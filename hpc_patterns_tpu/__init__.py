@@ -1,0 +1,28 @@
+"""tpu-hpc-patterns: a TPU-native framework with the capabilities of
+illuhad/HPC-Patterns, rebuilt idiomatically on JAX/XLA/Pallas/pjit.
+
+The reference (mounted at /root/reference) is a C++ suite of three
+self-validating GPU-parallelism pattern benchmarks:
+
+1. ``concurency/``              -> :mod:`hpc_patterns_tpu.concurrency`
+   (concurrent kernel/copy overlap; SYCL/OMP queues -> JAX async dispatch)
+2. ``aurora.mpich.miniapps/``   -> :mod:`hpc_patterns_tpu.comm` + ``apps/``
+   (GPU-aware MPI ring + collective allreduce -> ppermute/psum over a Mesh)
+3. ``sycl_omp_ze_interopt/``    -> :mod:`hpc_patterns_tpu.interop`
+   (Level-Zero zero-copy interop -> dlpack + native C++ shared buffers)
+
+Plus the layers the reference implies (SURVEY.md section 1):
+- device discovery/topology (``devices.hpp``) -> :mod:`hpc_patterns_tpu.topology`
+- dtype traits (``mpi_datatype.hpp``)         -> :mod:`hpc_patterns_tpu.dtypes`
+- harness/verdict/timing (per-app main()s)    -> :mod:`hpc_patterns_tpu.harness`
+
+And the TPU-first extensions the ring/pt2pt primitives are shaped for:
+- :mod:`hpc_patterns_tpu.parallel` — ring attention / sequence parallelism,
+  tensor parallelism helpers built on the same ring engine.
+- :mod:`hpc_patterns_tpu.models` — a flagship transformer exercising
+  dp/tp/sp shardings end to end.
+"""
+
+__version__ = "0.1.0"
+
+from hpc_patterns_tpu import topology, dtypes  # noqa: F401

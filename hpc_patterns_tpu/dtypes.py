@@ -1,0 +1,74 @@
+"""Dtype traits — TPU-native analog of the reference's
+``aurora.mpich.miniapps/src/include/mpi_datatype.hpp`` (C9 in SURVEY.md).
+
+The reference maps C++ scalar types to MPI datatypes via a trait template
+with 10 specializations and an ``MPI_BYTE`` default (mpi_datatype.hpp:24-51).
+XLA collectives are dtype-generic already, so the TPU equivalent is a
+registry of *supported, tested* dtypes with their collective/compute
+properties (bf16 is the MXU-native type; integer allreduce must be exact),
+plus the same "default = bytes" escape hatch: any unlisted dtype is handled
+by bitcasting to uint8 words, like the reference's MPI_BYTE default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DtypeTraits:
+    dtype: jnp.dtype
+    itemsize: int
+    exact_sum: bool  # integer/exact accumulate: validation uses ==, not isclose
+    mxu_native: bool  # preferred MXU input type
+    tolerance: float  # allreduce validation tolerance (reference: 1e-6,
+    # allreduce-mpi-sycl.cpp:197)
+
+
+def _t(dt, exact, mxu, tol) -> DtypeTraits:
+    dt = jnp.dtype(dt)
+    return DtypeTraits(dt, dt.itemsize, exact, mxu, tol)
+
+
+# The 10 scalar specializations of mpi_datatype.hpp:28-51 map onto these.
+REGISTRY: dict[str, DtypeTraits] = {
+    "float32": _t(jnp.float32, False, False, 1e-6),
+    "float16": _t(jnp.float16, False, False, 1e-2),
+    "bfloat16": _t(jnp.bfloat16, False, True, 1e-2),
+    "float64": _t(jnp.float64, False, False, 1e-12),  # x64 mode only
+    "int8": _t(jnp.int8, True, False, 0.0),
+    "int16": _t(jnp.int16, True, False, 0.0),
+    "int32": _t(jnp.int32, True, False, 0.0),
+    "int64": _t(jnp.int64, True, False, 0.0),  # x64 mode only
+    "uint8": _t(jnp.uint8, True, False, 0.0),
+    "uint32": _t(jnp.uint32, True, False, 0.0),
+}
+
+
+def get_traits(dtype) -> DtypeTraits:
+    """Traits for ``dtype``; unlisted dtypes get the byte-default treatment
+    (exact, bytewise), mirroring the reference's MPI_BYTE fallback
+    (mpi_datatype.hpp:24-26)."""
+    name = jnp.dtype(dtype).name
+    if name in REGISTRY:
+        return REGISTRY[name]
+    dt = jnp.dtype(dtype)
+    return DtypeTraits(dt, dt.itemsize, True, False, 0.0)
+
+
+def validate_allreduce(result: np.ndarray, expected_scalar, dtype) -> bool:
+    """The analytic-oracle check: every element equals the closed-form
+    expected value (allreduce-mpi-sycl.cpp:192-204)."""
+    traits = get_traits(dtype)
+    if traits.exact_sum:
+        # Compare in the original (integer) dtype — a float64 cast would
+        # lose precision past 2**53 and false-PASS wrong int64 results.
+        arr = np.asarray(result)
+        return bool(np.all(arr == arr.dtype.type(expected_scalar)))
+    arr = np.asarray(result, dtype=np.float64)
+    expected = float(expected_scalar)
+    bound = traits.tolerance + 1e-6 * abs(expected)  # atol + rtol form
+    return bool(np.all(np.abs(arr - expected) <= bound))

@@ -1,0 +1,84 @@
+"""Unified CLI/config layer (C13 in SURVEY.md).
+
+The reference uses three ad-hoc mechanisms — hand-rolled argv loops with a
+``-1 = auto`` sentinel (sycl_con.cpp:179-232), getopt short options
+``-haHDSp:`` (allreduce-mpi-sycl.cpp:106-131), and env vars
+(allreduce-usm-mpi-omp-offload.cpp:121-124). SURVEY.md section 5 calls for
+one layer with a ``--backend`` flag; this is it. All apps under
+``hpc_patterns_tpu.apps`` build on :func:`base_parser`.
+
+Kept semantics:
+- ``-1`` means auto/autotune wherever a size is accepted
+- ``-p N`` selects 2**N elements (allreduce-mpi-sycl.cpp:99,125-128),
+  default 25 (~128 MiB of float32)
+- memory-kind axis ``-H/-D`` maps host/device USM to JAX memory kinds
+  ``pinned_host`` / ``device`` (``-S`` shared has no TPU analog and maps
+  to device with a note)
+- ``--repetitions`` (default 10, sycl_con.cpp:182; the reference also
+  accepts a typo'd ``--repetitionss``, sycl_con.cpp:205 — not reproduced)
+"""
+
+from __future__ import annotations
+
+import argparse
+
+AUTO = -1
+
+
+def base_parser(description: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument(
+        "--backend",
+        default=None,
+        choices=["tpu", "cpu", "gpu"],
+        help="platform filter for device discovery (default: whatever JAX has)",
+    )
+    p.add_argument(
+        "--repetitions",
+        type=int,
+        default=10,
+        help="timing repetitions; result is the min (sycl_con.cpp protocol)",
+    )
+    p.add_argument("--warmup", type=int, default=2, help="untimed warm-up calls (absorbs XLA compile)")
+    p.add_argument("--log", default=None, help="write JSONL run log here (run.log analog)")
+    return p
+
+
+def add_msg_size_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "-p",
+        "--log2-elements",
+        type=int,
+        default=25,
+        help="message size = 2**p elements (default 25, ~128 MiB float32)",
+    )
+    p.add_argument("--dtype", default="float32", help="element dtype (dtypes.REGISTRY key)")
+
+
+def add_memory_kind_args(p: argparse.ArgumentParser) -> None:
+    g = p.add_mutually_exclusive_group()
+    g.add_argument(
+        "-H",
+        "--host",
+        dest="memory_kind",
+        action="store_const",
+        const="pinned_host",
+        help="buffers in host memory kind (reference -H, host USM)",
+    )
+    g.add_argument(
+        "-D",
+        "--device",
+        dest="memory_kind",
+        action="store_const",
+        const="device",
+        help="buffers in device HBM (reference -D, device USM; default)",
+    )
+    g.add_argument(
+        "-S",
+        "--shared",
+        dest="memory_kind",
+        action="store_const",
+        const="device",
+        help="reference -S shared USM; no TPU analog, treated as device",
+    )
+    p.set_defaults(memory_kind="device")

@@ -1,0 +1,63 @@
+"""Structured run log — the native-harness upgrade of ``run.log``.
+
+The reference's only artifact is a tee'd text log grepped for
+SUCCESS/FAILURE (concurency/run.sh:15-18). This keeps that grep-able
+stdout contract and *additionally* writes one JSON object per record, so
+sweeps are machine-readable (SURVEY.md section 5 "metrics/observability"
+upgrade). The native sweep driver (native/sweep.cpp) consumes the same
+format.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+from typing import IO, Any
+
+
+class RunLog:
+    def __init__(self, path: str | Path | None = None, stream: IO[str] | None = None):
+        self.path = Path(path) if path else None
+        self.stream = stream if stream is not None else sys.stdout
+        self.records: list[dict[str, Any]] = []
+        if self.path:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            # truncate: one log per run, like run.sh's tee
+            self.path.write_text("")
+
+    def emit(self, **record: Any) -> dict[str, Any]:
+        record.setdefault("ts", time.time())
+        self.records.append(record)
+        line = json.dumps(record, default=str)
+        if self.path:
+            with self.path.open("a") as f:
+                f.write(line + "\n")
+        return record
+
+    def print(self, text: str) -> None:
+        """Human/grep-able line to stdout (run.sh:17-18 contract)."""
+        print(text, file=self.stream)
+
+    def result(self, name: str, verdict, **extra: Any) -> None:
+        self.emit(
+            kind="result",
+            name=name,
+            success=verdict.success,
+            speedup=verdict.speedup,
+            max_theoretical_speedup=verdict.max_theoretical_speedup,
+            **extra,
+        )
+        for m in verdict.messages:
+            self.print(f"[{name}] {m}")
+
+    def summary(self) -> tuple[int, int]:
+        """(n_success, n_failure) over result records; prints the grep
+        summary exactly once, like run.sh:17-18."""
+        results = [r for r in self.records if r.get("kind") == "result"]
+        ok = sum(1 for r in results if r.get("success"))
+        bad = len(results) - ok
+        self.print(f"SUCCESS count: {ok}")
+        self.print(f"FAILURE count: {bad}")
+        return ok, bad

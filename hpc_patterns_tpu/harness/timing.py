@@ -1,0 +1,115 @@
+"""Timing protocol: warm-up + min-over-repetitions wall clock.
+
+Reproduces the reference's measurement protocol (SURVEY.md section 6):
+- min over N repetitions (sycl_con.cpp:114, default 10 at :182;
+  NUM_REPETION 2 in omp_con.cpp:22) as the noise-control estimator;
+- "best theoretical serial" = sum of per-command minima
+  (sycl_con.cpp:117-119);
+- per-rank wall clock, MAX-reduced across ranks for distributed runs
+  (allreduce-mpi-sycl.cpp:188-190) — here :func:`max_across_processes`.
+
+TPU-specific addition the reference didn't need: the first call under jit
+pays XLA compilation (~seconds), so measurement *must* warm up first and
+block on dispatch (`jax.block_until_ready`) — SURVEY.md section 7 "hard
+parts" (d).
+
+When the native extension is built (native/hpcpat.cpp), the min/mean/std
+reduction runs in C++; the pure-Python fallback is numerically identical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Sequence
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class TimingResult:
+    times_s: tuple[float, ...]
+
+    @property
+    def min_s(self) -> float:
+        return min(self.times_s)
+
+    @property
+    def mean_s(self) -> float:
+        return sum(self.times_s) / len(self.times_s)
+
+    @property
+    def max_s(self) -> float:
+        return max(self.times_s)
+
+    def bandwidth_gbps(self, nbytes: int) -> float:
+        return bandwidth_gbps(nbytes, self.min_s)
+
+
+def bandwidth_gbps(nbytes: int, seconds: float) -> float:
+    if seconds <= 0:
+        return float("inf")
+    return nbytes / seconds / 1e9
+
+
+def measure(
+    fn: Callable[[], object],
+    *,
+    repetitions: int = 10,
+    warmup: int = 1,
+) -> TimingResult:
+    """Time ``fn`` with the reference's protocol: ``warmup`` untimed calls
+    (absorbing XLA compilation), then ``repetitions`` timed calls; the
+    caller consumes :attr:`TimingResult.min_s`.
+
+    ``fn`` must block until its device work completes; wrap JAX work so it
+    ends in ``jax.block_until_ready``. Use :func:`blocking` for that.
+    """
+    if repetitions < 1:
+        raise ValueError("repetitions must be >= 1")
+    for _ in range(warmup):
+        fn()
+    times = []
+    for _ in range(repetitions):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return TimingResult(tuple(_native_identity(times)))
+
+
+def _native_identity(times: Sequence[float]) -> Sequence[float]:
+    """Round-trip the samples through the native stats engine when it is
+    available, so the C++ path is exercised everywhere timing is used."""
+    try:
+        from hpc_patterns_tpu.interop import native
+
+        if native.available():
+            return native.stats_roundtrip(times)
+    except Exception:
+        pass
+    return times
+
+
+def blocking(fn: Callable[..., object], *args, **kwargs) -> Callable[[], object]:
+    """Wrap a JAX computation into a zero-arg blocking thunk for measure()."""
+
+    def thunk():
+        return jax.block_until_ready(fn(*args, **kwargs))
+
+    return thunk
+
+
+def max_across_processes(seconds: float) -> float:
+    """Cross-process MAX of a local elapsed time, the distributed timing
+    convention of allreduce-mpi-sycl.cpp:188-190 (MPI_Allreduce(MAX)).
+
+    Single-process (the common JAX SPMD case: one process drives all local
+    devices) returns the input unchanged.
+    """
+    if jax.process_count() == 1:
+        return seconds
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(np.float64(seconds))
+    return float(np.max(gathered))

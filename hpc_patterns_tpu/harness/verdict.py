@@ -1,0 +1,120 @@
+"""Verdict engine: SUCCESS/FAILURE acceptance thresholds.
+
+The reference's benchmarks are their own tests (SURVEY.md section 4): each
+binary computes a theoretical bound from its serial baseline and exits
+0/1 on whether the measured result is within tolerance of it. This module
+centralizes those rules:
+
+- SYCL rule (sycl_con.cpp:279-296): theoretical max speedup =
+  serial_total / max_single_command; PASS iff achieved speedup >
+  theoretical / 1.3; WARN (unbalanced commands) if theoretical <= 1.5.
+- OMP rule (omp_con.cpp:223-244): PASS iff concurrent_total <=
+  1.3 * max_single_command; WARN if theoretical <= 1.3.
+- correctness rule (allreduce-mpi-sycl.cpp:192-204): every element equals
+  the analytic oracle within tolerance; prints "Passed <rank>".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+TOLERANCE = 1.3  # the reference's universal slack factor
+
+
+@dataclasses.dataclass(frozen=True)
+class Verdict:
+    success: bool
+    messages: tuple[str, ...]
+    speedup: float | None = None
+    max_theoretical_speedup: float | None = None
+    warned_unbalanced: bool = False
+
+    @property
+    def exit_code(self) -> int:
+        return 0 if self.success else 1
+
+    def summary_line(self) -> str:
+        # grep-able, like the lines run.sh:17-18 filters for
+        return "SUCCESS" if self.success else "FAILURE"
+
+
+def concurrency_verdict(
+    serial_command_times_s: Sequence[float],
+    concurrent_total_s: float,
+    *,
+    tolerance: float = TOLERANCE,
+    rule: str = "sycl",
+) -> Verdict:
+    """Overlap acceptance for the concurrency suite.
+
+    ``rule="sycl"``: speedup-based (sycl_con.cpp:279-296).
+    ``rule="omp"``: absolute-time-based (omp_con.cpp:238-244).
+    """
+    serial_times = [float(t) for t in serial_command_times_s]
+    if not serial_times or concurrent_total_s <= 0 or min(serial_times) <= 0:
+        raise ValueError(
+            "need positive serial per-command times and a positive concurrent total"
+        )
+    serial_total = sum(serial_times)
+    max_single = max(serial_times)
+    max_theoretical = serial_total / max_single
+    speedup = serial_total / concurrent_total_s
+    msgs = [
+        f"serial_total={serial_total:.6f}s max_single={max_single:.6f}s",
+        f"speedup={speedup:.3f} max_theoretical={max_theoretical:.3f}",
+    ]
+    warn_threshold = 1.5 if rule == "sycl" else tolerance
+    warned = max_theoretical <= warn_threshold
+    if warned:
+        msgs.append(
+            "WARNING: commands are unbalanced; overlap barely measurable "
+            f"(max theoretical speedup {max_theoretical:.3f} <= {warn_threshold})"
+        )
+    if rule == "sycl":
+        ok = speedup > max_theoretical / tolerance
+    elif rule == "omp":
+        ok = concurrent_total_s <= tolerance * max_single
+    else:
+        raise ValueError(f"unknown rule {rule!r}")
+    msgs.append("SUCCESS" if ok else "FAILURE")
+    return Verdict(
+        success=ok,
+        messages=tuple(msgs),
+        speedup=speedup,
+        max_theoretical_speedup=max_theoretical,
+        warned_unbalanced=warned,
+    )
+
+
+def correctness_verdict(
+    result,
+    expected_scalar: float,
+    *,
+    dtype=None,
+    rank: int = 0,
+) -> Verdict:
+    """Analytic-oracle elementwise validation (allreduce-mpi-sycl.cpp:192-204)."""
+    from hpc_patterns_tpu.dtypes import get_traits, validate_allreduce
+
+    arr = np.asarray(result)
+    dt = dtype if dtype is not None else arr.dtype
+    ok = validate_allreduce(arr, expected_scalar, dt)
+    if ok:
+        msgs = (f"Passed {rank}", "SUCCESS")
+    else:
+        traits = get_traits(dt)
+        atol = traits.tolerance if not traits.exact_sum else 0.0
+        bad = np.flatnonzero(
+            ~np.isclose(arr.astype(np.float64), float(expected_scalar), atol=atol, rtol=1e-6)
+        )
+        first = int(bad[0]) if bad.size else -1
+        msgs = (
+            f"rank {rank}: {bad.size}/{arr.size} elements wrong, "
+            f"first at [{first}] = {arr.flat[first] if first >= 0 else '?'} "
+            f"expected {expected_scalar}",
+            "FAILURE",
+        )
+    return Verdict(success=ok, messages=msgs)

@@ -1,0 +1,216 @@
+"""Device discovery and topology — TPU-native analog of the reference's
+``aurora.mpich.miniapps/src/include/devices.hpp`` (C8 in SURVEY.md).
+
+The reference provides (devices.hpp:6-59):
+- platform-prefix device lookup (``get_devices(target)``, devices.hpp:6-13)
+- "device fission": partition each GPU into NUMA tiles via
+  ``create_sub_devices<partition_by_affinity_domain>`` with whole-GPU
+  fallback (devices.hpp:28-38)
+- rank->device mapping: modulo round-robin when ranks > devices
+  (devices.hpp:47), contiguous block split when devices >= ranks
+  (devices.hpp:49-53)
+
+TPU-native equivalents here:
+- device lookup over ``jax.devices()`` filtered by platform
+- "fission" = the chip -> core topology JAX already exposes (each TPU core
+  is a device), plus grouping helpers by host/slice so meshes can be laid
+  out so collectives ride ICI, not DCN
+- the same two rank->device policies, reused for mesh construction
+- :func:`make_mesh` — the central entry point: build a
+  ``jax.sharding.Mesh`` with named axes (dp/sp/tp/...) over the devices,
+  the TPU analog of MPI communicators.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from collections import defaultdict
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+class TopologyError(RuntimeError):
+    """Raised when no usable device topology exists.
+
+    Analog of the reference's fail-fast no-device error
+    (allreduce-mpi-sycl.cpp:137-141).
+    """
+
+
+def get_devices(platform: str | None = None) -> list[jax.Device]:
+    """All addressable devices, optionally filtered by platform prefix.
+
+    Analog of ``get_devices(target)`` (devices.hpp:6-13), which filters
+    SYCL platforms by name prefix; here the "platform" is the JAX backend
+    name ("tpu", "cpu", "gpu").
+    """
+    devices = list(jax.devices())
+    if platform is not None:
+        devices = [d for d in devices if d.platform.startswith(platform)]
+    if not devices:
+        raise TopologyError(
+            f"no devices for platform prefix {platform!r}; "
+            f"available: {sorted({d.platform for d in jax.devices()})}"
+        )
+    return devices
+
+
+def fission(devices: Sequence[jax.Device] | None = None) -> list[jax.Device]:
+    """Expose the finest-grained compute units as devices.
+
+    The reference's fission splits each GPU into NUMA tiles, falling back
+    to whole GPUs when sub-devices are unsupported (devices.hpp:28-38).
+    On TPU, JAX already enumerates one device per core (a v4/v5p chip with
+    megacore shows one device; v2/v3/v5e show per-core devices), so the
+    sub-device set *is* ``jax.devices()``. This function exists to keep the
+    reference's API shape and its fallback semantics: it never fails, it
+    returns the finest partition available.
+    """
+    if devices is None:
+        devices = get_devices()
+    return list(devices)
+
+
+def assign_device(rank: int, size: int, devices: Sequence[jax.Device]) -> jax.Device:
+    """Map an SPMD rank to a device with the reference's two policies.
+
+    - ranks > devices: modulo round-robin — ``rank % n`` (devices.hpp:47)
+    - devices >= ranks: contiguous block split, rank r owns block
+      ``[r * n//size, (r+1) * n//size)`` and uses its first device
+      (devices.hpp:49-53)
+    """
+    if size <= 0 or rank < 0 or rank >= size:
+        raise ValueError(f"bad rank/size: {rank}/{size}")
+    n = len(devices)
+    if n == 0:
+        raise TopologyError("no devices to assign")
+    if size > n:
+        return devices[rank % n]
+    block = n // size
+    return devices[rank * block]
+
+
+def devices_for_rank(rank: int, size: int, devices: Sequence[jax.Device]) -> list[jax.Device]:
+    """The full device block owned by ``rank`` under the block policy."""
+    if size <= 0 or rank < 0 or rank >= size:
+        raise ValueError(f"bad rank/size: {rank}/{size}")
+    n = len(devices)
+    if size > n:
+        return [devices[rank % n]]
+    block = n // size
+    return list(devices[rank * block : (rank + 1) * block])
+
+
+def group_by_host(devices: Sequence[jax.Device] | None = None) -> dict[int, list[jax.Device]]:
+    """Group devices by owning process/host (ICI domain approximation).
+
+    Within one host/slice, collectives ride ICI; across hosts they may
+    cross DCN. Mesh layouts should put fast axes (tp/sp) inside a group.
+    """
+    if devices is None:
+        devices = get_devices()
+    groups: dict[int, list[jax.Device]] = defaultdict(list)
+    for d in devices:
+        groups[d.process_index].append(d)
+    return dict(groups)
+
+
+def group_by_slice(devices: Sequence[jax.Device] | None = None) -> dict[int, list[jax.Device]]:
+    """Group devices by TPU slice (multi-slice = DCN between groups)."""
+    if devices is None:
+        devices = get_devices()
+    groups: dict[int, list[jax.Device]] = defaultdict(list)
+    for d in devices:
+        groups[getattr(d, "slice_index", 0)].append(d)
+    return dict(groups)
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologyInfo:
+    """A summary of the visible device topology (for logs and verdicts)."""
+
+    platform: str
+    n_devices: int
+    n_hosts: int
+    n_slices: int
+    coords: tuple | None  # chip coords of device 0, if exposed
+
+    @classmethod
+    def detect(cls) -> "TopologyInfo":
+        ds = get_devices()
+        d0 = ds[0]
+        return cls(
+            platform=d0.platform,
+            n_devices=len(ds),
+            n_hosts=jax.process_count(),
+            n_slices=len(group_by_slice(ds)),
+            coords=getattr(d0, "coords", None),
+        )
+
+
+def _factor_axes(n_devices: int, axes: Mapping[str, int]) -> dict[str, int]:
+    """Resolve -1 ("auto", the reference's CLI sentinel, sycl_con.cpp:179-232)
+    axis sizes so the product equals ``n_devices``."""
+    sizes = dict(axes)
+    for k, v in sizes.items():
+        if v != -1 and v < 1:
+            raise TopologyError(f"axis {k!r} has invalid size {v} (use -1 for auto)")
+    auto = [k for k, v in sizes.items() if v == -1]
+    fixed = math.prod(v for v in sizes.values() if v != -1)
+    if n_devices % fixed != 0:
+        raise TopologyError(
+            f"mesh axes {dict(axes)} do not divide {n_devices} devices"
+        )
+    rest = n_devices // fixed
+    if not auto:
+        if fixed != n_devices:
+            raise TopologyError(
+                f"mesh axes {dict(axes)} use {fixed} devices but {n_devices} exist"
+            )
+        return sizes
+    # Give the remainder to the first auto axis, 1 to the others.
+    for k in auto[1:]:
+        sizes[k] = 1
+    sizes[auto[0]] = rest
+    return sizes
+
+
+def make_mesh(
+    axes: Mapping[str, int],
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a named-axis device mesh — the TPU analog of the reference's
+    MPI communicator + rank->device map (devices.hpp:22-59).
+
+    ``axes`` maps axis name -> size; a size of -1 means "auto" (fill with
+    the remaining devices), mirroring the reference CLI's -1 sentinels.
+    Axis order matters: later axes vary fastest over the device list, so
+    put the most communication-heavy axes (tp, then sp) last to keep their
+    collectives on adjacent devices (ICI, not DCN).
+    """
+    if devices is None:
+        devices = get_devices()
+    sizes = _factor_axes(len(devices), axes)
+    names = tuple(sizes.keys())
+    shape = tuple(sizes[k] for k in names)
+    try:
+        # Let JAX pick an ICI-friendly physical layout when it can.
+        return jax.make_mesh(shape, names, devices=tuple(devices))
+    except (ValueError, TypeError):
+        dev_array = np.asarray(devices).reshape(shape)
+        return Mesh(dev_array, names)
+
+
+def single_device_mesh(axes: Sequence[str] = ("dp",)) -> Mesh:
+    """A trivial 1-device mesh so every code path also runs on one chip."""
+    d = get_devices()[0]
+    shape = (1,) * len(axes)
+    return Mesh(np.asarray([d]).reshape(shape), tuple(axes))

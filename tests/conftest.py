@@ -1,0 +1,46 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The reference tests on real hardware only (mpirun -np 4, SURVEY.md
+section 4); the gap it leaves — hardware-free multi-device testing — is
+closed here with XLA's host-platform device-count override, so every
+distributed code path runs as 8-way SPMD on CPU.
+
+Must run before any jax import, hence module-level env mutation in
+conftest (pytest imports conftest first).
+"""
+
+import os
+import sys
+from pathlib import Path
+
+# The axon TPU plugin (sitecustomize in PYTHONPATH) force-registers the
+# real chip at interpreter start, before conftest runs — so jax is already
+# imported; retarget it to CPU via config (works as long as no backend has
+# been initialized yet).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from hpc_patterns_tpu import topology
+
+    return topology.make_mesh({"x": 8})
+
+
+@pytest.fixture(scope="session")
+def mesh_dp_sp_tp():
+    from hpc_patterns_tpu import topology
+
+    return topology.make_mesh({"dp": 2, "sp": 2, "tp": 2})

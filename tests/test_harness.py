@@ -1,0 +1,115 @@
+"""Tests for the harness: timing protocol, verdict rules, run log.
+
+The verdict rules are checked against the reference's arithmetic
+(sycl_con.cpp:279-296, omp_con.cpp:223-244) with synthetic numbers, so
+they hold regardless of host speed.
+"""
+
+import json
+import time
+
+import pytest
+
+from hpc_patterns_tpu.harness import (
+    RunLog,
+    TimingResult,
+    bandwidth_gbps,
+    concurrency_verdict,
+    correctness_verdict,
+    measure,
+)
+
+
+def test_measure_min_of_reps():
+    calls = []
+
+    def fn():
+        calls.append(time.perf_counter())
+        time.sleep(0.001)
+
+    r = measure(fn, repetitions=5, warmup=2)
+    assert len(calls) == 7  # warmup excluded from samples
+    assert len(r.times_s) == 5
+    assert r.min_s <= r.mean_s <= r.max_s
+    assert r.min_s >= 0.001
+
+
+def test_timing_result_bandwidth():
+    r = TimingResult((0.5, 1.0))
+    assert r.bandwidth_gbps(1_000_000_000) == pytest.approx(2.0)
+    assert bandwidth_gbps(10**9, 0) == float("inf")
+
+
+def test_sycl_verdict_pass_and_fail():
+    # two balanced commands, perfect overlap: speedup 2.0, theoretical 2.0
+    v = concurrency_verdict([1.0, 1.0], 1.0, rule="sycl")
+    assert v.success and v.speedup == pytest.approx(2.0)
+    assert v.max_theoretical_speedup == pytest.approx(2.0)
+    assert not v.warned_unbalanced
+    # no overlap at all: speedup 1.0 < 2.0/1.3 -> FAILURE
+    v = concurrency_verdict([1.0, 1.0], 2.0, rule="sycl")
+    assert not v.success
+    assert v.exit_code == 1
+    # boundary: exactly theoretical/1.3 is NOT a pass (strict >)
+    v = concurrency_verdict([1.0, 1.0], 1.3, rule="sycl")
+    assert not v.success
+    # just inside tolerance passes
+    v = concurrency_verdict([1.0, 1.0], 1.29, rule="sycl")
+    assert v.success
+
+
+def test_sycl_verdict_unbalanced_warning():
+    # one command dominates: theoretical = 1.1/1.0 = 1.1 <= 1.5 -> warn
+    v = concurrency_verdict([1.0, 0.1], 1.0, rule="sycl")
+    assert v.warned_unbalanced
+    assert any("unbalanced" in m for m in v.messages)
+
+
+def test_omp_verdict_rule():
+    # PASS iff concurrent_total <= 1.3 * max_single (omp_con.cpp:238-244)
+    assert concurrency_verdict([1.0, 1.0], 1.3, rule="omp").success
+    assert not concurrency_verdict([1.0, 1.0], 1.31, rule="omp").success
+
+
+def test_verdict_bad_inputs():
+    with pytest.raises(ValueError):
+        concurrency_verdict([], 1.0)
+    with pytest.raises(ValueError):
+        concurrency_verdict([1.0], 0.0)
+    with pytest.raises(ValueError):
+        concurrency_verdict([1.0], 1.0, rule="mystery")
+
+
+def test_correctness_verdict():
+    import numpy as np
+
+    # the analytic oracle: sum of ranks 0..7 = 28 (allreduce-mpi-sycl.cpp:192-204)
+    good = np.full(64, 28.0, dtype=np.float32)
+    v = correctness_verdict(good, 28.0, rank=3)
+    assert v.success
+    assert "Passed 3" in v.messages[0]
+    bad = good.copy()
+    bad[17] = 27.0
+    v = correctness_verdict(bad, 28.0, rank=0)
+    assert not v.success
+    assert "[17]" in v.messages[0]
+    # integer dtype: exact equality required
+    iv = np.full(8, 28, dtype=np.int32)
+    assert correctness_verdict(iv, 28, dtype="int32").success
+    iv[0] = 29
+    assert not correctness_verdict(iv, 28, dtype="int32").success
+
+
+def test_runlog_jsonl_and_summary(tmp_path, capsys):
+    log = RunLog(tmp_path / "run.jsonl")
+    v_ok = concurrency_verdict([1.0, 1.0], 1.0)
+    v_bad = concurrency_verdict([1.0, 1.0], 2.0)
+    log.result("a", v_ok, commands=["C", "M2D"])
+    log.result("b", v_bad)
+    ok, bad = log.summary()
+    assert (ok, bad) == (1, 1)
+    out = capsys.readouterr().out
+    assert "SUCCESS count: 1" in out and "FAILURE count: 1" in out
+    lines = [json.loads(l) for l in (tmp_path / "run.jsonl").read_text().splitlines()]
+    assert [l["name"] for l in lines] == ["a", "b"]
+    assert lines[0]["commands"] == ["C", "M2D"]

@@ -1,0 +1,78 @@
+"""Tests for topology (C8 parity: devices.hpp rank->device policies,
+fission fallback, mesh construction)."""
+
+import jax
+import pytest
+
+from hpc_patterns_tpu import topology
+
+
+def test_get_devices_platform_filter():
+    ds = topology.get_devices("cpu")
+    assert len(ds) == 8
+    with pytest.raises(topology.TopologyError):
+        topology.get_devices("nonexistent-platform")
+
+
+def test_fission_never_fails():
+    # reference semantics: finest partition, whole-device fallback
+    # (devices.hpp:28-38)
+    assert len(topology.fission()) == 8
+    assert topology.fission([]) == []
+
+
+def test_assign_device_modulo_when_oversubscribed():
+    # ranks > devices -> rank % n (devices.hpp:47)
+    ds = topology.get_devices()
+    n = len(ds)
+    for rank in range(2 * n):
+        assert topology.assign_device(rank, 2 * n, ds) == ds[rank % n]
+
+
+def test_assign_device_block_when_undersubscribed():
+    # devices >= ranks -> contiguous blocks (devices.hpp:49-53)
+    ds = topology.get_devices()  # 8
+    assert topology.assign_device(0, 2, ds) == ds[0]
+    assert topology.assign_device(1, 2, ds) == ds[4]
+    assert topology.devices_for_rank(1, 2, ds) == list(ds[4:8])
+    assert topology.devices_for_rank(0, 4, ds) == list(ds[0:2])
+
+
+def test_assign_device_bad_args():
+    ds = topology.get_devices()
+    with pytest.raises(ValueError):
+        topology.assign_device(3, 2, ds)
+    with pytest.raises(topology.TopologyError):
+        topology.assign_device(0, 1, [])
+
+
+def test_make_mesh_explicit_and_auto():
+    m = topology.make_mesh({"dp": 2, "tp": 4})
+    assert m.shape == {"dp": 2, "tp": 4}
+    # -1 auto sentinel (sycl_con.cpp CLI convention)
+    m = topology.make_mesh({"dp": -1, "tp": 2})
+    assert m.shape == {"dp": 4, "tp": 2}
+    m = topology.make_mesh({"a": -1, "b": -1, "c": 2})
+    assert m.shape == {"a": 4, "b": 1, "c": 2}
+
+
+def test_make_mesh_rejects_nondividing():
+    with pytest.raises(topology.TopologyError):
+        topology.make_mesh({"dp": 3})
+    with pytest.raises(topology.TopologyError):
+        topology.make_mesh({"dp": 2})  # uses 2 of 8 with no auto axis
+
+
+def test_single_device_mesh_and_info():
+    m = topology.single_device_mesh(("dp", "tp"))
+    assert m.shape == {"dp": 1, "tp": 1}
+    info = topology.TopologyInfo.detect()
+    assert info.n_devices == 8
+    assert info.platform == "cpu"
+    assert info.n_hosts == 1
+
+
+def test_group_by_host():
+    groups = topology.group_by_host()
+    assert sum(len(v) for v in groups.values()) == 8
+    assert set(groups) == {jax.devices()[0].process_index}
