@@ -1,0 +1,23 @@
+"""Distributed communication backend — TPU-native analog of the
+reference's GPU-aware MPICH layer (SURVEY.md §2.3).
+
+The reference passes device-resident buffers straight to
+``MPI_Send/Recv/Allreduce`` (allreduce-mpi-sycl.cpp:173-182) over ranks
+created by ``mpirun``. Here the "communicator" is a named axis of a
+``jax.sharding.Mesh``; collectives are XLA collectives over ICI/DCN that
+operate directly on HBM-resident sharded arrays — the TPU meaning of
+"GPU-aware" (no host staging).
+
+Two API levels:
+
+- :mod:`hpc_patterns_tpu.comm.ring` + :mod:`~.collectives` — *rank-local*
+  functions used **inside** ``shard_map``: each takes the local shard and
+  an axis name, exactly like the reference's per-rank functions take a
+  device buffer and a communicator.
+- :class:`~hpc_patterns_tpu.comm.communicator.Communicator` — array-level
+  API over global ``jax.Array``\\ s: builds the ``shard_map`` for you, the
+  analog of the miniapp main()s wiring buffers to MPI calls.
+"""
+
+from hpc_patterns_tpu.comm import collectives, ring  # noqa: F401
+from hpc_patterns_tpu.comm.communicator import Communicator  # noqa: F401

@@ -1,0 +1,74 @@
+"""Library collectives: the XLA-native layer (MPI_Allreduce analog).
+
+The reference offers both a hand-built ring AND the library collective so
+their bandwidth can be compared (``AllreduceColl`` -> ``MPI_Allreduce`` on
+device pointers, allreduce-mpi-sycl.cpp:61-67; comparison requirement in
+SURVEY.md §2.3(b)). This module is the library side: thin, dtype-generic
+wrappers over ``jax.lax`` collectives for use inside ``shard_map``, with
+the reference's dtype-trait dispatch (mpi_datatype.hpp) riding on
+:mod:`hpc_patterns_tpu.dtypes`.
+
+On TPU these lower to XLA all-reduce / all-gather / reduce-scatter /
+all-to-all over ICI (intra-slice) or DCN (multi-slice) on HBM-resident
+shards — no host staging, the "GPU-aware" property (§2.3(a)).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+def _pprod(x, axis):
+    # XLA has no native pprod; all_gather+reduce keeps exactness for ints.
+    return lax.all_gather(x, axis).prod(axis=0)
+
+
+# op name -> shard_map-level implementation; the reference hard-codes
+# MPI_SUM (allreduce-mpi-sycl.cpp:66) but MPI's op table is part of the
+# API shape being reproduced.
+_REDUCE_OPS = {
+    "sum": lax.psum,
+    "max": lax.pmax,
+    "min": lax.pmin,
+    "mean": lax.pmean,
+    "prod": _pprod,
+}
+
+
+def allreduce(x, axis: str, op: str = "sum"):
+    """``MPI_Allreduce`` analog (allreduce-mpi-sycl.cpp:61-67): every rank
+    gets the elementwise reduction across the mesh axis."""
+    try:
+        fn = _REDUCE_OPS[op]
+    except KeyError:
+        raise ValueError(f"unknown reduce op {op!r}; have {sorted(_REDUCE_OPS)}")
+    return fn(x, axis)
+
+
+def all_gather(x, axis: str, *, tiled: bool = True, gather_axis: int = 0):
+    """``MPI_Allgather`` analog; tiled concatenates along ``gather_axis``."""
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str, *, scatter_axis: int = 0, op: str = "sum"):
+    """``MPI_Reduce_scatter`` analog via ``lax.psum_scatter``."""
+    if op != "sum":
+        raise ValueError("reduce_scatter supports op='sum' (XLA psum_scatter)")
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def all_to_all(x, axis: str, *, split_axis: int = 0, concat_axis: int = 0):
+    """``MPI_Alltoall`` analog — the Ulysses sequence-parallel primitive."""
+    return lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
+
+
+def broadcast(x, axis: str, *, root: int = 0):
+    """``MPI_Bcast`` analog: every rank gets root's shard."""
+    return lax.all_gather(x, axis)[root]
+
+
+def barrier_value(axis: str):
+    """A cheap full-axis synchronization value (psum of 1); the closest
+    XLA analog of ``MPI_Barrier`` — collectives are the only cross-shard
+    ordering points in the XLA program order."""
+    return lax.psum(jnp.ones((), jnp.int32), axis)

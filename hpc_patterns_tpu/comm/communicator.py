@@ -1,0 +1,146 @@
+"""Array-level communicator: mesh axis ≙ MPI communicator.
+
+The reference's miniapp mains wire device buffers to MPI calls per rank
+(allreduce-mpi-sycl.cpp:88-207). Here one process drives all local TPU
+devices, so the per-rank view is created by ``shard_map``: a
+:class:`Communicator` binds a mesh axis and exposes collectives over
+global ``jax.Array``\\ s whose leading dimension is sharded on that axis —
+row r of the global array is rank r's buffer, exactly the miniapp's
+``VA/VB/VC`` per-rank layout.
+
+Every operation jit-compiles a ``shard_map`` closure (cached per shape/
+dtype/algorithm); on TPU the collectives run on HBM shards over ICI with
+no host staging.
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hpc_patterns_tpu.comm import collectives, ring
+
+Algorithm = Literal["collective", "ring", "ring_chunked"]
+
+# allreduce algorithm table: library collective vs hand-built rings —
+# the comparison the reference exists to make (SURVEY.md §2.3(b)).
+_ALLREDUCE = {
+    "collective": lambda x, axis: collectives.allreduce(x, axis, "sum"),
+    "ring": ring.ring_allreduce,
+    # chunk over the trailing (data) axis — the leading axis is the
+    # 1-row rank dimension inside shard_map
+    "ring_chunked": lambda x, axis: ring.ring_allreduce_chunked(
+        x, axis, scatter_axis=x.ndim - 1
+    ),
+}
+
+
+class Communicator:
+    """Collectives over one named axis of a mesh.
+
+    ``Communicator(mesh, "x")`` plays the role of ``MPI_COMM_WORLD`` in
+    the miniapps; ``size`` is ``MPI_Comm_size``. Arrays passed in must
+    have a leading dimension equal to ``size`` (one row per rank); they
+    are sharded onto the axis automatically if not already.
+    """
+
+    def __init__(self, mesh: Mesh, axis: str = "x"):
+        if axis not in mesh.axis_names:
+            raise ValueError(f"axis {axis!r} not in mesh axes {mesh.axis_names}")
+        self.mesh = mesh
+        self.axis = axis
+
+    @property
+    def size(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    def row_sharding(self, ndim: int) -> NamedSharding:
+        """Sharding that puts row r on rank r (leading dim over the axis)."""
+        return NamedSharding(self.mesh, P(self.axis, *([None] * (ndim - 1))))
+
+    def shard(self, x) -> jax.Array:
+        """Place a (size, ...) array with one row per rank — the analog of
+        each rank allocating + initializing its device buffer
+        (allreduce-mpi-sycl.cpp:154-164)."""
+        x = jnp.asarray(x)
+        if x.shape[0] != self.size:
+            raise ValueError(
+                f"leading dim {x.shape[0]} != communicator size {self.size}"
+            )
+        return jax.device_put(x, self.row_sharding(x.ndim))
+
+    def _shmap(self, fn, x, out_specs=None):
+        spec = P(self.axis, *([None] * (jnp.ndim(x) - 1)))
+        out = out_specs if out_specs is not None else spec
+        mapped = jax.shard_map(fn, mesh=self.mesh, in_specs=spec, out_specs=out)
+        return jax.jit(mapped)
+
+    # -- collectives over (size, n) arrays --------------------------------
+
+    def allreduce(self, x, algorithm: Algorithm = "collective") -> jax.Array:
+        """Elementwise sum across ranks; every row of the result holds the
+        sum (MPI_Allreduce semantics, allreduce-mpi-sycl.cpp:61-67 for
+        ``"collective"``; the :173-182 hand ring for ``"ring"``;
+        two-phase bandwidth-optimal ring for ``"ring_chunked"``)."""
+        impl = _ALLREDUCE[algorithm]
+        return self._shmap(lambda local: impl(local, self.axis), x)(x)
+
+    def jit_allreduce(self, x, algorithm: Algorithm = "collective"):
+        """The compiled allreduce closure for ``x``'s shape — what a
+        benchmark should time (compile excluded per SURVEY.md §7(d))."""
+        impl = _ALLREDUCE[algorithm]
+        return self._shmap(lambda local: impl(local, self.axis), x)
+
+    def pingpong(self, x) -> jax.Array:
+        """Pairwise even/odd exchange: row r swaps with row r^1 — the
+        pt2pt ping-pong config of BASELINE.json."""
+        return self._shmap(lambda l: ring.pairwise_exchange(l, self.axis), x)(x)
+
+    def sendrecv_ring(self, x, shift: int = 1) -> jax.Array:
+        """One ring hop: row r moves to row (r+shift) % size
+        (SendRecvRing, allreduce-mpi-sycl.cpp:43-59)."""
+        return self._shmap(lambda l: ring.ring_shift(l, self.axis, shift), x)(x)
+
+    def all_gather(self, x) -> jax.Array:
+        """Every rank receives every row: (size, n) -> (size, size, n)."""
+        fn = lambda l: collectives.all_gather(l, self.axis, tiled=False).squeeze(1)[None]
+        spec = P(self.axis, None, *([None] * (jnp.ndim(x) - 1)))
+        return self._shmap(fn, x, out_specs=spec)(x)
+
+    def reduce_scatter(self, x) -> jax.Array:
+        """(size, size*n) rows -> (size, n): rank r gets chunk r of the sum."""
+        fn = lambda l: collectives.reduce_scatter(l, self.axis, scatter_axis=jnp.ndim(x) - 1)
+        return self._shmap(fn, x, out_specs=P(self.axis, *([None] * (jnp.ndim(x) - 1))))(x)
+
+    def all_to_all(self, x) -> jax.Array:
+        """Row r's chunk c goes to row c's chunk r (MPI_Alltoall)."""
+        fn = lambda l: collectives.all_to_all(
+            l, self.axis, split_axis=jnp.ndim(x) - 1, concat_axis=jnp.ndim(x) - 1
+        )
+        return self._shmap(fn, x)(x)
+
+    # -- miniapp-style buffer init ---------------------------------------
+
+    def rank_filled(self, n: int, dtype="float32") -> jax.Array:
+        """The miniapp's ``Initialize``: rank r's buffer filled with r
+        (allreduce-mpi-sycl.cpp:33-41), so the allreduce oracle is
+        ``size*(size-1)/2`` (:192-204). Built shard-wise (no host
+        materialization of the global array)."""
+
+        def init(_):
+            r = ring.axis_index(self.axis)
+            return jnp.full((1, n), r, dtype=dtype)
+
+        spec = P(self.axis, None)
+        token = self.shard(np.zeros((self.size, 1), np.int8))
+        return jax.jit(
+            jax.shard_map(init, mesh=self.mesh, in_specs=spec, out_specs=spec)
+        )(token)
+
+    def expected_allreduce_value(self) -> float:
+        """The analytic oracle: Σ ranks = size(size-1)/2."""
+        return self.size * (self.size - 1) / 2
