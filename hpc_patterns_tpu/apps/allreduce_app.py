@@ -1,0 +1,144 @@
+"""Allreduce miniapp: ring vs library collective on a TPU mesh.
+
+TPU-native rebuild of the reference's three allreduce miniapps
+(allreduce-mpi-sycl.cpp, allreduce-usm/map-mpi-omp-offload.cpp — C5–C7
+in SURVEY.md). Reproduced semantics:
+
+- ``-a`` switches from the hand ring to the library collective
+  (allreduce-mpi-sycl.cpp:122-124 → here ``lax.psum``); additionally
+  ``--algorithm ring_chunked`` selects the bandwidth-optimal two-phase
+  ring the reference's teaching ring approximates.
+- ``-p N`` → 2**N elements per rank, default 25 (:99,125-128).
+- ``-H/-D/-S`` allocator axis → JAX memory kinds (:104-131); host kind
+  falls back to device with a logged note when the backend lacks it.
+- rank-valued init (:33-41), analytic oracle size(size−1)/2 validated
+  elementwise on the host (:192-204), per-rank "Passed r" lines (:206).
+- wall-clock timed region, MAX across processes (:170-190), min over
+  repetitions; compile excluded by warm-up (SURVEY.md §7(d)).
+- dtype axis via ``--dtype`` ≙ the typed CTest variants
+  (mpi-sycl/CMakeLists.txt:4-5, float+int).
+
+Reported: elapsed seconds, algorithm bandwidth, and ring-normalized bus
+bandwidth (the BASELINE.json headline metric).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from hpc_patterns_tpu.harness.timing import blocking
+
+from hpc_patterns_tpu.apps import common
+from hpc_patterns_tpu.dtypes import get_traits
+from hpc_patterns_tpu.harness import RunLog, correctness_verdict, measure
+from hpc_patterns_tpu.harness.cli import (
+    add_memory_kind_args,
+    add_msg_size_args,
+    base_parser,
+)
+from hpc_patterns_tpu.harness.timing import max_across_processes
+
+
+def build_parser():
+    p = base_parser(__doc__.splitlines()[0])
+    add_msg_size_args(p)
+    add_memory_kind_args(p)
+    p.add_argument(
+        "-a",
+        "--allreduce",
+        action="store_true",
+        help="use the library collective (reference -a → MPI_Allreduce)",
+    )
+    p.add_argument(
+        "--algorithm",
+        default=None,
+        choices=["ring", "ring_chunked", "collective"],
+        help="explicit algorithm (overrides -a; default ring, like the reference)",
+    )
+    p.add_argument(
+        "--world",
+        type=int,
+        default=-1,
+        help="ranks (mesh size); -1 = all devices (mpirun -np analog)",
+    )
+    return p
+
+
+def resolve_algorithm(args) -> str:
+    if args.algorithm:
+        return args.algorithm
+    return "collective" if args.allreduce else "ring"
+
+
+def run(args) -> int:
+    log = RunLog(args.log)
+    comm = common.make_communicator(args.backend, args.world, even=True)
+    world = comm.size
+    algorithm = resolve_algorithm(args)
+    n = 1 << args.log2_elements
+    traits = get_traits(args.dtype)
+    if algorithm == "ring_chunked" and n % world:
+        # chunked ring needs size | n; pad up like any real collective would
+        n += world - n % world
+
+    memory_kind = None if args.memory_kind == "device" else args.memory_kind
+    x = comm.rank_filled(n, traits.dtype)
+    step = comm.jit_allreduce(x, algorithm)
+    if memory_kind is not None:
+        # probe by *executing* once: backends can advertise a memory kind
+        # (addressable_memories) yet reject collectives on it
+        try:
+            xh = comm.shard(x, memory_kind)
+            step_h = comm.jit_allreduce(xh, algorithm)
+            import jax
+
+            jax.block_until_ready(step_h(xh))
+            x, step = xh, step_h
+        except Exception as e:  # noqa: BLE001 — any backend rejection falls back
+            log.print(
+                f"note: memory kind {memory_kind!r} unsupported here "
+                f"({type(e).__name__}); using device"
+            )
+            memory_kind = None
+
+    result = measure(
+        blocking(step, x), repetitions=args.repetitions, warmup=args.warmup
+    )
+    elapsed = max_across_processes(result.min_s)
+
+    out = np.asarray(step(x))
+    verdict = correctness_verdict(out, comm.expected_allreduce_value(), dtype=traits.dtype)
+    for r in range(world):
+        if verdict.success:
+            log.print(f"Passed {r}")
+
+    nbytes = n * traits.itemsize
+    busbw = common.allreduce_bus_bandwidth_gbps(nbytes, elapsed, world)
+    log.result(
+        f"allreduce[{algorithm}]",
+        verdict,
+        world=world,
+        elements=n,
+        dtype=traits.dtype.name,
+        bytes_per_rank=nbytes,
+        elapsed_s=elapsed,
+        algbw_gbps=nbytes / elapsed / 1e9 if elapsed > 0 else float("inf"),
+        busbw_gbps=busbw,
+        memory_kind=memory_kind or "device",
+    )
+    log.print(
+        f"{algorithm} world={world} n=2^{args.log2_elements} {traits.dtype.name}: "
+        f"{elapsed * 1e3:.3f} ms, busbw {busbw:.2f} GB/s"
+    )
+    log.print(verdict.summary_line())
+    return verdict.exit_code
+
+
+def main(argv=None) -> int:
+    return run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

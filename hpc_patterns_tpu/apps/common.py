@@ -1,0 +1,60 @@
+"""Shared app scaffolding: device/mesh resolution and reporting units.
+
+The reference duplicates this in every main() (device pick at
+allreduce-mpi-sycl.cpp:135-152, world-size guard at :95-97, reporting at
+:185-206); apps here share one implementation.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from hpc_patterns_tpu import topology
+from hpc_patterns_tpu.comm import Communicator
+
+
+def make_communicator(
+    backend: str | None, world: int, *, even: bool = False, axis: str = "x"
+) -> Communicator:
+    """Build the app's communicator: all (or ``world``) devices of the
+    chosen backend on a 1-D mesh.
+
+    ``world=-1`` (auto) uses every device — the miniapps' mpirun -np
+    choice made explicit. ``even=True`` reproduces the reference's
+    even-rank-count precondition (allreduce-mpi-sycl.cpp:95-97) by
+    dropping the odd device out, rather than failing, because a 1-chip
+    dev box is the common case here.
+    """
+    devices = topology.get_devices(backend)
+    if world == -1:
+        world = len(devices)
+    if world > len(devices):
+        raise topology.TopologyError(
+            f"world {world} > {len(devices)} visible devices"
+        )
+    if even and world % 2 and world > 1:
+        world -= 1
+    mesh = topology.make_mesh({axis: world}, devices[:world])
+    return Communicator(mesh, axis)
+
+
+def allreduce_bus_bandwidth_gbps(nbytes: int, seconds: float, world: int) -> float:
+    """Bus bandwidth for an allreduce: algbw · 2(size−1)/size.
+
+    The standard ring-limit normalization, so numbers are comparable
+    across world sizes — the BASELINE.json "allreduce GB/s" metric.
+    Degenerates to 0 for world=1 (no wire traffic).
+    """
+    if seconds <= 0:
+        return float("inf")
+    return (nbytes / seconds / 1e9) * (2 * (world - 1) / world)
+
+
+def supports_memory_kind(kind: str) -> bool:
+    """Whether the backend exposes the given JAX memory kind (TPU has
+    pinned_host + device; CPU meshes typically only the default)."""
+    try:
+        memories = jax.devices()[0].addressable_memories()
+    except Exception:
+        return False
+    return any(m.kind == kind for m in memories)

@@ -1,0 +1,84 @@
+"""Point-to-point ping-pong: pt2pt latency/bandwidth between mesh pairs.
+
+The BASELINE.json "2-rank device-buffer ping-pong" config, i.e. the
+reference's paired blocking ``MPI_Send/MPI_Recv`` with even/odd ordering
+(allreduce-mpi-sycl.cpp:50-58) run as a standalone benchmark. On TPU the
+pair exchange is one ``lax.ppermute`` with the involution permutation
+r ↔ r^1, riding ICI between mesh neighbors.
+
+Sweeps message sizes ``--min-p .. -p`` (default 3..25, the 8 B–256 MiB
+band of the BASELINE 8B–8GB axis that fits a dev box), reporting per-size
+round-trip latency and per-rank bandwidth. Validation oracle: after two
+exchanges every buffer is back home (ppermute with an involution applied
+twice is the identity).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from hpc_patterns_tpu.apps import common
+from hpc_patterns_tpu.dtypes import get_traits
+from hpc_patterns_tpu.harness import RunLog, Verdict, measure
+from hpc_patterns_tpu.harness.cli import add_msg_size_args, base_parser
+from hpc_patterns_tpu.harness.timing import blocking, max_across_processes
+
+
+def build_parser():
+    p = base_parser(__doc__.splitlines()[0])
+    add_msg_size_args(p)
+    p.add_argument("--min-p", type=int, default=3, help="sweep start: 2**min_p elements")
+    p.add_argument("--world", type=int, default=-1, help="ranks; -1 = all devices")
+    return p
+
+
+def run(args) -> int:
+    log = RunLog(args.log)
+    comm = common.make_communicator(args.backend, args.world, even=True)
+    if comm.size < 2:
+        log.print("SKIP: ping-pong needs >= 2 devices (even ranks, "
+                  "allreduce-mpi-sycl.cpp:95-97)")
+        log.print("SUCCESS")  # precondition skip, not a failure
+        return 0
+    traits = get_traits(args.dtype)
+    all_ok = True
+    for p in range(args.min_p, args.log2_elements + 1):
+        n = 1 << p
+        x = comm.rank_filled(n, traits.dtype)
+        exchange = comm.jit_pingpong(x)
+        result = measure(
+            blocking(exchange, x), repetitions=args.repetitions, warmup=args.warmup
+        )
+        elapsed = max_across_processes(result.min_s)
+        # validation: one hop moves rank r's data to r^1
+        out = np.asarray(exchange(x))
+        expect = np.asarray(x)[[r ^ 1 for r in range(comm.size)]]
+        ok = bool(np.array_equal(out, expect))
+        all_ok &= ok
+        nbytes = n * traits.itemsize
+        log.emit(
+            kind="result",
+            name=f"pingpong[p={p}]",
+            success=ok,
+            elements=n,
+            bytes_per_rank=nbytes,
+            latency_us=elapsed * 1e6,
+            bandwidth_gbps=nbytes / elapsed / 1e9 if elapsed > 0 else float("inf"),
+        )
+        log.print(
+            f"pingpong n=2^{p}: {elapsed * 1e6:.2f} us, "
+            f"{nbytes / elapsed / 1e9:.3f} GB/s {'ok' if ok else 'MISMATCH'}"
+        )
+    verdict = Verdict(success=all_ok, messages=("SUCCESS" if all_ok else "FAILURE",))
+    log.print(verdict.summary_line())
+    return verdict.exit_code
+
+
+def main(argv=None) -> int:
+    return run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,11 +58,19 @@ class Communicator:
     def size(self) -> int:
         return self.mesh.shape[self.axis]
 
-    def row_sharding(self, ndim: int) -> NamedSharding:
-        """Sharding that puts row r on rank r (leading dim over the axis)."""
-        return NamedSharding(self.mesh, P(self.axis, *([None] * (ndim - 1))))
+    def row_sharding(self, ndim: int, memory_kind: str | None = None) -> NamedSharding:
+        """Sharding that puts row r on rank r (leading dim over the axis).
 
-    def shard(self, x) -> jax.Array:
+        ``memory_kind`` maps the reference's USM allocator axis
+        (``-H/-D``, allreduce-mpi-sycl.cpp:104-131) onto JAX memory
+        kinds: ``"pinned_host"`` ≙ host USM, ``"device"``/None ≙ device
+        USM (HBM)."""
+        spec = P(self.axis, *([None] * (ndim - 1)))
+        if memory_kind is None:
+            return NamedSharding(self.mesh, spec)
+        return NamedSharding(self.mesh, spec, memory_kind=memory_kind)
+
+    def shard(self, x, memory_kind: str | None = None) -> jax.Array:
         """Place a (size, ...) array with one row per rank — the analog of
         each rank allocating + initializing its device buffer
         (allreduce-mpi-sycl.cpp:154-164)."""
@@ -71,7 +79,7 @@ class Communicator:
             raise ValueError(
                 f"leading dim {x.shape[0]} != communicator size {self.size}"
             )
-        return jax.device_put(x, self.row_sharding(x.ndim))
+        return jax.device_put(x, self.row_sharding(x.ndim, memory_kind))
 
     def _shmap(self, fn, x, out_specs=None):
         spec = P(self.axis, *([None] * (jnp.ndim(x) - 1)))
@@ -98,7 +106,11 @@ class Communicator:
     def pingpong(self, x) -> jax.Array:
         """Pairwise even/odd exchange: row r swaps with row r^1 — the
         pt2pt ping-pong config of BASELINE.json."""
-        return self._shmap(lambda l: ring.pairwise_exchange(l, self.axis), x)(x)
+        return self.jit_pingpong(x)(x)
+
+    def jit_pingpong(self, x):
+        """Compiled pairwise-exchange closure (for timing loops)."""
+        return self._shmap(lambda l: ring.pairwise_exchange(l, self.axis), x)
 
     def sendrecv_ring(self, x, shift: int = 1) -> jax.Array:
         """One ring hop: row r moves to row (r+shift) % size
