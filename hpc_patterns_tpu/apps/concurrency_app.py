@@ -1,0 +1,169 @@
+"""Concurrency benchmark app — the rebuild of ``sycl_con`` / ``omp_con`` /
+``omp_con_meta`` (C1–C3 in SURVEY.md).
+
+Measures whether independent device commands (compute ``C``, host→device
+``M2D``, device→host ``D2M``) overlap, exactly as the reference does
+(sycl_con.cpp:163-297):
+
+- positional mode + command list CLI (:184-232), with the reference's
+  mode names accepted as aliases (``out_of_order``/``in_order`` →
+  ``async``, ``host_threads`` → ``threads``, plus omp_con's ``nowait``);
+- ``-1`` = autotune sentinels for sizes/tripcount (:179-232), resolved by
+  the C12 autotuner (balance copies :243-255, tripcount :257-268);
+- serial baseline → theoretical max speedup → concurrent run → verdict
+  (:274-296), with both the SYCL speedup rule and the OMP absolute rule
+  (omp_con.cpp:238-244) selectable via ``--rule`` — the one-binary-all-
+  modes role of ``omp_con_meta``'s metadirectives;
+- ``--n-queues`` spreads commands round-robin over devices
+  (``Qs[i % n_queues]``, sycl_con.cpp:58-61,89), the queue-pool analog;
+- ``--enable_profiling`` wraps the concurrent run in a ``jax.profiler``
+  trace (run.sh:10-12's overhead re-check, now with real artifacts).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from hpc_patterns_tpu import topology
+from hpc_patterns_tpu.concurrency import autotune, commands as cmds, engine
+from hpc_patterns_tpu.harness import RunLog, concurrency_verdict
+from hpc_patterns_tpu.harness.cli import AUTO, base_parser
+from hpc_patterns_tpu.harness.profiling import maybe_trace
+
+DEFAULT_COPY_ELEMENTS = 1 << 22  # 16 MiB float32; ref default is
+# max_mem_alloc_size (sycl_con.cpp:168-172), far past useful on TPU hosts
+
+
+def build_parser():
+    p = base_parser(__doc__.splitlines()[0])
+    p.add_argument(
+        "mode",
+        nargs="?",
+        default="async",
+        help="dispatch mode: serial | async | threads "
+        "(aliases: out_of_order, in_order, nowait, host_threads)",
+    )
+    p.add_argument(
+        "commands",
+        nargs="*",
+        default=["C", "M2D"],
+        help="command list, e.g. C M2D (default) — sycl_con.cpp positional list",
+    )
+    p.add_argument("--tripcount", type=int, default=AUTO,
+                   help="compute trips; -1 = autotune to mean copy time")
+    p.add_argument("--copy-elements", type=int, default=AUTO,
+                   help="copy size in float32 elements; -1 = default + balance")
+    p.add_argument("--compute-elements", type=int, default=8 * 128,
+                   help="compute buffer elements (one VPU tile by default)")
+    p.add_argument("--n-queues", type=int, default=1,
+                   help="devices to round-robin commands over (queue pool analog)")
+    p.add_argument("--rule", default="sycl", choices=["sycl", "omp"],
+                   help="verdict rule: sycl speedup (sycl_con) or omp absolute (omp_con)")
+    p.add_argument("--enable_profiling", action="store_true",
+                   help="jax.profiler trace around the concurrent run")
+    p.add_argument("--trace-dir", default=None, help="profiler output dir")
+    return p
+
+
+def build_commands(args, devices) -> tuple[list[cmds.Command], dict]:
+    kinds = [k.upper() for k in args.commands]
+    for k in kinds:
+        if k not in ("C", "M2D", "D2M"):
+            raise SystemExit(f"unknown command {k!r} (want C, M2D, or D2M)")
+
+    m2d_elems = d2m_elems = (
+        DEFAULT_COPY_ELEMENTS if args.copy_elements == AUTO else args.copy_elements
+    )
+    tune_info = {}
+    if args.copy_elements == AUTO and "M2D" in kinds and "D2M" in kinds:
+        m2d_elems, d2m_elems, info = autotune.balance_copy_sizes(
+            m2d_elems, d2m_elems, devices[0]
+        )
+        tune_info["balance"] = info
+
+    tripcount = args.tripcount
+    if tripcount == AUTO and "C" in kinds:
+        copy_cmds = []
+        if "M2D" in kinds:
+            copy_cmds.append(cmds.CopyM2DCommand(m2d_elems, devices[0]))
+        if "D2M" in kinds:
+            copy_cmds.append(cmds.CopyD2MCommand(d2m_elems, devices[0]))
+        if copy_cmds:
+            target = sum(autotune._time_command(c) for c in copy_cmds) / len(copy_cmds)
+            tripcount, info = autotune.tune_tripcount(
+                max(target, 1e-4),
+                compute_elements=args.compute_elements,
+                device=devices[0],
+            )
+            tune_info["tripcount"] = info
+        else:
+            tripcount = 1000
+    elif tripcount == AUTO:
+        tripcount = 1000
+
+    built = []
+    for i, k in enumerate(kinds):
+        dev = devices[i % max(1, args.n_queues) % len(devices)]
+        if k == "C":
+            built.append(cmds.ComputeCommand(args.compute_elements, tripcount, dev))
+        elif k == "M2D":
+            built.append(cmds.CopyM2DCommand(m2d_elems, dev))
+        else:
+            built.append(cmds.CopyD2MCommand(d2m_elems, dev))
+    return built, tune_info
+
+
+def run(args) -> int:
+    log = RunLog(args.log)
+    mode = engine.canonical_mode(args.mode)
+    devices = topology.get_devices(args.backend)
+    command_list, tune_info = build_commands(args, devices)
+    names = [c.name for c in command_list]
+    for key, info in tune_info.items():
+        log.emit(kind="autotune", which=key, **info)
+        log.print(f"autotune[{key}]: {info}")
+
+    serial = engine.bench(
+        "serial", command_list, repetitions=args.repetitions, warmup=args.warmup
+    )
+    per_times = [t.min_s for t in serial.per_command]
+    for name, t in zip(names, per_times):
+        log.print(f"serial {name}: {t * 1e3:.3f} ms")
+    log.print(f"best serial total: {serial.best_serial_total_s * 1e3:.3f} ms")
+
+    if mode == "serial":
+        log.emit(kind="result", name="concurrency[serial]", success=True,
+                 commands=names, per_command_ms=[t * 1e3 for t in per_times])
+        log.print("SUCCESS")
+        return 0
+
+    with maybe_trace(args.enable_profiling, args.trace_dir) as trace_dir:
+        concurrent = engine.bench(
+            mode, command_list, repetitions=args.repetitions, warmup=args.warmup
+        )
+    if trace_dir:
+        log.print(f"profiler trace: {trace_dir}")
+
+    verdict = concurrency_verdict(
+        per_times, concurrent.total.min_s, rule=args.rule
+    )
+    log.result(
+        f"concurrency[{mode}:{'+'.join(names)}]",
+        verdict,
+        commands=names,
+        mode=mode,
+        rule=args.rule,
+        serial_total_ms=serial.best_serial_total_s * 1e3,
+        concurrent_total_ms=concurrent.total.min_s * 1e3,
+        per_command_ms=[t * 1e3 for t in per_times],
+        trace_dir=trace_dir,
+    )
+    return verdict.exit_code
+
+
+def main(argv=None) -> int:
+    return run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

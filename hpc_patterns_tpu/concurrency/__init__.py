@@ -1,0 +1,36 @@
+"""Concurrency suite — TPU rebuild of the reference's ``concurency/``
+(C1–C4 + C12 in SURVEY.md §2.1).
+
+The reference measures whether N independent device commands — compute
+kernels (``C``), host→device copies (``M2D``), device→host copies
+(``D2M``) — actually overlap on one GPU, comparing an out-of-order queue
+and an in-order queue pool against serial execution
+(sycl_con.cpp:35-131), plus OpenMP ``nowait`` tasks and host-thread
+fan-out (omp_con.cpp:64-125).
+
+TPU mapping (SURVEY.md §7 step 3):
+
+- ``C``   → a Pallas busy-wait FMA kernel (:mod:`~.kernels`, ≙
+  ``busy_wait``, sycl_con.cpp:26-33)
+- ``M2D`` → host→HBM transfer; ``D2M`` → HBM→host transfer
+  (:mod:`~.commands`), via JAX memory-kind jits on TPU or
+  ``device_put``/``copy_to_host_async`` elsewhere
+- out-of-order queue / ``nowait`` → JAX **async dispatch**: submits
+  return immediately, the runtime overlaps DMA with compute
+- in-order queue pool → round-robin over multiple devices
+- ``host_threads`` → a thread per command (:func:`~.engine.bench`)
+
+The verdict rules and timing protocol are the shared harness
+(:mod:`hpc_patterns_tpu.harness`); the autotuner (C12) lives in
+:mod:`~.autotune`.
+"""
+
+from hpc_patterns_tpu.concurrency.commands import (  # noqa: F401
+    Command,
+    ComputeCommand,
+    CopyD2MCommand,
+    CopyM2DCommand,
+    make_command,
+)
+from hpc_patterns_tpu.concurrency.engine import MODES, BenchResult, bench  # noqa: F401
+from hpc_patterns_tpu.concurrency.kernels import busy_wait  # noqa: F401

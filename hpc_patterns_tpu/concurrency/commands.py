@@ -1,0 +1,215 @@
+"""Device commands: the units of work whose overlap is measured.
+
+The reference's three command kinds (sycl_con.cpp:84-99):
+
+- ``C``   — compute kernel (``Q.parallel_for`` of ``busy_wait``)
+- ``M2D`` — host→device copy (``Q.copy(host, dev)``)
+- ``D2M`` — device→host copy (``Q.copy(dev, host)``)
+
+Each command here has MPI-queue-like async semantics: :meth:`submit`
+enqueues the work and returns immediately (JAX async dispatch ≙ an
+out-of-order queue submit), :meth:`block` waits for completion (≙
+``Q.wait()``). A command owns its buffers, like each reference command
+owning its USM allocation (sycl_con.cpp:64-73), so independent commands
+share no data dependencies and the runtime is free to overlap them.
+
+Transfers use the TPU-native path when the backend exposes memory kinds
+(a jitted identity with ``pinned_host``/``device`` output sharding — an
+XLA transfer op on the DMA engine) and fall back to
+``device_put`` / ``copy_to_host_async`` elsewhere, so the same suite runs
+on the CPU test mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from hpc_patterns_tpu.concurrency import kernels
+
+
+def _kind_sharding(device, kind: str):
+    return jax.sharding.SingleDeviceSharding(device, memory_kind=kind)
+
+
+_MEMORY_KIND_PROBE: dict[str, bool] = {}
+
+
+def _memory_kind_transfers_work(device) -> bool:
+    """Whether host↔device memory-kind transfers actually *execute* on
+    this backend. Backends can advertise ``pinned_host`` in
+    ``addressable_memories`` yet reject placement at runtime (CPU does),
+    so probe by running one tiny round-trip, memoized per platform."""
+    key = device.platform
+    if key not in _MEMORY_KIND_PROBE:
+        try:
+            kinds = {m.kind for m in device.addressable_memories()}
+            if "pinned_host" not in kinds:
+                raise ValueError("no pinned_host memory")
+            tiny = jax.device_put(
+                jnp.zeros((8,), jnp.float32), _kind_sharding(device, "pinned_host")
+            )
+            moved = jax.jit(
+                lambda x: x, out_shardings=_kind_sharding(device, "device")
+            )(tiny)
+            jax.block_until_ready(moved)
+            _MEMORY_KIND_PROBE[key] = True
+        except Exception:
+            _MEMORY_KIND_PROBE[key] = False
+    return _MEMORY_KIND_PROBE[key]
+
+
+class Command:
+    """Base: one unit of asynchronously-submittable device work."""
+
+    name = "?"
+
+    def submit(self) -> None:
+        raise NotImplementedError
+
+    def block(self) -> None:
+        raise NotImplementedError
+
+    def run_blocking(self) -> None:
+        self.submit()
+        self.block()
+
+    @property
+    def nbytes(self) -> int:
+        return 0
+
+
+class ComputeCommand(Command):
+    """``C``: the busy-wait FMA chain on a device buffer
+    (sycl_con.cpp:92-95). ``tripcount`` is mutable so the autotuner can
+    re-balance a built command (C12)."""
+
+    name = "C"
+
+    def __init__(self, n_elements: int = 8 * 128, tripcount: int = 1000, device=None):
+        self.device = device if device is not None else jax.devices()[0]
+        self.x = kernels.compute_buffer(n_elements, self.device)
+        self.tripcount = int(tripcount)
+        self._pending = None
+
+    def submit(self) -> None:
+        self._pending = kernels.busy_wait(self.x, self.tripcount)
+
+    def block(self) -> None:
+        if self._pending is not None:
+            jax.block_until_ready(self._pending)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.x.size) * 4
+
+
+class CopyM2DCommand(Command):
+    """``M2D``: host memory → device HBM (sycl_con.cpp:96-99 with a host
+    source; ``omp target update to``)."""
+
+    name = "M2D"
+
+    def __init__(self, n_elements: int, device=None, dtype=jnp.float32):
+        self.device = device if device is not None else jax.devices()[0]
+        self.n_elements = int(n_elements)
+        self._pending = None
+        if _memory_kind_transfers_work(self.device):
+            # TPU path: source lives in pinned host memory; the transfer
+            # is a jitted XLA op targeting the device memory kind.
+            src = jax.device_put(
+                jnp.zeros((self.n_elements,), dtype),
+                _kind_sharding(self.device, "pinned_host"),
+            )
+            self._src = jax.block_until_ready(src)
+            self._move = jax.jit(
+                lambda x: x, out_shardings=_kind_sharding(self.device, "device")
+            )
+            self._submit = lambda: self._move(self._src)
+        else:
+            self._host = np.zeros((self.n_elements,), dtype)
+            self._submit = lambda: jax.device_put(self._host, self.device)
+
+    def submit(self) -> None:
+        self._pending = self._submit()
+
+    def block(self) -> None:
+        if self._pending is not None:
+            jax.block_until_ready(self._pending)
+
+    @property
+    def nbytes(self) -> int:
+        return self.n_elements * 4
+
+
+class CopyD2MCommand(Command):
+    """``D2M``: device HBM → host memory (sycl_con.cpp:96-99 with a host
+    destination; ``omp target update from``)."""
+
+    name = "D2M"
+
+    def __init__(self, n_elements: int, device=None, dtype=jnp.float32):
+        self.device = device if device is not None else jax.devices()[0]
+        self.n_elements = int(n_elements)
+        self._pending = None
+        self._dev = jax.block_until_ready(
+            jax.device_put(jnp.zeros((self.n_elements,), dtype), self.device)
+        )
+        if _memory_kind_transfers_work(self.device):
+            self._move = jax.jit(
+                lambda x: x, out_shardings=_kind_sharding(self.device, "pinned_host")
+            )
+            self._mode = "memory_kind"
+        else:
+            # Fallback: produce a *fresh* device array each submit (a
+            # cached jax.Array host copy would make the 2nd repetition a
+            # no-op), then start its host transfer.
+            self._fresh = jax.jit(lambda x: x + 0)
+            self._mode = "host_async"
+
+    def submit(self) -> None:
+        if self._mode == "memory_kind":
+            self._pending = self._move(self._dev)
+        else:
+            y = self._fresh(self._dev)
+            y.copy_to_host_async()
+            self._pending = y
+
+    def block(self) -> None:
+        if self._pending is None:
+            return
+        if self._mode == "memory_kind":
+            jax.block_until_ready(self._pending)
+        else:
+            np.asarray(self._pending)
+
+    @property
+    def nbytes(self) -> int:
+        return self.n_elements * 4
+
+
+_KINDS = {
+    "C": ComputeCommand,
+    "M2D": CopyM2DCommand,
+    "D2M": CopyD2MCommand,
+}
+
+
+def make_command(
+    kind: str,
+    *,
+    device=None,
+    copy_elements: int = 1 << 20,
+    compute_elements: int = 8 * 128,
+    tripcount: int = 1000,
+) -> Command:
+    """Build a command from its reference CLI name (the positional command
+    list of sycl_con.cpp:184-232)."""
+    kind = kind.upper()
+    if kind == "C":
+        return ComputeCommand(compute_elements, tripcount, device)
+    if kind in ("M2D", "D2M"):
+        return _KINDS[kind](copy_elements, device)
+    raise ValueError(f"unknown command {kind!r}; expected one of {sorted(_KINDS)}")

@@ -1,0 +1,132 @@
+"""The concurrency bench engine: run N commands under a dispatch mode,
+min-of-repetitions (sycl_con.cpp:84-115 / omp_con.cpp:62-125).
+
+Modes (reference → here):
+
+- ``serial``       — submit+wait each command in turn, recording
+  per-command times (the baseline, sycl_con.cpp:101-106)
+- ``async``        — submit all, then wait all: JAX async dispatch plays
+  the out-of-order queue / OpenMP ``nowait`` role
+  (sycl_con.cpp:108-114, omp_con.cpp:76-99). Aliases: ``out_of_order``,
+  ``in_order`` (a pool of in-order queues is still concurrent *across*
+  queues), ``nowait``.
+- ``threads``      — one host thread per command, each submit+wait:
+  the OpenMP ``host_threads`` strategy (omp_con.cpp:67-73).
+
+Returns per-mode totals and, for serial, per-command
+:class:`~hpc_patterns_tpu.harness.timing.TimingResult`\\ s — exactly the
+inputs the verdict engine needs (harness.verdict.concurrency_verdict).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Sequence
+
+from hpc_patterns_tpu.concurrency.commands import Command
+from hpc_patterns_tpu.harness.timing import TimingResult
+
+ALIASES = {
+    "out_of_order": "async",
+    "in_order": "async",
+    "nowait": "async",
+    "host_threads": "threads",
+}
+MODES = ("serial", "async", "threads")
+
+
+def canonical_mode(mode: str) -> str:
+    mode = ALIASES.get(mode, mode)
+    if mode not in MODES:
+        raise ValueError(
+            f"unknown mode {mode!r}; expected {MODES} or aliases {sorted(ALIASES)}"
+        )
+    return mode
+
+
+@dataclasses.dataclass(frozen=True)
+class BenchResult:
+    mode: str
+    total: TimingResult
+    per_command: tuple[TimingResult, ...] | None  # serial mode only
+
+    @property
+    def best_serial_total_s(self) -> float:
+        """Sum of per-command minima — the reference's "best theoretical
+        serial" baseline (sycl_con.cpp:117-119)."""
+        if self.per_command is None:
+            raise ValueError("per-command times only exist in serial mode")
+        return sum(t.min_s for t in self.per_command)
+
+
+def _run_serial(commands: Sequence[Command]) -> tuple[float, list[float]]:
+    per = []
+    t_all = time.perf_counter()
+    for cmd in commands:
+        t0 = time.perf_counter()
+        cmd.run_blocking()
+        per.append(time.perf_counter() - t0)
+    return time.perf_counter() - t_all, per
+
+
+def _run_async(commands: Sequence[Command]) -> float:
+    t0 = time.perf_counter()
+    for cmd in commands:
+        cmd.submit()
+    for cmd in commands:
+        cmd.block()
+    return time.perf_counter() - t0
+
+
+def _run_threads(commands: Sequence[Command], pool: ThreadPoolExecutor) -> float:
+    t0 = time.perf_counter()
+    futures = [pool.submit(cmd.run_blocking) for cmd in commands]
+    for f in futures:
+        f.result()
+    return time.perf_counter() - t0
+
+
+def bench(
+    mode: str,
+    commands: Sequence[Command],
+    *,
+    repetitions: int = 10,
+    warmup: int = 2,
+) -> BenchResult:
+    """Time ``commands`` under ``mode``: ``warmup`` untimed runs (absorbing
+    XLA compiles — SURVEY.md §7 hard part (d)), then min over
+    ``repetitions`` (sycl_con.cpp:114, default 10 at :182)."""
+    mode = canonical_mode(mode)
+    if not commands:
+        raise ValueError("need at least one command")
+    pool = ThreadPoolExecutor(max_workers=len(commands)) if mode == "threads" else None
+    try:
+        totals: list[float] = []
+        per: list[list[float]] = [[] for _ in commands]
+        for rep in range(warmup + repetitions):
+            if mode == "serial":
+                total, per_cmd = _run_serial(commands)
+            elif mode == "async":
+                total, per_cmd = _run_async(commands), None
+            else:
+                total, per_cmd = _run_threads(commands, pool), None
+            if rep < warmup:
+                continue
+            totals.append(total)
+            if per_cmd is not None:
+                for i, t in enumerate(per_cmd):
+                    per[i].append(t)
+        return BenchResult(
+            mode=mode,
+            total=TimingResult(tuple(totals)),
+            per_command=(
+                tuple(TimingResult(tuple(ts)) for ts in per)
+                if mode == "serial"
+                else None
+            ),
+        )
+    finally:
+        if pool is not None:
+            pool.shutdown()

@@ -1,0 +1,32 @@
+"""Profiler hookup behind ``--enable_profiling`` (SURVEY.md §5).
+
+The reference accepts ``--enable_profiling`` and sets the SYCL queue
+profiling property, but never reads the per-event data — the flag only
+checks that overlap survives profiling overhead (sycl_con.cpp:47-52,
+run.sh:10-12). The TPU build keeps the flag and its overhead-check role,
+and *actually produces artifacts*: a ``jax.profiler`` trace directory
+(TensorBoard/XProf-loadable) per run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import tempfile
+
+import jax
+
+
+@contextlib.contextmanager
+def maybe_trace(enabled: bool, logdir: str | None = None):
+    """Trace the enclosed region with ``jax.profiler`` when ``enabled``.
+
+    Yields the trace directory (or None when disabled), so callers can
+    surface it in the run log — the upgrade over the reference's
+    write-only property.
+    """
+    if not enabled:
+        yield None
+        return
+    logdir = logdir or tempfile.mkdtemp(prefix="hpcpat_trace_")
+    with jax.profiler.trace(logdir):
+        yield logdir
