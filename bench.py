@@ -1,0 +1,95 @@
+"""Headline benchmark: on-chip DMA/compute overlap speedup.
+
+The reference's headline claim is concurrent-kernel/copy overlap on one
+device (concurency/sycl_con.cpp; BASELINE.json "concurrent-kernel overlap
+%"). The TPU-native equivalent measured here: a Pallas double-buffered
+HBM→VMEM pipeline (compute on chunk i while chunk i+1's DMA flies) vs the
+serialized wait-then-compute walk of the same work
+(hpc_patterns_tpu/concurrency/pipeline.py).
+
+Protocol (all on-device, honest through high-latency dispatch paths):
+- per-pass times via completion-forced differencing
+  (harness.timing.amortized_seconds) — dispatch/readback latency cancels;
+- C12-style autotune: tripcount set so compute/pass ≈ DMA/pass
+  (sycl_con.cpp:257-268's balance step);
+- verdict per the reference rule: PASS iff speedup > theoretical/1.3
+  (sycl_con.cpp:279-296).
+
+Prints ONE JSON line:
+  {"metric": "onchip_overlap_speedup", "value": <speedup>, "unit": "x",
+   "vs_baseline": <speedup / (theoretical_max / 1.3)>}
+vs_baseline >= 1.0 means the overlap beats the reference's own PASS bar.
+"""
+
+import json
+import sys
+
+import jax
+
+from hpc_patterns_tpu.concurrency import pipeline
+from hpc_patterns_tpu.harness.timing import amortized_seconds
+
+NUM_CHUNKS = 64
+CHUNK_ROWS = 512  # 64 x (512,128) f32 = 16 MiB working set
+PROBE_TRIPS = 8
+
+
+def per_pass_seconds(x, mode, tripcount, iters, repetitions=3):
+    run = lambda p: pipeline.overlap_run(x, mode=mode, tripcount=tripcount, passes=p)
+    return amortized_seconds(run, iters=iters, repetitions=repetitions)
+
+
+def main() -> int:
+    on_tpu = jax.default_backend() == "tpu"
+    # CPU fallback (no real DMA engine): tiny shapes through the
+    # interpreter so the protocol still runs end-to-end.
+    num_chunks, chunk_rows = (NUM_CHUNKS, CHUNK_ROWS) if on_tpu else (4, 8)
+    iters_fast, iters_slow = (4000, 2000) if on_tpu else (4, 3)
+
+    x = jax.block_until_ready(pipeline.make_hbm_array(num_chunks, chunk_rows))
+
+    t_dma = per_pass_seconds(x, "dma", PROBE_TRIPS, iters_fast)
+    t_comp_probe = per_pass_seconds(x, "compute", PROBE_TRIPS, iters_fast)
+    # balance compute to DMA (linear in tripcount), C12-style
+    trips = max(1, int(PROBE_TRIPS * t_dma / max(t_comp_probe, 1e-9)))
+    trips = min(trips, 1 << 16)
+    t_comp = per_pass_seconds(x, "compute", trips, iters_slow)
+
+    t_serial = per_pass_seconds(x, "serial", trips, iters_slow)
+    t_overlap = per_pass_seconds(x, "overlap", trips, iters_slow)
+
+    degenerate = t_overlap <= 0 or t_serial <= 0  # below timer resolution
+    if degenerate:
+        # report "measured nothing", never a pass
+        speedup, theoretical, vs_baseline = 0.0, 0.0, 0.0
+    else:
+        speedup = t_serial / t_overlap
+        theoretical = (t_dma + t_comp) / max(t_dma, t_comp, 1e-12)
+        vs_baseline = speedup / (theoretical / 1.3) if theoretical > 0 else 0.0
+    nbytes = x.size * 4
+    print(
+        json.dumps(
+            {
+                "metric": "onchip_overlap_speedup",
+                "value": round(speedup, 4),
+                "unit": "x",
+                "vs_baseline": round(vs_baseline, 4),
+                "detail": {
+                    "t_dma_us": round(t_dma * 1e6, 2),
+                    "t_compute_us": round(t_comp * 1e6, 2),
+                    "t_serial_us": round(t_serial * 1e6, 2),
+                    "t_overlap_us": round(t_overlap * 1e6, 2),
+                    "dma_gbps": round(nbytes / t_dma / 1e9, 1) if t_dma > 0 else None,
+                    "theoretical_max_speedup": round(theoretical, 4),
+                    "tripcount": trips,
+                    "degenerate": degenerate,
+                    "backend": jax.default_backend(),
+                },
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

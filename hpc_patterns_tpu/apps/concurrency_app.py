@@ -89,9 +89,8 @@ def build_commands(args, devices) -> tuple[list[cmds.Command], dict]:
         if "D2M" in kinds:
             copy_cmds.append(cmds.CopyD2MCommand(d2m_elems, devices[0]))
         if copy_cmds:
-            target = sum(autotune._time_command(c) for c in copy_cmds) / len(copy_cmds)
-            tripcount, info = autotune.tune_tripcount(
-                max(target, 1e-4),
+            tripcount, info = autotune.tune_tripcount_to_copies(
+                copy_cmds,
                 compute_elements=args.compute_elements,
                 device=devices[0],
             )

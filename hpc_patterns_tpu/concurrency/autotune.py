@@ -57,6 +57,27 @@ def balance_copy_sizes(
     return m2d_elements, d2m_elements, info
 
 
+def tune_tripcount_to_copies(
+    copy_commands,
+    *,
+    compute_elements: int = 8 * 128,
+    device=None,
+    min_target_s: float = 1e-4,
+) -> tuple[int, dict]:
+    """The full C12 compute-balance step: probe each copy command, target
+    the *mean* copy time (sycl_con.cpp:257-268 targets the copy-time
+    mean), and tune the tripcount to it. Keeps the whole policy —
+    probing protocol included — in this module."""
+    if not copy_commands:
+        raise ValueError("need at least one copy command to balance against")
+    target = sum(_time_command(c) for c in copy_commands) / len(copy_commands)
+    return tune_tripcount(
+        max(target, min_target_s),
+        compute_elements=compute_elements,
+        device=device,
+    )
+
+
 def tune_tripcount(
     target_s: float,
     *,

@@ -34,6 +34,23 @@ def _kind_sharding(device, kind: str):
     return jax.sharding.SingleDeviceSharding(device, memory_kind=kind)
 
 
+_fresh_copy = jax.jit(lambda x: x + 0)  # shared across D2M instances
+
+_MOVE_CACHE: dict[tuple, object] = {}
+
+
+def _move_to_kind(device, kind: str):
+    """Cached jitted transfer program targeting ``kind`` on ``device`` —
+    every copy command of the same direction shares one compile (the
+    autotuner alone builds several probe commands per run)."""
+    key = (device, kind)
+    if key not in _MOVE_CACHE:
+        _MOVE_CACHE[key] = jax.jit(
+            lambda x: x, out_shardings=_kind_sharding(device, kind)
+        )
+    return _MOVE_CACHE[key]
+
+
 _MEMORY_KIND_PROBE: dict[str, bool] = {}
 
 
@@ -124,9 +141,7 @@ class CopyM2DCommand(Command):
                 _kind_sharding(self.device, "pinned_host"),
             )
             self._src = jax.block_until_ready(src)
-            self._move = jax.jit(
-                lambda x: x, out_shardings=_kind_sharding(self.device, "device")
-            )
+            self._move = _move_to_kind(self.device, "device")
             self._submit = lambda: self._move(self._src)
         else:
             self._host = np.zeros((self.n_elements,), dtype)
@@ -158,15 +173,13 @@ class CopyD2MCommand(Command):
             jax.device_put(jnp.zeros((self.n_elements,), dtype), self.device)
         )
         if _memory_kind_transfers_work(self.device):
-            self._move = jax.jit(
-                lambda x: x, out_shardings=_kind_sharding(self.device, "pinned_host")
-            )
+            self._move = _move_to_kind(self.device, "pinned_host")
             self._mode = "memory_kind"
         else:
             # Fallback: produce a *fresh* device array each submit (a
             # cached jax.Array host copy would make the 2nd repetition a
             # no-op), then start its host transfer.
-            self._fresh = jax.jit(lambda x: x + 0)
+            self._fresh = _fresh_copy
             self._mode = "host_async"
 
     def submit(self) -> None:

@@ -101,6 +101,8 @@ def bench(
     mode = canonical_mode(mode)
     if not commands:
         raise ValueError("need at least one command")
+    if repetitions < 1:
+        raise ValueError("repetitions must be >= 1")
     pool = ThreadPoolExecutor(max_workers=len(commands)) if mode == "threads" else None
     try:
         totals: list[float] = []

@@ -1,0 +1,156 @@
+"""On-chip DMA/compute overlap benchmark (the Pallas heart of C1).
+
+The reference's concurrency suite asks: do independent copy and compute
+commands *actually overlap* on one device (sycl_con.cpp:84-115)? On TPU
+the equivalent boundary is HBM↔VMEM DMA vs VPU compute inside a kernel
+(SURVEY.md §2.2 "intra-device stream parallelism": Pallas double-buffered
+DMA/compute overlap stands in for H2D/D2H-vs-kernel overlap), and —
+unlike host wall-clock games — it is measurable honestly even through a
+high-latency dispatch path, because the whole experiment is ONE kernel.
+
+Four variants of the same chunk-walk over an HBM-resident array, all
+computing the identical checksum (the correctness oracle):
+
+- ``overlap``  — double-buffered: DMA of chunk i+1 in flight while the
+  busy-wait chain runs on chunk i (the out-of-order-queue analog)
+- ``serial``   — single-buffered: DMA chunk i, wait, compute chunk i
+  (the reference's serial baseline, sycl_con.cpp:101-106)
+- ``dma``      — DMAs only (per-command baseline for M2D/D2M)
+- ``compute``  — busy-wait only (per-command baseline for C)
+
+``tripcount`` (compute per chunk) and ``passes`` (repetitions over the
+whole array, amortizing fixed overheads inside the kernel) are runtime
+SMEM scalars, so the C12 autotuner balances DMA vs compute without
+recompiles. Speedup/verdict math reuses the shared rules
+(harness.verdict.concurrency_verdict).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hpc_patterns_tpu.concurrency.kernels import FMA_UNROLL
+
+MODES = ("overlap", "serial", "dma", "compute")
+
+
+def _chain(acc, trips, salt):
+    # ``salt`` (pass-index-derived) keeps every pass's chain distinct so
+    # the compiler cannot hoist the loop body out of the pass loop.
+    add = jnp.float32(0.5) + salt
+
+    def body(_, a):
+        for _ in range(FMA_UNROLL):
+            a = a * jnp.float32(0.9999999) + add
+        return a
+
+    return lax.fori_loop(0, trips, body, acc)
+
+
+def _make_kernel(mode: str, num_chunks: int):
+    do_dma = mode in ("overlap", "serial", "dma")
+    do_compute = mode in ("overlap", "serial", "compute")
+
+    def kernel(scalar_ref, hbm_ref, out_ref):
+        trips = scalar_ref[0]
+        passes = scalar_ref[1]
+
+        def body(scratch, sem):
+            def get_dma(slot, chunk):
+                return pltpu.make_async_copy(
+                    hbm_ref.at[chunk], scratch.at[slot], sem.at[slot]
+                )
+
+            def one_pass(p, _):
+                if mode == "overlap":
+                    # warm-up DMA for this pass's first chunk
+                    get_dma(0, 0).start()
+
+                def chunk_step(i, _):
+                    slot = lax.rem(i, 2)
+                    if mode == "overlap":
+
+                        @pl.when(i + 1 < num_chunks)
+                        def _():
+                            get_dma(1 - slot, i + 1).start()
+
+                        get_dma(slot, i).wait()
+                    elif do_dma:
+                        dma = get_dma(slot, i)
+                        dma.start()
+                        dma.wait()
+                    if do_compute:
+                        salt = (p * num_chunks + i).astype(jnp.float32) * jnp.float32(1e-7)
+                        acc = _chain(scratch[slot], trips, salt)
+                        out_ref[:] = acc[:8]
+                    return 0
+
+                lax.fori_loop(0, num_chunks, chunk_step, 0)
+                return 0
+
+            lax.fori_loop(0, passes, one_pass, 0)
+
+        chunk_shape = hbm_ref.shape[1:]
+        pl.run_scoped(
+            body,
+            scratch=pltpu.VMEM((2, *chunk_shape), jnp.float32),
+            sem=pltpu.SemaphoreType.DMA((2,)),
+        )
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("mode", "interpret"))
+def _run(hbm_array, tripcount, passes, *, mode: str, interpret: bool):
+    num_chunks = hbm_array.shape[0]
+    scalars = jnp.asarray([tripcount, passes], jnp.int32)
+    return pl.pallas_call(
+        _make_kernel(mode, num_chunks),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # stays in HBM; DMA'd manually
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(scalars, hbm_array)
+
+
+def overlap_run(
+    hbm_array,
+    *,
+    mode: str,
+    tripcount: int = 64,
+    passes: int = 1,
+    interpret: bool | None = None,
+):
+    """Run one variant over ``hbm_array`` of shape (num_chunks, rows, 128)
+    float32; returns the (8, 128) checksum tile (identical across modes
+    that compute — the oracle for tests)."""
+    if mode not in MODES:
+        raise ValueError(f"mode {mode!r} not in {MODES}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if hbm_array.ndim != 3 or hbm_array.shape[2] != 128 or hbm_array.shape[1] % 8:
+        raise ValueError(
+            f"want (num_chunks, 8k rows, 128) float32, got {hbm_array.shape}"
+        )
+    return _run(
+        hbm_array, jnp.int32(tripcount), jnp.int32(passes),
+        mode=mode, interpret=interpret,
+    )
+
+
+def make_hbm_array(num_chunks: int = 64, chunk_rows: int = 512, seed: int = 0):
+    """The HBM working set: (num_chunks, chunk_rows, 128) float32. Values
+    in [0, 1) so the busy-wait chain stays bounded."""
+    key = jax.random.PRNGKey(seed)
+    return jax.random.uniform(
+        key, (num_chunks, chunk_rows, 128), jnp.float32
+    )

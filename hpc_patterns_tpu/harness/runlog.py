@@ -18,14 +18,22 @@ from typing import IO, Any
 
 
 class RunLog:
-    def __init__(self, path: str | Path | None = None, stream: IO[str] | None = None):
+    def __init__(
+        self,
+        path: str | Path | None = None,
+        stream: IO[str] | None = None,
+        *,
+        truncate: bool = False,
+    ):
         self.path = Path(path) if path else None
         self.stream = stream if stream is not None else sys.stdout
         self.records: list[dict[str, Any]] = []
         if self.path:
             self.path.parent.mkdir(parents=True, exist_ok=True)
-            # truncate: one log per run, like run.sh's tee
-            self.path.write_text("")
+            if truncate:
+                # one log per harness run, like run.sh's tee; apps invoked
+                # *by* a harness append to the harness's log instead
+                self.path.write_text("")
 
     def emit(self, **record: Any) -> dict[str, Any]:
         record.setdefault("ts", time.time())

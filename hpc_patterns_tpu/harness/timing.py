@@ -99,6 +99,57 @@ def blocking(fn: Callable[..., object], *args, **kwargs) -> Callable[[], object]
     return thunk
 
 
+def measure_forced(
+    fn: Callable[[], object],
+    *,
+    repetitions: int = 5,
+    warmup: int = 1,
+) -> TimingResult:
+    """Like :func:`measure`, but forces completion by reading the result
+    back to the host (``np.asarray``).
+
+    Needed on dispatch paths where ``block_until_ready`` resolves before
+    device work truly finishes (observed through tunneled PJRT backends):
+    a host readback is the only airtight completion barrier. ``fn`` must
+    return the array whose value depends on all timed work.
+    """
+    import numpy as np
+
+    def forced():
+        np.asarray(fn())
+
+    return measure(forced, repetitions=repetitions, warmup=warmup)
+
+
+def amortized_seconds(
+    run_with_iters: Callable[[int], object],
+    *,
+    iters: int = 64,
+    repetitions: int = 5,
+    warmup: int = 1,
+) -> float:
+    """Per-iteration device time via differencing: run the workload with
+    ``iters`` internal repetitions and with 1, both completion-forced, and
+    return ``(t_iters - t_1) / (iters - 1)``.
+
+    This cancels dispatch/readback latency (~100 ms through tunneled
+    backends) and any per-call constant, leaving pure steady-state device
+    time — the TPU-honest version of the reference's min-of-reps protocol
+    for environments where wall-clocking a single dispatch is meaningless.
+    ``run_with_iters(n)`` must return an array depending on all n
+    iterations (e.g. a Pallas kernel looping n passes internally).
+    """
+    if iters < 2:
+        raise ValueError("iters must be >= 2")
+    t_many = measure_forced(
+        lambda: run_with_iters(iters), repetitions=repetitions, warmup=warmup
+    ).min_s
+    t_one = measure_forced(
+        lambda: run_with_iters(1), repetitions=repetitions, warmup=warmup
+    ).min_s
+    return max(t_many - t_one, 0.0) / (iters - 1)
+
+
 def max_across_processes(seconds: float) -> float:
     """Cross-process MAX of a local elapsed time, the distributed timing
     convention of allreduce-mpi-sycl.cpp:188-190 (MPI_Allreduce(MAX)).
