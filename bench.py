@@ -58,7 +58,8 @@ def main() -> int:
     t_serial = per_pass_seconds(x, "serial", trips, iters_slow)
     t_overlap = per_pass_seconds(x, "overlap", trips, iters_slow)
 
-    degenerate = t_overlap <= 0 or t_serial <= 0  # below timer resolution
+    # any clamped-to-zero component means the run measured nothing usable
+    degenerate = min(t_overlap, t_serial, t_dma, t_comp) <= 0
     if degenerate:
         # report "measured nothing", never a pass
         speedup, theoretical, vs_baseline = 0.0, 0.0, 0.0

@@ -73,7 +73,7 @@ def resolve_algorithm(args) -> str:
 
 
 def run(args) -> int:
-    log = RunLog(args.log)
+    log = RunLog(args.log, truncate=not args.log_append)
     comm = common.make_communicator(args.backend, args.world, even=True)
     world = comm.size
     algorithm = resolve_algorithm(args)

@@ -113,7 +113,7 @@ def build_commands(args, devices) -> tuple[list[cmds.Command], dict]:
 
 
 def run(args) -> int:
-    log = RunLog(args.log)
+    log = RunLog(args.log, truncate=not args.log_append)
     mode = engine.canonical_mode(args.mode)
     devices = topology.get_devices(args.backend)
     command_list, tune_info = build_commands(args, devices)

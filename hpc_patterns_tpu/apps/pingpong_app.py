@@ -35,7 +35,7 @@ def build_parser():
 
 
 def run(args) -> int:
-    log = RunLog(args.log)
+    log = RunLog(args.log, truncate=not args.log_append)
     comm = common.make_communicator(args.backend, args.world, even=True)
     if comm.size < 2:
         log.print("SKIP: ping-pong needs >= 2 devices (even ranks, "

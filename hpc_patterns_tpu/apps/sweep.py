@@ -35,7 +35,7 @@ def build_parser():
 
 
 def run(args) -> int:
-    log = RunLog(args.log, truncate=True)  # the harness owns the log file
+    log = RunLog(args.log, truncate=not args.log_append)  # harness owns the log
     app_parser = concurrency_app.build_parser()
     for commands in DEFAULT_MATRIX:
         for mode in args.modes:
@@ -48,7 +48,7 @@ def run(args) -> int:
             if args.backend:
                 argv += ["--backend", args.backend]
             if args.log:
-                argv += ["--log", args.log]  # apps append into our log
+                argv += ["--log", args.log, "--log-append"]  # share our log
             log.print(f"=== {mode} {' '.join(commands)} ===")
             code = concurrency_app.run(app_parser.parse_args(argv))
             log.emit(kind="result", name=f"sweep[{mode}:{'+'.join(commands)}]",

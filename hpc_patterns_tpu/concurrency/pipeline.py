@@ -67,12 +67,12 @@ def _make_kernel(mode: str, num_chunks: int):
                     hbm_ref.at[chunk], scratch.at[slot], sem.at[slot]
                 )
 
-            def one_pass(p, _):
+            def one_pass(p, checksum):
                 if mode == "overlap":
                     # warm-up DMA for this pass's first chunk
                     get_dma(0, 0).start()
 
-                def chunk_step(i, _):
+                def chunk_step(i, csum):
                     slot = lax.rem(i, 2)
                     if mode == "overlap":
 
@@ -88,13 +88,17 @@ def _make_kernel(mode: str, num_chunks: int):
                     if do_compute:
                         salt = (p * num_chunks + i).astype(jnp.float32) * jnp.float32(1e-7)
                         acc = _chain(scratch[slot], trips, salt)
-                        out_ref[:] = acc[:8]
-                    return 0
+                        # fold EVERY chunk into the checksum so the oracle
+                        # (overlap == serial) covers every DMA'd block, not
+                        # just the last one
+                        csum = csum + acc[:8]
+                    return csum
 
-                lax.fori_loop(0, num_chunks, chunk_step, 0)
-                return 0
+                return lax.fori_loop(0, num_chunks, chunk_step, checksum)
 
-            lax.fori_loop(0, passes, one_pass, 0)
+            out_ref[:] = lax.fori_loop(
+                0, passes, one_pass, jnp.zeros((8, 128), jnp.float32)
+            )
 
         chunk_shape = hbm_ref.shape[1:]
         pl.run_scoped(

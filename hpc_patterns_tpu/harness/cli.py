@@ -41,6 +41,11 @@ def base_parser(description: str) -> argparse.ArgumentParser:
     )
     p.add_argument("--warmup", type=int, default=2, help="untimed warm-up calls (absorbs XLA compile)")
     p.add_argument("--log", default=None, help="write JSONL run log here (run.log analog)")
+    p.add_argument(
+        "--log-append",
+        action="store_true",
+        help="append to --log instead of truncating (for harness-invoked runs)",
+    )
     return p
 
 

@@ -23,7 +23,7 @@ class RunLog:
         path: str | Path | None = None,
         stream: IO[str] | None = None,
         *,
-        truncate: bool = False,
+        truncate: bool = True,
     ):
         self.path = Path(path) if path else None
         self.stream = stream if stream is not None else sys.stdout
@@ -31,8 +31,9 @@ class RunLog:
         if self.path:
             self.path.parent.mkdir(parents=True, exist_ok=True)
             if truncate:
-                # one log per harness run, like run.sh's tee; apps invoked
-                # *by* a harness append to the harness's log instead
+                # one log per run, like run.sh's tee; apps invoked *by* a
+                # harness pass truncate=False (--log-append) to share the
+                # harness's log instead
                 self.path.write_text("")
 
     def emit(self, **record: Any) -> dict[str, Any]:

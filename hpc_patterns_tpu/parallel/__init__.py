@@ -1,0 +1,33 @@
+"""Parallelism strategies built on the comm layer (SURVEY.md §2.2).
+
+The reference's patterns are the HPC primitives ML parallelism is built
+from; SURVEY.md §2.2 maps each and notes TP/PP/SP/ring-attention are
+"absent as such — the ring + pt2pt components are their building blocks
+and should be API-shaped so these can be layered on". This package is
+that layering, TPU-first:
+
+- :mod:`~.ring_attention` — context parallelism over a sequence-sharded
+  mesh axis: the reference's ring exchange-and-accumulate dataflow
+  (allreduce-mpi-sycl.cpp:173-182) with the accumulate generalized to
+  online-softmax attention (SURVEY.md §5 "long-context").
+- :mod:`~.ulysses` — all-to-all sequence parallelism (DeepSpeed-Ulysses
+  style): heads scatter / sequence gather around local full attention.
+- :mod:`~.tensor` — Megatron-style tensor parallelism: column/row
+  sharded matmuls where the row-parallel reduction is the reference's
+  allreduce (library ``psum`` or the hand ring, caller's choice).
+- :mod:`~.pipeline` — pipeline-parallel stage handoff: the pairwise
+  pt2pt pattern (SendRecvRing, allreduce-mpi-sycl.cpp:43-59) as a
+  fill-drain microbatch schedule.
+
+Everything is a rank-local function for use inside ``shard_map`` over a
+named mesh axis, composable with dp/tp/sp/pp axes of one Mesh.
+"""
+
+from hpc_patterns_tpu.parallel.ring_attention import ring_attention  # noqa: F401
+from hpc_patterns_tpu.parallel.ulysses import ulysses_attention  # noqa: F401
+from hpc_patterns_tpu.parallel.tensor import (  # noqa: F401
+    column_parallel,
+    row_parallel,
+    tp_mlp,
+)
+from hpc_patterns_tpu.parallel.pipeline import pipeline_forward  # noqa: F401

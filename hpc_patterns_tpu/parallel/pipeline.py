@@ -1,0 +1,67 @@
+"""Pipeline parallelism: microbatch fill-drain over the pt2pt ring.
+
+The reference's pairwise blocking Send/Recv between ring neighbors is
+"the core of PP" (SURVEY.md §2.2): a pipeline stage boundary is exactly
+one neighbor handoff per tick. This module turns that primitive
+(comm.ring.ring_shift — deadlock-free ppermute, vs the reference's
+even/odd ordering trick, allreduce-mpi-sycl.cpp:50-58) into a GPipe-style
+forward schedule: rank r runs stage r; microbatch m enters at tick m,
+reaches stage r at tick m+r, exits after M + P - 1 ticks.
+
+SPMD subtlety: inside ``shard_map`` every rank executes the same program,
+so "is my buffer valid at this tick" is data (a mask), not control flow —
+inactive (fill/drain bubble) ticks compute on garbage and mask the
+result, the standard XLA-friendly formulation (static tick loop, no
+data-dependent branching — SURVEY.md's XLA-semantics ground rule).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+from hpc_patterns_tpu.comm import ring
+
+
+def pipeline_forward(
+    stage_fn: Callable,
+    stage_params,
+    x_microbatches,
+    axis: str,
+):
+    """Run ``stage_fn(stage_params, x)`` as a P-stage pipeline over the
+    mesh axis (rank-local; run inside ``shard_map``).
+
+    ``stage_params``: this rank's stage parameters (stage r on rank r).
+    ``x_microbatches``: (M, ...) microbatches — read on rank 0 (the
+    pipeline entry); other ranks may pass zeros of the same shape.
+    Returns (M, ...) outputs, valid on the LAST rank (rank size-1); other
+    ranks return zeros — fetch the last-rank shard, or close the ring
+    with one more hop if replication is wanted.
+    """
+    size = ring.axis_size(axis)
+    me = ring.axis_index(axis)
+    M = x_microbatches.shape[0]
+    mb_shape = x_microbatches.shape[1:]
+
+    buf = jnp.zeros(mb_shape, x_microbatches.dtype)  # incoming activation
+    outs = jnp.zeros((M, *mb_shape), x_microbatches.dtype)
+
+    for tick in range(M + size - 1):
+        # entry rank injects microbatch `tick` during the fill window
+        feed_idx = min(tick, M - 1)
+        cur = jnp.where(me == 0, x_microbatches[feed_idx], buf)
+        # stage r is active for microbatch (tick - r) in [0, M)
+        active = jnp.logical_and(tick - me >= 0, tick - me < M)
+        y = stage_fn(stage_params, cur)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        # last stage banks its finished microbatch
+        out_idx = max(min(tick - (size - 1), M - 1), 0)
+        bank = jnp.logical_and(active, me == size - 1)
+        outs = outs.at[out_idx].set(jnp.where(bank, y, outs[out_idx]))
+        # neighbor handoff (the SendRecvRing hop); last->0 wraps but rank 0
+        # overwrites with its injection, so the wrap is harmless
+        buf = ring.ring_shift(y, axis, 1)
+
+    return outs

@@ -1,0 +1,55 @@
+"""Ulysses-style sequence parallelism: all-to-all around local attention.
+
+The second canonical long-context strategy (vs the ring,
+SURVEY.md §2.2's "TP / PP / SP ... ring + pt2pt components above are
+their building blocks"): instead of circulating K/V, one
+``MPI_Alltoall``-shaped exchange (comm.collectives.all_to_all,
+lax.all_to_all over ICI) re-shards from sequence-sharded to
+head-sharded, every rank runs *full-sequence* attention on its head
+slice, and a second all-to-all restores sequence sharding.
+
+Ring vs Ulysses is the same library-collective-vs-composed-ring tradeoff
+the reference's allreduce miniapp exists to measure (§2.3(b)): Ulysses
+is 2 dense collectives, ring is (size-1) neighbor hops overlapped with
+compute. Both are exposed so benchmarks can race them.
+"""
+
+from __future__ import annotations
+
+from hpc_patterns_tpu.comm import collectives, ring
+from hpc_patterns_tpu.parallel.ring_attention import full_attention
+
+
+def ulysses_attention(
+    q,
+    k,
+    v,
+    axis: str,
+    *,
+    causal: bool = False,
+    scale: float | None = None,
+):
+    """Attention over a sequence sharded on ``axis`` via head scattering
+    (rank-local; run inside ``shard_map``).
+
+    ``q``/``k``/``v``: (batch, seq_local, heads, head_dim) with ``heads``
+    divisible by the axis size. Returns the local sequence block of the
+    full attention output, same shape as ``q``.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"want (batch, seq, heads, head_dim), got {q.shape}")
+    size = ring.axis_size(axis)
+    H = q.shape[2]
+    if H % size:
+        raise ValueError(f"heads {H} not divisible by axis size {size}")
+
+    # (B, T/P, H, D) -> (B, T, H/P, D): gather sequence, scatter heads
+    def seq_to_heads(x):
+        return collectives.all_to_all(x, axis, split_axis=2, concat_axis=1)
+
+    def heads_to_seq(x):
+        return collectives.all_to_all(x, axis, split_axis=1, concat_axis=2)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = full_attention(qh, kh, vh, causal=causal, scale=scale)
+    return heads_to_seq(out)
