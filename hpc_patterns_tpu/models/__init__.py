@@ -1,0 +1,28 @@
+"""Flagship model family: a TPU-first decoder-only transformer.
+
+The reference has no model zoo (SURVEY.md: "no scheduler daemon, no
+model zoo, no training loop") — but its ring/pt2pt/collective patterns
+are the building blocks of ML parallelism, and SURVEY.md §2.2 requires
+them "API-shaped so these [TP/SP/ring-attention] can be layered on".
+This package is the proof of that layering: a transformer whose
+
+- tensor parallelism is the Megatron column/row sharding the
+  :mod:`~hpc_patterns_tpu.parallel.tensor` helpers express,
+- long-context path is :func:`~hpc_patterns_tpu.parallel.ring_attention`
+  (the reference's ring dataflow generalized),
+- data/sequence parallelism is pure ``jax.sharding`` annotation —
+  XLA inserts the ICI collectives (the §2.3 "GPU-aware" property).
+
+Design: pure-JAX pytree params (no framework layer), f32 master params
+with bf16 (MXU-native) compute, layers stacked for ``lax.scan`` (one
+compile per model, not per layer), optional ``jax.checkpoint`` remat.
+"""
+
+from hpc_patterns_tpu.models.transformer import (  # noqa: F401
+    TransformerConfig,
+    init_params,
+    forward,
+    loss_fn,
+)
+from hpc_patterns_tpu.models.train import make_train_step, make_optimizer  # noqa: F401
+from hpc_patterns_tpu.models.sharding import param_shardings, batch_sharding  # noqa: F401

@@ -1,0 +1,59 @@
+"""Parameter/batch sharding rules: Megatron column/row TP + dp/sp data
+layout over one named mesh.
+
+These PartitionSpecs are the annotation form of the explicit
+parallel/tensor.py helpers (column-parallel = output-feature sharded,
+row-parallel = input-feature sharded → XLA inserts the psum the helpers
+spell out — the library-collective path of §2.3). Axis order follows
+topology.make_mesh guidance: tp last (fastest-varying → ICI neighbors),
+then sp, then dp.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hpc_patterns_tpu.models.transformer import TransformerConfig
+
+
+def param_specs(cfg: TransformerConfig) -> dict:
+    """PartitionSpec pytree matching init_params' structure. Layer
+    weights carry a leading (unsharded) n_layers scan axis."""
+    tp = cfg.axis_tp
+    return {
+        "embed": P(None, None),          # replicated: lookup stays local
+        "pos_embed": P(None, None),
+        "layers": {
+            "ln1_scale": P(None, None),
+            "ln2_scale": P(None, None),
+            "wqkv": P(None, None, tp),   # column-parallel (heads split)
+            "wo": P(None, tp, None),     # row-parallel
+            "w1": P(None, None, tp),     # column-parallel
+            "w2": P(None, tp, None),     # row-parallel
+        },
+        "ln_f_scale": P(None),
+        "lm_head": P(None, tp),          # vocab-sharded logits
+    }
+
+
+def param_shardings(mesh: Mesh, cfg: TransformerConfig):
+    """NamedSharding pytree for params (pass as jit in_shardings /
+    device_put target)."""
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def batch_sharding(mesh: Mesh, cfg: TransformerConfig) -> NamedSharding:
+    """Tokens (batch, seq): batch over dp, sequence over sp — the rank→
+    data map, ≙ the reference's rank→device policies (devices.hpp:22-59)
+    lifted to arrays."""
+    return NamedSharding(mesh, P(cfg.axis_dp, cfg.axis_sp))
+
+
+def shard_params(params, mesh: Mesh, cfg: TransformerConfig):
+    """Place a (host or single-device) param pytree onto the mesh."""
+    return jax.device_put(params, param_shardings(mesh, cfg))

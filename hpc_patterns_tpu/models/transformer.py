@@ -1,0 +1,181 @@
+"""Decoder-only transformer, TPU-first.
+
+Architecture choices driven by the hardware (SURVEY.md preamble +
+/opt/skills/guides/pallas_guide.md):
+
+- all matmuls shaped for the MXU: bf16 compute dtype, model dims kept in
+  multiples of 128, no per-layer Python loop — layers are stacked on a
+  leading axis and driven by ``lax.scan`` (one traced layer body);
+- attention is pluggable: ``"full"`` (single-device oracle),
+  ``"ring"`` (context parallelism over the ``sp`` mesh axis — the
+  reference's ring dataflow, parallel/ring_attention.py), or
+  ``"ulysses"`` (all-to-all SP);
+- activation sharding is annotated with ``with_sharding_constraint``;
+  parameter shardings live in models/sharding.py (Megatron column/row
+  rules, ≙ parallel/tensor.py helpers);
+- optional ``jax.checkpoint`` remat on the layer body trades FLOPs for
+  HBM (the bandwidth-vs-memory lever).
+
+Params are a plain pytree of f32 arrays (master weights); ``forward``
+casts to ``cfg.dtype`` (bf16 by default) at use.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from hpc_patterns_tpu.parallel.ring_attention import full_attention, ring_attention
+from hpc_patterns_tpu.parallel.ulysses import ulysses_attention
+
+ATTENTION_IMPLS = ("full", "ring", "ulysses")
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 32768
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    d_ff: int = 3072
+    max_seq: int = 2048
+    dtype: str = "bfloat16"  # compute dtype (MXU-native)
+    attention: str = "full"  # full | ring | ulysses
+    remat: bool = False
+    # mesh axis names (data / sequence(context) / tensor)
+    axis_dp: str = "dp"
+    axis_sp: str = "sp"
+    axis_tp: str = "tp"
+
+    @property
+    def head_dim(self) -> int:
+        if self.d_model % self.n_heads:
+            raise ValueError(f"d_model {self.d_model} % n_heads {self.n_heads} != 0")
+        return self.d_model // self.n_heads
+
+    def __post_init__(self):
+        if self.attention not in ATTENTION_IMPLS:
+            raise ValueError(
+                f"attention {self.attention!r} not in {ATTENTION_IMPLS}"
+            )
+
+
+def init_params(key, cfg: TransformerConfig):
+    """f32 master params; layer weights stacked on a leading n_layers
+    axis for ``lax.scan``."""
+    D, F, L, V = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab
+    k = iter(jax.random.split(key, 8))
+
+    def initn(shape, scale):
+        return jax.random.normal(next(k), shape, jnp.float32) * scale
+
+    return {
+        "embed": initn((V, D), 0.02),
+        "pos_embed": initn((cfg.max_seq, D), 0.02),
+        "layers": {
+            "ln1_scale": jnp.ones((L, D), jnp.float32),
+            "ln2_scale": jnp.ones((L, D), jnp.float32),
+            "wqkv": initn((L, D, 3 * D), D ** -0.5),
+            "wo": initn((L, D, D), (2 * D * L) ** -0.5),
+            "w1": initn((L, D, F), D ** -0.5),
+            "w2": initn((L, F, D), (2 * F * L) ** -0.5),
+        },
+        "ln_f_scale": jnp.ones((D,), jnp.float32),
+        "lm_head": initn((D, V), D ** -0.5),
+    }
+
+
+def _rmsnorm(x, scale):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * lax.rsqrt(var + 1e-6).astype(x.dtype)) * scale.astype(x.dtype)
+
+
+def _attention(q, k, v, cfg: TransformerConfig, mesh):
+    """Dispatch to the configured attention impl. ring/ulysses wrap the
+    rank-local kernels in ``shard_map`` over (dp, sp, tp) — sequence
+    travels the ``sp`` ring while heads stay tensor-sharded."""
+    if cfg.attention == "full" or mesh is None:
+        return full_attention(q, k, v, causal=True)
+    spec = P(cfg.axis_dp, cfg.axis_sp, cfg.axis_tp, None)
+    impl = ring_attention if cfg.attention == "ring" else ulysses_attention
+    fn = partial(impl, axis=cfg.axis_sp, causal=True)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+
+
+def _layer(x, lp, cfg: TransformerConfig, mesh, act_spec):
+    """One pre-norm block: attn + mlp, Megatron-sharded (wqkv/w1 column,
+    wo/w2 row — models/sharding.py), activations re-constrained after
+    each collective-inducing matmul."""
+    B, T, D = x.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+    dt = x.dtype
+
+    def c(y, spec):
+        return lax.with_sharding_constraint(y, spec) if mesh is not None else y
+
+    h = _rmsnorm(x, lp["ln1_scale"])
+    qkv = jnp.dot(h, lp["wqkv"].astype(dt))  # (B, T, 3D) — column-parallel
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, H, Dh)
+    k = k.reshape(B, T, H, Dh)
+    v = v.reshape(B, T, H, Dh)
+    o = _attention(q, k, v, cfg, mesh)
+    o = jnp.dot(o.reshape(B, T, D), lp["wo"].astype(dt))  # row-parallel
+    x = c(x + o, act_spec)
+
+    h = _rmsnorm(x, lp["ln2_scale"])
+    h = jax.nn.gelu(jnp.dot(h, lp["w1"].astype(dt)))  # column-parallel
+    h = jnp.dot(h, lp["w2"].astype(dt))  # row-parallel (psum by XLA)
+    return c(x + h, act_spec)
+
+
+def forward(params, tokens, cfg: TransformerConfig, mesh=None):
+    """Logits for next-token prediction. ``tokens``: (batch, seq) int32.
+    ``mesh``: the device mesh for sharding constraints + ring/ulysses
+    attention; None = single-device (tests/oracle)."""
+    dt = jnp.dtype(cfg.dtype)
+    B, T = tokens.shape
+    act_spec = (
+        jax.sharding.NamedSharding(mesh, P(cfg.axis_dp, cfg.axis_sp, None))
+        if mesh is not None
+        else None
+    )
+    x = params["embed"].astype(dt)[tokens] + params["pos_embed"].astype(dt)[:T]
+    if mesh is not None:
+        x = lax.with_sharding_constraint(x, act_spec)
+
+    layer = partial(_layer, cfg=cfg, mesh=mesh, act_spec=act_spec)
+    if cfg.remat:
+        layer = jax.checkpoint(layer)
+
+    def scan_body(h, lp):
+        return layer(h, lp), None
+
+    x, _ = lax.scan(scan_body, x, params["layers"])
+    x = _rmsnorm(x, params["ln_f_scale"])
+    logits = jnp.dot(x, params["lm_head"].astype(dt))
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params, tokens, cfg: TransformerConfig, mesh=None):
+    """Causal LM loss: predict token t+1 from prefix ≤ t (mean NLL).
+
+    The full (batch, seq) token array feeds forward() and the final
+    position is masked out of the loss — rather than slicing to seq-1 —
+    so sequence shardings (seq % sp == 0) survive into the activations.
+    """
+    B, T = tokens.shape
+    logits = forward(params, tokens, cfg, mesh)
+    targets = jnp.roll(tokens, -1, axis=1)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - gold
+    mask = (lax.broadcasted_iota(jnp.int32, (B, T), 1) < T - 1).astype(nll.dtype)
+    return jnp.sum(nll * mask) / jnp.sum(mask)

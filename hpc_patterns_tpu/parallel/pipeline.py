@@ -55,6 +55,13 @@ def pipeline_forward(
         # stage r is active for microbatch (tick - r) in [0, M)
         active = jnp.logical_and(tick - me >= 0, tick - me < M)
         y = stage_fn(stage_params, cur)
+        if y.shape != cur.shape or y.dtype != cur.dtype:
+            # the handoff buffer is reused every tick, so stages must be
+            # shape/dtype-preserving (project in/out inside stage_fn)
+            raise ValueError(
+                f"stage_fn must preserve microbatch shape/dtype: "
+                f"{cur.shape}/{cur.dtype} -> {y.shape}/{y.dtype}"
+            )
         y = jnp.where(active, y, jnp.zeros_like(y))
         # last stage banks its finished microbatch
         out_idx = max(min(tick - (size - 1), M - 1), 0)

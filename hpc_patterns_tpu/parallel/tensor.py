@@ -14,6 +14,7 @@ concurrency suite measures at the queue level).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from hpc_patterns_tpu.comm import collectives, ring
@@ -65,8 +66,6 @@ def tp_mlp(x, w_in_local, w_out_local, *, axis: str, activation=None,
     """The canonical TP block: column-parallel in-projection, elementwise
     activation on the shard, row-parallel out-projection — exactly one
     allreduce per block."""
-    import jax
-
     h = column_parallel(x, w_in_local)
     h = (activation or jax.nn.gelu)(h)
     return row_parallel(h, w_out_local, axis=axis, algorithm=algorithm)

@@ -201,12 +201,17 @@ def make_mesh(
     sizes = _factor_axes(len(devices), axes)
     names = tuple(sizes.keys())
     shape = tuple(sizes[k] for k in names)
+    # Auto axis types: the framework uses with_sharding_constraint /
+    # shard_map-style GSPMD, not the Explicit sharding-in-types mode.
+    axis_types = (jax.sharding.AxisType.Auto,) * len(names)
     try:
         # Let JAX pick an ICI-friendly physical layout when it can.
-        return jax.make_mesh(shape, names, devices=tuple(devices))
+        return jax.make_mesh(
+            shape, names, devices=tuple(devices), axis_types=axis_types
+        )
     except (ValueError, TypeError):
         dev_array = np.asarray(devices).reshape(shape)
-        return Mesh(dev_array, names)
+        return Mesh(dev_array, names, axis_types=axis_types)
 
 
 def single_device_mesh(axes: Sequence[str] = ("dp",)) -> Mesh:

@@ -55,7 +55,6 @@ class TestRingAttention:
 
     def test_rejects_bad_rank(self, mesh8):
         with pytest.raises(ValueError, match="head_dim"):
-            q = jnp.zeros((T, D))
             jax.shard_map(
                 lambda q: parallel.ring_attention(q, q, q, "x"),
                 mesh=mesh8, in_specs=P("x"), out_specs=P("x"),
