@@ -1,0 +1,22 @@
+"""Interop suite — TPU rebuild of ``sycl_omp_ze_interopt`` (C10).
+
+The reference proves two runtimes can share device memory zero-copy: it
+extracts Level-Zero handles from the OpenMP runtime, wraps them as SYCL
+objects, then asserts that buffers allocated by either runtime are
+readable by the other without copies (interop_omp_ze_sycl.cpp:16-101).
+
+The TPU-native equivalents:
+
+- :mod:`~.native` — the C++ support library (native/hpcpat.cpp) bound
+  via ctypes: aligned allocator, analytic validators, stats engine,
+  ring planner. The "foreign runtime" whose memory Python/JAX must use.
+- :mod:`~.zero_copy` — the pointer-sharing proofs: native buffer ↔
+  numpy ↔ JAX (dlpack) ↔ torch, each direction asserted zero-copy by
+  *pointer identity*, the airtight version of the reference's
+  write-here-read-there asserts (:81-101).
+
+apps/interop_app.py runs the full proof chain as a self-validating
+benchmark.
+"""
+
+from hpc_patterns_tpu.interop import native  # noqa: F401

@@ -1,0 +1,78 @@
+"""Zero-copy buffer sharing across runtimes: native C++ ↔ numpy ↔ JAX ↔
+torch.
+
+The reference proves OMP↔SYCL zero-copy by writing through one runtime
+and reading through the other with asserts (interop_omp_ze_sycl.cpp:
+81-101). Here the runtimes are the native allocator (hpcpat.cpp), numpy,
+JAX (via the dlpack protocol) and torch; each bridge returns the shared
+view AND the proof — *pointer identity* between producer and consumer —
+which is stronger than value equality (a copy could pass a value check).
+
+Scope note (honest TPU story): true zero-copy aliasing is a same-memory-
+space property. These bridges are zero-copy on the host (CPU backend /
+pinned host buffers); crossing into TPU HBM is a DMA by physics, which
+is the M2D path of the concurrency suite, not interop. The reference is
+the same: its zero-copy claim holds within one GPU's Level-Zero context.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+
+def jax_pointer(arr) -> int:
+    """Device-buffer address of a jax.Array (single shard)."""
+    return arr.addressable_shards[0].data.unsafe_buffer_pointer()
+
+
+def numpy_to_jax(x: np.ndarray):
+    """Import host memory into JAX via dlpack, zero-copy.
+
+    Returns (jax_array, zero_copy: bool) — zero_copy is proven by
+    pointer identity, the ``assert`` of interop_omp_ze_sycl.cpp:90-91
+    made airtight.
+
+    XLA only aliases imports with >= 64-byte-aligned storage (it copies
+    otherwise) — the TPU-stack reason the reference's ALIGNMENT-style
+    aligned allocator (native.AlignedBuffer, ≙ allreduce-mpi-sycl.cpp:
+    19-21) is load-bearing, not cosmetic: plain numpy allocations are
+    16-aligned and silently lose the aliasing."""
+    arr = jax.dlpack.from_dlpack(x)  # consumes x.__dlpack__()
+    same = jax_pointer(arr) == x.ctypes.data
+    return arr, bool(same)
+
+
+def jax_to_numpy(arr) -> tuple[np.ndarray, bool]:
+    """Export a CPU jax.Array to numpy via dlpack, zero-copy."""
+    out = np.from_dlpack(arr)
+    same = out.ctypes.data == jax_pointer(arr)
+    return out, bool(same)
+
+
+def jax_to_torch(arr):
+    """Export a CPU jax.Array to torch via dlpack (torch is the stand-in
+    for the reference's *other* runtime, as SYCL was to OpenMP)."""
+    import torch
+
+    t = torch.from_dlpack(arr)
+    same = t.data_ptr() == jax_pointer(arr)
+    return t, bool(same)
+
+
+def torch_to_jax(t):
+    """Import a torch CPU tensor into JAX via dlpack."""
+    arr = jax.dlpack.from_dlpack(t)
+    same = jax_pointer(arr) == t.data_ptr()
+    return arr, bool(same)
+
+
+def native_to_jax(buf):
+    """The full reference chain: native-allocator memory → numpy view →
+    JAX array, all aliasing one allocation (≙ ``omp_target_alloc_device``
+    memory read by a SYCL queue, interop_omp_ze_sycl.cpp:81-91)."""
+    np_view = buf.as_numpy()
+    assert np_view.ctypes.data == buf.address, "numpy view must alias"
+    arr, zc = numpy_to_jax(np_view)
+    return arr, zc

@@ -60,11 +60,19 @@ def make_train_step(cfg: TransformerConfig, mesh=None, optimizer=None):
 def init_train_state(key, cfg: TransformerConfig, mesh=None, optimizer=None):
     """(params, opt_state): f32 master params placed per the sharding
     rules; optax state inherits the placement (zeros_like preserves
-    sharding)."""
+    sharding).
+
+    With a mesh, init runs *under jit with sharded out_shardings*, so
+    each device materializes only its own shards — no single device ever
+    holds the full f32 copy (the point of TP at flagship scale)."""
     optimizer = optimizer or make_optimizer()
-    params = init_params(key, cfg)
-    if mesh is not None:
-        params = shardlib.shard_params(params, mesh, cfg)
+    if mesh is None:
+        params = init_params(key, cfg)
+    else:
+        params = jax.jit(
+            lambda k: init_params(k, cfg),
+            out_shardings=shardlib.param_shardings(mesh, cfg),
+        )(key)
     opt_state = optimizer.init(params)
     return params, opt_state
 
