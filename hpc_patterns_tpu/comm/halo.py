@@ -1,0 +1,50 @@
+"""Halo exchange: ghost-cell neighbor transfer on the ring.
+
+The interop suite's shared-USM role in BASELINE.json is the
+stencil/halo-exchange config ("SYCL+OMP shared-USM stencil with halo
+exchange"; SURVEY.md §5 calls it "the stencil/halo analog" of the ring
+engine). A halo exchange is two simultaneous one-hop ring transfers:
+each rank sends its boundary strip left and right and receives its
+neighbors' strips — ``lax.ppermute`` in both directions over ICI, the
+deadlock-free form of the reference's even/odd ordered Send/Recv pairs
+(allreduce-mpi-sycl.cpp:50-58).
+
+Rank-local functions for use inside ``shard_map``; the domain axis is
+dim 0 of the local shard, mesh-axis order = global domain order,
+periodic by construction (the ring closes — pass explicit boundary
+handling downstream for non-periodic problems).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from hpc_patterns_tpu.comm import ring
+
+
+def halo_exchange(x, axis: str, *, halo: int = 1):
+    """Return ``x`` padded with ``halo`` ghost rows from each ring
+    neighbor: (n_local, ...) → (n_local + 2·halo, ...).
+
+    Row layout: ``[left-neighbor's last halo rows | x | right-neighbor's
+    first halo rows]`` with periodic wrap-around.
+    """
+    if halo < 1:
+        raise ValueError(f"halo must be >= 1, got {halo}")
+    if x.shape[0] < halo:
+        raise ValueError(
+            f"local shard ({x.shape[0]} rows) smaller than halo {halo}"
+        )
+    # +1 shift: my strip lands on my right neighbor => what *I* receive
+    # came from my left neighbor, and vice versa.
+    from_left = ring.ring_shift(x[-halo:], axis, +1)
+    from_right = ring.ring_shift(x[:halo], axis, -1)
+    return jnp.concatenate([from_left, x, from_right], axis=0)
+
+
+def jacobi_step(u, axis: str, *, alpha: float = 0.25):
+    """One periodic 1-D diffusion (3-point Jacobi) step with halo
+    exchange: u' = (1-2α)·u + α·(left + right). The canonical stencil
+    the halo pattern exists for."""
+    g = halo_exchange(u, axis, halo=1)
+    return (1.0 - 2.0 * alpha) * g[1:-1] + alpha * (g[:-2] + g[2:])

@@ -1,0 +1,10 @@
+"""Cross-cutting utilities: checkpoint/resume, misc helpers.
+
+SURVEY.md §5 records the reference has **no** checkpoint/resume ("None
+anywhere — no serialization of any state"). For a framework with a
+training loop that gap is load-bearing, so it is closed here rather
+than reproduced: orbax-backed save/restore of the full sharded train
+state (checkpoint.py).
+"""
+
+from hpc_patterns_tpu.utils.checkpoint import save_checkpoint, restore_checkpoint  # noqa: F401

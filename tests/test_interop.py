@@ -113,8 +113,14 @@ class TestInteropApp:
     def test_app_passes(self, capsys):
         from hpc_patterns_tpu.apps import interop_app
 
+        try:
+            import torch  # noqa: F401 — app skips its torch legs without it
+
+            min_passed = 5
+        except ImportError:
+            min_passed = 3
         code = interop_app.main(["-n", "4096"])
         out = capsys.readouterr().out
         assert code == 0, out
         assert "SUCCESS" in out
-        assert out.count("Passed") >= 5
+        assert out.count("Passed") >= min_passed
