@@ -37,6 +37,7 @@ from hpc_patterns_tpu.harness.timing import blocking
 def build_parser():
     p = base_parser(__doc__.splitlines()[0])
     add_msg_size_args(p)
+    p.set_defaults(log2_elements=22)  # stencil default: 4M cells
     p.add_argument("--steps", type=int, default=64, help="Jacobi steps per run")
     p.add_argument("--world", type=int, default=-1, help="ranks; -1 = all devices")
     p.add_argument("--alpha", type=float, default=0.25)
@@ -48,7 +49,7 @@ def run(args) -> int:
     comm = common.make_communicator(args.backend, args.world)
     mesh, axis = comm.mesh, comm.axis
     world = comm.size
-    n = 1 << min(args.log2_elements, 22)  # global domain size
+    n = 1 << args.log2_elements  # global domain size (2**p, like -p)
     n += (-n) % world
     steps = args.steps
     alpha = args.alpha
@@ -87,7 +88,7 @@ def run(args) -> int:
 
     ok = conserved and matches
     per_step = result.min_s / steps
-    halo_bytes = 2 * 2 * 4 * world  # 2 dirs × send+recv × f32, per step
+    halo_bytes = 2 * 4 * world  # 2 directions × f32 per rank, per step
     log.emit(
         kind="result", name="stencil", success=ok, world=world,
         elements=n, steps=steps, per_step_us=per_step * 1e6,

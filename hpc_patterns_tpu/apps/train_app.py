@@ -38,7 +38,7 @@ def build_parser():
     p.add_argument("--n-heads", type=int, default=8)
     p.add_argument("--vocab", type=int, default=1024)
     p.add_argument("--attention", default="full",
-                   choices=["full", "ring", "ulysses"])
+                   choices=["full", "flash", "ring", "ulysses"])
     p.add_argument("--remat", action="store_true")
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1)
@@ -56,7 +56,13 @@ def run(args) -> int:
         n_layers=args.n_layers, d_ff=4 * args.d_model, max_seq=args.seq,
         attention=args.attention, remat=args.remat,
     )
-    use_mesh = args.dp * args.sp * args.tp > 1 or args.attention != "full"
+    n_mesh = args.dp * args.sp * args.tp
+    if args.attention == "flash" and n_mesh > 1:
+        log.print("ERROR: attention='flash' is single-device; "
+                  "use ring/ulysses with a mesh")
+        log.print("FAILURE")
+        return 1
+    use_mesh = n_mesh > 1 or args.attention in ("ring", "ulysses")
     mesh = None
     if use_mesh:
         devices = topology.get_devices(args.backend)
@@ -81,7 +87,8 @@ def run(args) -> int:
         log.emit(kind="step", step=i, loss=loss_val, dt_s=t_steps[-1])
 
     finite = all(l == l and abs(l) != float("inf") for l in losses)
-    learned = losses[-1] < losses[0]
+    # a 1-step run has nothing to compare — finiteness is its check
+    learned = args.steps < 2 or losses[-1] < losses[0]
 
     resume_ok = True
     if args.resume_check:

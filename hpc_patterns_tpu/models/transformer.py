@@ -7,6 +7,7 @@ Architecture choices driven by the hardware (SURVEY.md preamble +
   multiples of 128, no per-layer Python loop — layers are stacked on a
   leading axis and driven by ``lax.scan`` (one traced layer body);
 - attention is pluggable: ``"full"`` (single-device oracle),
+  ``"flash"`` (the Pallas blockwise kernel, ops/flash_attention.py),
   ``"ring"`` (context parallelism over the ``sp`` mesh axis — the
   reference's ring dataflow, parallel/ring_attention.py), or
   ``"ulysses"`` (all-to-all SP);
@@ -33,7 +34,7 @@ from jax.sharding import PartitionSpec as P
 from hpc_patterns_tpu.parallel.ring_attention import full_attention, ring_attention
 from hpc_patterns_tpu.parallel.ulysses import ulysses_attention
 
-ATTENTION_IMPLS = ("full", "ring", "ulysses")
+ATTENTION_IMPLS = ("full", "flash", "ring", "ulysses")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -45,7 +46,7 @@ class TransformerConfig:
     d_ff: int = 3072
     max_seq: int = 2048
     dtype: str = "bfloat16"  # compute dtype (MXU-native)
-    attention: str = "full"  # full | ring | ulysses
+    attention: str = "full"  # full | flash | ring | ulysses
     remat: bool = False
     # mesh axis names (data / sequence(context) / tensor)
     axis_dp: str = "dp"
@@ -99,6 +100,16 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
     """Dispatch to the configured attention impl. ring/ulysses wrap the
     rank-local kernels in ``shard_map`` over (dp, sp, tp) — sequence
     travels the ``sp`` ring while heads stay tensor-sharded."""
+    if cfg.attention == "flash":
+        if mesh is not None:
+            raise ValueError(
+                "attention='flash' is the single-device kernel; distribute "
+                "with 'ring' or 'ulysses' on a mesh (each rank's local "
+                "compute can then use ops.flash_attention internally)"
+            )
+        from hpc_patterns_tpu.ops import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
     if cfg.attention == "full" or mesh is None:
         return full_attention(q, k, v, causal=True)
     spec = P(cfg.axis_dp, cfg.axis_sp, cfg.axis_tp, None)

@@ -1,0 +1,20 @@
+"""Pallas TPU kernels — the hand-written hot ops.
+
+The reference's only device kernels are a busy-wait FMA chain and a
+vector accumulate (sycl_con.cpp:26-33, allreduce-mpi-sycl.cpp:26-31);
+the TPU framework's hot ops live here instead, written as Pallas kernels
+where XLA's automatic fusion isn't enough (SURVEY.md preamble: "pallas
+kernels for the hot ops"):
+
+- :mod:`~.flash_attention` — blockwise causal attention in VMEM with an
+  online-softmax accumulator: O(T) memory, MXU-shaped block matmuls,
+  grid-pipelined HBM→VMEM streaming. The single-chip fast path of the
+  model (the ring/Ulysses paths in :mod:`hpc_patterns_tpu.parallel`
+  distribute *across* chips; this kernel is what each chip should run
+  locally).
+
+The concurrency suite's kernels (busy-wait, DMA/compute pipeline) stay
+in :mod:`hpc_patterns_tpu.concurrency` next to their benchmarks.
+"""
+
+from hpc_patterns_tpu.ops.flash_attention import flash_attention  # noqa: F401

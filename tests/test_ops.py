@@ -1,0 +1,64 @@
+"""Pallas op tests: flash attention vs the dense oracle (interpret mode
+on the CPU mesh; real-TPU correctness/perf are exercised by bench/driver
+runs)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hpc_patterns_tpu.ops import flash_attention
+from hpc_patterns_tpu.parallel.ring_attention import full_attention
+
+
+def _qkv(key, B=2, T=128, H=4, D=32, dtype=jnp.float32):
+    ks = jax.random.split(key, 3)
+    return tuple(jax.random.normal(k, (B, T, H, D), dtype) for k in ks)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_oracle(self, causal):
+        q, k, v = _qkv(jax.random.PRNGKey(0))
+        got = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+        want = full_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5
+        )
+
+    def test_uneven_blocks_rejected(self):
+        q, k, v = _qkv(jax.random.PRNGKey(1), T=96)
+        with pytest.raises(ValueError, match="divide"):
+            flash_attention(q, k, v, block_q=64, block_k=64)
+
+    def test_bad_rank(self):
+        with pytest.raises(ValueError, match="head_dim"):
+            flash_attention(jnp.zeros((2, 2)), jnp.zeros((2, 2)), jnp.zeros((2, 2)))
+
+    def test_block_larger_than_seq_clamps(self):
+        q, k, v = _qkv(jax.random.PRNGKey(2), T=64)
+        got = flash_attention(q, k, v, causal=True)  # default blocks 128 > 64
+        want = full_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    def test_model_flash_matches_full(self):
+        from hpc_patterns_tpu.models import TransformerConfig, forward, init_params
+
+        base = dict(vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                    max_seq=32, dtype="float32")
+        params = init_params(jax.random.PRNGKey(0), TransformerConfig(**base))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 64, "int32")
+        a = forward(params, tokens, TransformerConfig(**base))
+        b = forward(params, tokens, TransformerConfig(**base, attention="flash"))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+    def test_flash_on_mesh_rejected(self, mesh_dp_sp_tp):
+        from hpc_patterns_tpu.models import TransformerConfig, forward, init_params
+
+        cfg = TransformerConfig(vocab=64, d_model=32, n_heads=8, n_layers=1,
+                                d_ff=64, max_seq=32, attention="flash")
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 64, "int32")
+        with pytest.raises(ValueError, match="single-device"):
+            forward(params, tokens, cfg, mesh_dp_sp_tp)
