@@ -43,6 +43,10 @@ def build_parser():
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1)
     p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel axis (requires --n-experts)")
+    p.add_argument("--n-experts", type=int, default=0,
+                   help="MoE experts per layer (0 = dense MLP)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--resume-check", action="store_true",
                    help="save+restore mid-run and verify identical losses")
@@ -51,12 +55,21 @@ def build_parser():
 
 def run(args) -> int:
     log = RunLog(args.log, truncate=not args.log_append)
+    if args.ep > 1 and not args.n_experts:
+        log.print("ERROR: --ep requires --n-experts")
+        log.print("FAILURE")
+        return 1
+    if args.n_experts and args.n_experts % max(args.ep, 1):
+        log.print(f"ERROR: --n-experts {args.n_experts} must divide by "
+                  f"--ep {args.ep}")
+        log.print("FAILURE")
+        return 1
     cfg = TransformerConfig(
         vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, d_ff=4 * args.d_model, max_seq=args.seq,
-        attention=args.attention, remat=args.remat,
+        attention=args.attention, remat=args.remat, n_experts=args.n_experts,
     )
-    n_mesh = args.dp * args.sp * args.tp
+    n_mesh = args.dp * args.sp * args.tp * args.ep
     if args.attention == "flash" and n_mesh > 1:
         log.print("ERROR: attention='flash' is single-device; "
                   "use ring/ulysses with a mesh")
@@ -66,10 +79,10 @@ def run(args) -> int:
     mesh = None
     if use_mesh:
         devices = topology.get_devices(args.backend)
-        mesh = topology.make_mesh(
-            {"dp": args.dp, "sp": args.sp, "tp": args.tp},
-            devices[: args.dp * args.sp * args.tp],
-        )
+        axes = {"dp": args.dp, "sp": args.sp, "tp": args.tp}
+        if args.ep > 1:
+            axes["ep"] = args.ep
+        mesh = topology.make_mesh(axes, devices[:n_mesh])
 
     params, opt_state = init_train_state(jax.random.PRNGKey(0), cfg, mesh)
     step_fn = make_train_step(cfg, mesh)
@@ -116,7 +129,7 @@ def run(args) -> int:
         kind="result", name="train", success=ok,
         steps=args.steps, loss_first=losses[0], loss_last=losses[-1],
         step_time_s=step_s, tokens_per_s=tokens_per_s,
-        mesh={"dp": args.dp, "sp": args.sp, "tp": args.tp} if mesh else None,
+        mesh=dict(mesh.shape) if mesh else None,
         attention=args.attention, checkpoint=ckpt_path,
     )
     log.print(

@@ -14,6 +14,7 @@ from __future__ import annotations
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from hpc_patterns_tpu.models.sharding_util import mesh_axis_size, resolve_spec  # noqa: F401 — re-exported
 from hpc_patterns_tpu.models.transformer import TransformerConfig
 
 
@@ -21,17 +22,24 @@ def param_specs(cfg: TransformerConfig) -> dict:
     """PartitionSpec pytree matching init_params' structure. Layer
     weights carry a leading (unsharded) n_layers scan axis."""
     tp = cfg.axis_tp
+    layers = {
+        "ln1_scale": P(None, None),
+        "ln2_scale": P(None, None),
+        "wqkv": P(None, None, tp),       # column-parallel (heads split)
+        "wo": P(None, tp, None),         # row-parallel
+    }
+    if cfg.n_experts:
+        ep = cfg.axis_ep
+        layers["router"] = P(None, None, None)  # replicated routing table
+        layers["w1"] = P(None, ep, None, None)  # experts over ep
+        layers["w2"] = P(None, ep, None, None)
+    else:
+        layers["w1"] = P(None, None, tp)  # column-parallel
+        layers["w2"] = P(None, tp, None)  # row-parallel
     return {
         "embed": P(None, None),          # replicated: lookup stays local
         "pos_embed": P(None, None),
-        "layers": {
-            "ln1_scale": P(None, None),
-            "ln2_scale": P(None, None),
-            "wqkv": P(None, None, tp),   # column-parallel (heads split)
-            "wo": P(None, tp, None),     # row-parallel
-            "w1": P(None, None, tp),     # column-parallel
-            "w2": P(None, tp, None),     # row-parallel
-        },
+        "layers": layers,
         "ln_f_scale": P(None),
         "lm_head": P(None, tp),          # vocab-sharded logits
     }
@@ -41,7 +49,7 @@ def param_shardings(mesh: Mesh, cfg: TransformerConfig):
     """NamedSharding pytree for params (pass as jit in_shardings /
     device_put target)."""
     return jax.tree.map(
-        lambda spec: NamedSharding(mesh, spec),
+        lambda spec: NamedSharding(mesh, resolve_spec(spec, mesh)),
         param_specs(cfg),
         is_leaf=lambda x: isinstance(x, P),
     )
@@ -51,7 +59,7 @@ def batch_sharding(mesh: Mesh, cfg: TransformerConfig) -> NamedSharding:
     """Tokens (batch, seq): batch over dp, sequence over sp — the rank→
     data map, ≙ the reference's rank→device policies (devices.hpp:22-59)
     lifted to arrays."""
-    return NamedSharding(mesh, P(cfg.axis_dp, cfg.axis_sp))
+    return NamedSharding(mesh, resolve_spec(P(cfg.axis_dp, cfg.axis_sp), mesh))
 
 
 def shard_params(params, mesh: Mesh, cfg: TransformerConfig):

@@ -1,0 +1,30 @@
+"""Mesh-spec helpers shared by the model and its sharding rules.
+
+Separate from models/sharding.py (which depends on the model config) so
+transformer.py can import these without a cycle.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def resolve_spec(spec: P, mesh: Mesh) -> P:
+    """Drop spec axes the mesh doesn't have (→ replicated on that dim),
+    so one rule table serves every mesh shape — a dp-only mesh simply
+    replicates the tp/ep-sharded dims, the reference's fallback-to-
+    whole-device philosophy (devices.hpp:33-38). Tuple entries (axis
+    groups like ``(dp, ep)``) keep only their present members."""
+
+    def fix(ax):
+        if isinstance(ax, tuple):
+            kept = tuple(a for a in ax if a in mesh.axis_names)
+            return kept if len(kept) > 1 else (kept[0] if kept else None)
+        return ax if ax is None or ax in mesh.axis_names else None
+
+    return P(*(fix(ax) for ax in spec))
+
+
+def mesh_axis_size(mesh: Mesh, name: str) -> int:
+    """Axis size, 1 when the mesh doesn't carry the axis (pruned away)."""
+    return mesh.shape[name] if name in mesh.axis_names else 1

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from hpc_patterns_tpu.models.sharding_util import mesh_axis_size, resolve_spec
 from hpc_patterns_tpu.parallel.ring_attention import full_attention, ring_attention
 from hpc_patterns_tpu.parallel.ulysses import ulysses_attention
 
@@ -48,10 +49,16 @@ class TransformerConfig:
     dtype: str = "bfloat16"  # compute dtype (MXU-native)
     attention: str = "full"  # full | flash | ring | ulysses
     remat: bool = False
-    # mesh axis names (data / sequence(context) / tensor)
+    # mixture-of-experts: 0 = dense MLP; >0 = Switch-style top-1 MoE
+    # with experts sharded over the ep axis (parallel/moe.py)
+    n_experts: int = 0
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    # mesh axis names (data / sequence(context) / tensor / expert)
     axis_dp: str = "dp"
     axis_sp: str = "sp"
     axis_tp: str = "tp"
+    axis_ep: str = "ep"
 
     @property
     def head_dim(self) -> int:
@@ -75,17 +82,24 @@ def init_params(key, cfg: TransformerConfig):
     def initn(shape, scale):
         return jax.random.normal(next(k), shape, jnp.float32) * scale
 
+    layers = {
+        "ln1_scale": jnp.ones((L, D), jnp.float32),
+        "ln2_scale": jnp.ones((L, D), jnp.float32),
+        "wqkv": initn((L, D, 3 * D), D ** -0.5),
+        "wo": initn((L, D, D), (2 * D * L) ** -0.5),
+    }
+    if cfg.n_experts:
+        E = cfg.n_experts
+        layers["router"] = initn((L, D, E), D ** -0.5)
+        layers["w1"] = initn((L, E, D, F), D ** -0.5)
+        layers["w2"] = initn((L, E, F, D), (2 * F * L) ** -0.5)
+    else:
+        layers["w1"] = initn((L, D, F), D ** -0.5)
+        layers["w2"] = initn((L, F, D), (2 * F * L) ** -0.5)
     return {
         "embed": initn((V, D), 0.02),
         "pos_embed": initn((cfg.max_seq, D), 0.02),
-        "layers": {
-            "ln1_scale": jnp.ones((L, D), jnp.float32),
-            "ln2_scale": jnp.ones((L, D), jnp.float32),
-            "wqkv": initn((L, D, 3 * D), D ** -0.5),
-            "wo": initn((L, D, D), (2 * D * L) ** -0.5),
-            "w1": initn((L, D, F), D ** -0.5),
-            "w2": initn((L, F, D), (2 * F * L) ** -0.5),
-        },
+        "layers": layers,
         "ln_f_scale": jnp.ones((D,), jnp.float32),
         "lm_head": initn((D, V), D ** -0.5),
     }
@@ -112,7 +126,7 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
         return flash_attention(q, k, v, causal=True)
     if cfg.attention == "full" or mesh is None:
         return full_attention(q, k, v, causal=True)
-    spec = P(cfg.axis_dp, cfg.axis_sp, cfg.axis_tp, None)
+    spec = resolve_spec(P(cfg.axis_dp, cfg.axis_sp, cfg.axis_tp, None), mesh)
     impl = ring_attention if cfg.attention == "ring" else ulysses_attention
     fn = partial(impl, axis=cfg.axis_sp, causal=True)
     return jax.shard_map(
@@ -120,10 +134,73 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
     )(q, k, v)
 
 
+def _moe_block(h, lp, cfg: TransformerConfig, mesh):
+    """Switch-style MoE MLP: top-1 routed experts over the ep axis
+    (parallel/moe.py). Returns (out, aux_loss)."""
+    from hpc_patterns_tpu.parallel import moe
+
+    B, T, D = h.shape
+    if mesh is None:
+        cap = moe.default_capacity(B * T, cfg.n_experts, cfg.capacity_factor)
+        y, aux = moe.moe_dense(
+            h.reshape(B * T, D), lp["router"], lp["w1"], lp["w2"], capacity=cap
+        )
+        return y.reshape(B, T, D), aux
+
+    dp, sp, ep = cfg.axis_dp, cfg.axis_sp, cfg.axis_ep
+    # tokens shard over BOTH dp and ep for the MoE block: ep must
+    # partition the routing/FFN work, not replicate it (the reshard in
+    # and out is XLA's, riding ICI). When the batch doesn't divide
+    # dp*ep, fall back to dp-only token sharding (ep still partitions
+    # the experts; routing work is then replicated across ep).
+    batch_over_ep = B % (mesh_axis_size(mesh, dp) * mesh_axis_size(mesh, ep)) == 0
+    b_shards = mesh_axis_size(mesh, dp) * (
+        mesh_axis_size(mesh, ep) if batch_over_ep else 1
+    )
+    n_local = (B // b_shards) * (T // mesh_axis_size(mesh, sp))
+    cap = moe.default_capacity(n_local, cfg.n_experts, cfg.capacity_factor)
+
+    has = lambda ax: ax in mesh.axis_names
+
+    def local(hl, router, w1l, w2l):
+        b, t, d = hl.shape
+        if has(ep):
+            y, aux = moe.moe_ep(
+                hl.reshape(b * t, d), router, w1l, w2l,
+                axis=ep, capacity=cap,
+            )
+        else:  # no expert axis in this mesh: all experts local
+            y, aux = moe.moe_dense(
+                hl.reshape(b * t, d), router, w1l, w2l, capacity=cap
+            )
+        # moe_ep means aux over ep (as a comm axis); with tokens also
+        # sharded on ep, fold every data axis for the global scalar
+        for ax in (dp, sp):
+            if has(ax):
+                aux = lax.pmean(aux, ax)
+        return y.reshape(b, t, d), aux
+
+    tok_spec = (
+        resolve_spec(P((dp, ep), sp, None), mesh)
+        if has(ep) and batch_over_ep
+        else resolve_spec(P(dp, sp, None), mesh)
+    )
+    y, aux = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(tok_spec, P(None, None),
+                  resolve_spec(P(ep, None, None), mesh),
+                  resolve_spec(P(ep, None, None), mesh)),
+        out_specs=(tok_spec, P()),
+        check_vma=False,  # all_to_all + pmean replication not VMA-provable
+    )(h, lp["router"], lp["w1"], lp["w2"])
+    return y, aux
+
+
 def _layer(x, lp, cfg: TransformerConfig, mesh, act_spec):
-    """One pre-norm block: attn + mlp, Megatron-sharded (wqkv/w1 column,
-    wo/w2 row — models/sharding.py), activations re-constrained after
-    each collective-inducing matmul."""
+    """One pre-norm block: attn + mlp/moe, Megatron-sharded (wqkv/w1
+    column, wo/w2 row — models/sharding.py), activations re-constrained
+    after each collective-inducing matmul. Returns (x, moe_aux)."""
     B, T, D = x.shape
     H, Dh = cfg.n_heads, cfg.head_dim
     dt = x.dtype
@@ -142,22 +219,31 @@ def _layer(x, lp, cfg: TransformerConfig, mesh, act_spec):
     x = c(x + o, act_spec)
 
     h = _rmsnorm(x, lp["ln2_scale"])
-    h = jax.nn.gelu(jnp.dot(h, lp["w1"].astype(dt)))  # column-parallel
-    h = jnp.dot(h, lp["w2"].astype(dt))  # row-parallel (psum by XLA)
-    return c(x + h, act_spec)
+    if cfg.n_experts:
+        h, aux = _moe_block(h, lp, cfg, mesh)
+        h = h.astype(dt)
+    else:
+        h = jax.nn.gelu(jnp.dot(h, lp["w1"].astype(dt)))  # column-parallel
+        h = jnp.dot(h, lp["w2"].astype(dt))  # row-parallel (psum by XLA)
+        aux = jnp.zeros((), jnp.float32)
+    return c(x + h, act_spec), aux
 
 
-def forward(params, tokens, cfg: TransformerConfig, mesh=None):
+def forward(params, tokens, cfg: TransformerConfig, mesh=None, *,
+            return_aux: bool = False):
     """Logits for next-token prediction. ``tokens``: (batch, seq) int32.
     ``mesh``: the device mesh for sharding constraints + ring/ulysses
-    attention; None = single-device (tests/oracle)."""
+    attention; None = single-device (tests/oracle). With
+    ``return_aux=True`` also returns the summed MoE load-balance loss
+    (zeros for dense models)."""
     dt = jnp.dtype(cfg.dtype)
     B, T = tokens.shape
-    act_spec = (
-        jax.sharding.NamedSharding(mesh, P(cfg.axis_dp, cfg.axis_sp, None))
-        if mesh is not None
-        else None
-    )
+    if mesh is not None:
+        act_spec = jax.sharding.NamedSharding(
+            mesh, resolve_spec(P(cfg.axis_dp, cfg.axis_sp, None), mesh)
+        )
+    else:
+        act_spec = None
     x = params["embed"].astype(dt)[tokens] + params["pos_embed"].astype(dt)[:T]
     if mesh is not None:
         x = lax.with_sharding_constraint(x, act_spec)
@@ -167,12 +253,16 @@ def forward(params, tokens, cfg: TransformerConfig, mesh=None):
         layer = jax.checkpoint(layer)
 
     def scan_body(h, lp):
-        return layer(h, lp), None
+        h, aux = layer(h, lp)
+        return h, aux
 
-    x, _ = lax.scan(scan_body, x, params["layers"])
+    x, auxes = lax.scan(scan_body, x, params["layers"])
     x = _rmsnorm(x, params["ln_f_scale"])
     logits = jnp.dot(x, params["lm_head"].astype(dt))
-    return logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    if return_aux:
+        return logits, jnp.sum(auxes)
+    return logits
 
 
 def loss_fn(params, tokens, cfg: TransformerConfig, mesh=None):
@@ -183,10 +273,13 @@ def loss_fn(params, tokens, cfg: TransformerConfig, mesh=None):
     so sequence shardings (seq % sp == 0) survive into the activations.
     """
     B, T = tokens.shape
-    logits = forward(params, tokens, cfg, mesh)
+    logits, aux = forward(params, tokens, cfg, mesh, return_aux=True)
     targets = jnp.roll(tokens, -1, axis=1)
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     nll = logz - gold
     mask = (lax.broadcasted_iota(jnp.int32, (B, T), 1) < T - 1).astype(nll.dtype)
-    return jnp.sum(nll * mask) / jnp.sum(mask)
+    loss = jnp.sum(nll * mask) / jnp.sum(mask)
+    if cfg.n_experts:
+        loss = loss + cfg.moe_aux_weight * aux
+    return loss

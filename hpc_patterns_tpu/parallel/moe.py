@@ -1,0 +1,103 @@
+"""Mixture-of-experts with expert parallelism (EP) over a mesh axis.
+
+Completes the parallelism menu of SURVEY.md §2.2 (EP listed as a
+strategy the ring/pt2pt/collective primitives must be shaped for). The
+communication pattern is the ``MPI_Alltoall`` the comm layer already
+exposes (collectives.all_to_all — the same primitive as Ulysses): each
+rank owns E/P experts; tokens are routed top-1 (Switch style), packed
+into fixed ``capacity`` slots per (source rank, expert) — static shapes,
+the XLA ground rule — exchanged with one all-to-all each way, processed
+by the local experts' FFNs (batched einsum, MXU-shaped), and combined
+with the router gates.
+
+Drop semantics: tokens past an expert's per-source-rank capacity are
+dropped (output contribution zero), exactly as in the dense oracle
+:func:`moe_dense` with the same capacity — sharded and dense results are
+numerically identical per token shard, which is what the §4.2-style
+oracle test asserts.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from hpc_patterns_tpu.comm import collectives, ring
+
+
+def _dispatch_combine(x, router_w, n_experts: int, capacity: int):
+    """Top-1 routing tensors for local tokens x: (N, D).
+
+    Returns (dispatch (N, E, C) f32 0/1, combine (N, E, C) f32 gate,
+    aux_loss scalar). Position within an expert's capacity is assigned
+    in token order (cumsum), the Switch transformer formulation.
+    """
+    n = x.shape[0]
+    logits = jnp.dot(x.astype(jnp.float32), router_w.astype(jnp.float32))
+    gates = jax.nn.softmax(logits, axis=-1)  # (N, E)
+    expert = jnp.argmax(gates, axis=-1)  # (N,)
+    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)  # (N, E)
+    # slot index of each token within its expert (0-based, token order)
+    position = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # (N, E), -1 elsewhere
+    kept = onehot * (position < capacity)  # overflow dropped
+    pos_clamped = jnp.clip(position, 0, capacity - 1).astype(jnp.int32)
+    slot_onehot = jax.nn.one_hot(pos_clamped, capacity, dtype=jnp.float32)
+    dispatch = kept[..., None] * slot_onehot  # (N, E, C)
+    top_gate = jnp.sum(gates * onehot, axis=-1)  # (N,)
+    combine = dispatch * top_gate[:, None, None]
+    # Switch load-balancing auxiliary loss: E * sum_e f_e * P_e
+    f = onehot.mean(axis=0)
+    p = gates.mean(axis=0)
+    aux = n_experts * jnp.sum(f * p)
+    return dispatch, combine, aux
+
+
+def _expert_ffn(xin, w1, w2, activation=None):
+    """Batched per-expert FFN: xin (E, C, D), w1 (E, D, F), w2 (E, F, D)."""
+    act = activation or jax.nn.gelu
+    h = act(jnp.einsum("ecd,edf->ecf", xin, w1.astype(xin.dtype)))
+    return jnp.einsum("ecf,efd->ecd", h, w2.astype(xin.dtype))
+
+
+def default_capacity(n_tokens: int, n_experts: int,
+                     capacity_factor: float = 1.25) -> int:
+    return max(1, int(n_tokens * capacity_factor / n_experts))
+
+
+def moe_dense(x, router_w, w1, w2, *, capacity: int, activation=None):
+    """Single-device oracle: all E experts local. x: (N, D); w1: (E, D,
+    F); w2: (E, F, D). Returns (y (N, D), aux_loss)."""
+    E = w1.shape[0]
+    dispatch, combine, aux = _dispatch_combine(x, router_w, E, capacity)
+    # routing math stays f32; dispatch/FFN run in x's (MXU-native) dtype
+    xin = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), x)
+    out = _expert_ffn(xin, w1, w2, activation)
+    y = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), out)
+    return y.astype(x.dtype), aux
+
+
+def moe_ep(x, router_w, w1_local, w2_local, *, axis: str, capacity: int,
+           activation=None):
+    """Expert-parallel MoE layer (rank-local; run inside ``shard_map``).
+
+    ``x``: (N_local, D) this rank's tokens. ``w1_local``/``w2_local``:
+    (E/P, D, F)/(E/P, F, D) — this rank's expert shard. ``router_w``:
+    (D, E) replicated. Two all-to-alls move (tokens→experts→tokens),
+    riding ICI like every other collective in the framework (§2.3).
+    Per-token results equal :func:`moe_dense` on the same token shard
+    with the same capacity.
+    """
+    P = ring.axis_size(axis)
+    e_local = w1_local.shape[0]
+    E = e_local * P
+    dispatch, combine, aux = _dispatch_combine(x, router_w, E, capacity)
+    xin = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), x)  # (E, C, D)
+    # tokens to their experts' owners: (E, C, D) -> (E/P, P*C, D)
+    xin = collectives.all_to_all(xin, axis, split_axis=0, concat_axis=1)
+    out = _expert_ffn(xin, w1_local, w2_local, activation)
+    # results back to the tokens' owners: (E/P, P*C, D) -> (E, C, D)
+    out = collectives.all_to_all(out, axis, split_axis=1, concat_axis=0)
+    y = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), out)
+    # aux is per-shard; average across ranks for a global scalar
+    aux = collectives.allreduce(aux, axis, "mean")
+    return y.astype(x.dtype), aux
