@@ -22,6 +22,7 @@ dataflow, and :mod:`hpc_patterns_tpu.parallel.ring_attention` builds on
 
 from __future__ import annotations
 
+from collections import Counter
 from typing import Callable, Sequence
 
 import jax
@@ -45,6 +46,44 @@ def _ring_perm(size: int, shift: int) -> list[tuple[int, int]]:
     return [(i, (i + shift) % size) for i in range(size)]
 
 
+def check_permutation(pairs, size: int, *, allow_partial: bool = False) -> None:
+    """Deadlock/race sanitizer for ppermute schedules.
+
+    The reference avoids deadlock *by construction* (even/odd blocking
+    ordering, allreduce-mpi-sycl.cpp:50-58) and has no checker
+    (SURVEY.md §5 "race detection: None"). ppermute is deadlock-free by
+    design, but a malformed permutation silently drops or duplicates
+    data (XLA zero-fills destinations with no incoming pair); this
+    closes that gap: indices in range, no rank twice as source or
+    destination, and — unless ``allow_partial`` — every rank exactly
+    once as both (a true permutation). Raises ValueError. O(n).
+    """
+    srcs, dsts = [], []
+    for s, d in pairs:
+        if not (0 <= s < size and 0 <= d < size):
+            raise ValueError(f"pair ({s}, {d}) out of range for size {size}")
+        srcs.append(s)
+        dsts.append(d)
+    by_name = (("sources", srcs), ("destinations", dsts))
+    for name, idxs in by_name:
+        counts = Counter(idxs)
+        dups = sorted(x for x, c in counts.items() if c > 1)
+        if dups:
+            raise ValueError(
+                f"malformed permutation: duplicate {name} {dups} — data "
+                "would be dropped/duplicated"
+            )
+    if not allow_partial:
+        for name, idxs in by_name:
+            missing = sorted(set(range(size)) - set(idxs))
+            if missing:
+                raise ValueError(
+                    f"partial permutation: ranks {missing} missing from "
+                    f"{name} — ppermute would zero-fill their buffers "
+                    "(pass allow_partial=True if intended)"
+                )
+
+
 def ring_shift(x, axis: str, shift: int = 1):
     """Shift local data ``shift`` ranks around the ring.
 
@@ -55,7 +94,9 @@ def ring_shift(x, axis: str, shift: int = 1):
     ordering (:50-58).
     """
     size = lax.axis_size(axis)
-    return lax.ppermute(x, axis, _ring_perm(size, shift))
+    perm = _ring_perm(size, shift)
+    check_permutation(perm, size)
+    return lax.ppermute(x, axis, perm)
 
 
 def pairwise_exchange(x, axis: str):

@@ -49,7 +49,7 @@ def param_shardings(mesh: Mesh, cfg: TransformerConfig):
     """NamedSharding pytree for params (pass as jit in_shardings /
     device_put target)."""
     return jax.tree.map(
-        lambda spec: NamedSharding(mesh, resolve_spec(spec, mesh)),
+        lambda spec: NamedSharding(mesh, resolve_spec(spec, mesh, cfg.mesh_axes)),
         param_specs(cfg),
         is_leaf=lambda x: isinstance(x, P),
     )
@@ -59,7 +59,9 @@ def batch_sharding(mesh: Mesh, cfg: TransformerConfig) -> NamedSharding:
     """Tokens (batch, seq): batch over dp, sequence over sp — the rank→
     data map, ≙ the reference's rank→device policies (devices.hpp:22-59)
     lifted to arrays."""
-    return NamedSharding(mesh, resolve_spec(P(cfg.axis_dp, cfg.axis_sp), mesh))
+    return NamedSharding(
+        mesh, resolve_spec(P(cfg.axis_dp, cfg.axis_sp), mesh, cfg.mesh_axes)
+    )
 
 
 def shard_params(params, mesh: Mesh, cfg: TransformerConfig):

@@ -9,18 +9,33 @@ from __future__ import annotations
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def resolve_spec(spec: P, mesh: Mesh) -> P:
+def resolve_spec(spec: P, mesh: Mesh, allowed: set | None = None) -> P:
     """Drop spec axes the mesh doesn't have (→ replicated on that dim),
     so one rule table serves every mesh shape — a dp-only mesh simply
     replicates the tp/ep-sharded dims, the reference's fallback-to-
     whole-device philosophy (devices.hpp:33-38). Tuple entries (axis
-    groups like ``(dp, ep)``) keep only their present members."""
+    groups like ``(dp, ep)``) keep only their present members.
+
+    ``allowed``: the axis names pruning is legitimate for (the model
+    config's dp/sp/tp/ep set). An absent axis NOT in ``allowed`` is a
+    misconfiguration (e.g. a mesh named {"data", "model"} with default
+    cfg axis names) and raises instead of silently replicating.
+    """
 
     def fix(ax):
         if isinstance(ax, tuple):
-            kept = tuple(a for a in ax if a in mesh.axis_names)
+            kept = tuple(fix(a) for a in ax)
+            kept = tuple(a for a in kept if a is not None)
             return kept if len(kept) > 1 else (kept[0] if kept else None)
-        return ax if ax is None or ax in mesh.axis_names else None
+        if ax is None or ax in mesh.axis_names:
+            return ax
+        if allowed is not None and ax not in allowed:
+            raise ValueError(
+                f"spec axis {ax!r} is neither in the mesh "
+                f"{mesh.axis_names} nor a declared model axis "
+                f"{sorted(allowed)} — axis-name mismatch?"
+            )
+        return None
 
     return P(*(fix(ax) for ax in spec))
 

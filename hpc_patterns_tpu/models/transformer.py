@@ -61,6 +61,11 @@ class TransformerConfig:
     axis_ep: str = "ep"
 
     @property
+    def mesh_axes(self) -> frozenset:
+        """Declared axis names — the set resolve_spec may prune."""
+        return frozenset((self.axis_dp, self.axis_sp, self.axis_tp, self.axis_ep))
+
+    @property
     def head_dim(self) -> int:
         if self.d_model % self.n_heads:
             raise ValueError(f"d_model {self.d_model} % n_heads {self.n_heads} != 0")
@@ -126,7 +131,8 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
         return flash_attention(q, k, v, causal=True)
     if cfg.attention == "full" or mesh is None:
         return full_attention(q, k, v, causal=True)
-    spec = resolve_spec(P(cfg.axis_dp, cfg.axis_sp, cfg.axis_tp, None), mesh)
+    spec = resolve_spec(P(cfg.axis_dp, cfg.axis_sp, cfg.axis_tp, None), mesh,
+                        cfg.mesh_axes)
     impl = ring_attention if cfg.attention == "ring" else ulysses_attention
     fn = partial(impl, axis=cfg.axis_sp, causal=True)
     return jax.shard_map(
@@ -181,16 +187,16 @@ def _moe_block(h, lp, cfg: TransformerConfig, mesh):
         return y.reshape(b, t, d), aux
 
     tok_spec = (
-        resolve_spec(P((dp, ep), sp, None), mesh)
+        resolve_spec(P((dp, ep), sp, None), mesh, cfg.mesh_axes)
         if has(ep) and batch_over_ep
-        else resolve_spec(P(dp, sp, None), mesh)
+        else resolve_spec(P(dp, sp, None), mesh, cfg.mesh_axes)
     )
     y, aux = jax.shard_map(
         local,
         mesh=mesh,
         in_specs=(tok_spec, P(None, None),
-                  resolve_spec(P(ep, None, None), mesh),
-                  resolve_spec(P(ep, None, None), mesh)),
+                  resolve_spec(P(ep, None, None), mesh, cfg.mesh_axes),
+                  resolve_spec(P(ep, None, None), mesh, cfg.mesh_axes)),
         out_specs=(tok_spec, P()),
         check_vma=False,  # all_to_all + pmean replication not VMA-provable
     )(h, lp["router"], lp["w1"], lp["w2"])
@@ -240,7 +246,8 @@ def forward(params, tokens, cfg: TransformerConfig, mesh=None, *,
     B, T = tokens.shape
     if mesh is not None:
         act_spec = jax.sharding.NamedSharding(
-            mesh, resolve_spec(P(cfg.axis_dp, cfg.axis_sp, None), mesh)
+            mesh, resolve_spec(P(cfg.axis_dp, cfg.axis_sp, None), mesh,
+                               cfg.mesh_axes)
         )
     else:
         act_spec = None

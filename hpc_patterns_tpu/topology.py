@@ -133,6 +133,41 @@ def is_multihost() -> bool:
     return jax.process_count() > 1
 
 
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Multi-host runtime init — the ``MPI_Init`` analog (SURVEY.md §2.3:
+    ``jax.distributed.initialize`` replaces MPI_Init, mesh axes replace
+    communicators).
+
+    On TPU pods the arguments come from the environment automatically;
+    explicit args cover CPU/GPU clusters (coordinator address ≙ the
+    mpirun rendezvous). Idempotent: returns False when already
+    initialized or single-process (the reference's guard style,
+    allreduce-mpi-sycl.cpp:91-97), True when initialization happened.
+    """
+    explicit = any(
+        a is not None for a in (coordinator_address, num_processes, process_id)
+    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return True
+    except (RuntimeError, ValueError):
+        if explicit:
+            # the operator asked for a specific rendezvous: a failure is
+            # a real failure (N silent single-host copies otherwise)
+            raise
+        # nothing discoverable from the environment — the common
+        # dev-box case; callers proceed single-host
+        return False
+
+
 @dataclasses.dataclass(frozen=True)
 class TopologyInfo:
     """A summary of the visible device topology (for logs and verdicts)."""

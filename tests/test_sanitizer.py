@@ -1,0 +1,41 @@
+"""Sanitizer + multi-host init tests."""
+
+import pytest
+
+from hpc_patterns_tpu import topology
+from hpc_patterns_tpu.comm.ring import _ring_perm, check_permutation
+
+
+class TestPermutationSanitizer:
+    def test_valid_rings_pass(self):
+        for size in (1, 2, 8):
+            for shift in (1, -1, 3):
+                check_permutation(_ring_perm(size, shift), size)
+
+    def test_duplicate_destination_rejected(self):
+        with pytest.raises(ValueError, match="duplicate destinations"):
+            check_permutation([(0, 1), (1, 1)], 4)
+
+    def test_duplicate_source_rejected(self):
+        with pytest.raises(ValueError, match="duplicate sources"):
+            check_permutation([(0, 1), (0, 2)], 4)
+
+    def test_out_of_range_rejected(self):
+        with pytest.raises(ValueError, match="out of range"):
+            check_permutation([(0, 7)], 4, allow_partial=True)
+
+    def test_partial_permutation_rejected(self):
+        # the silent-drop case: ranks with no incoming pair get zeros
+        with pytest.raises(ValueError, match="partial permutation"):
+            check_permutation([(0, 1), (1, 2), (2, 3)], 4)
+
+    def test_partial_allowed_when_opted_in(self):
+        check_permutation([(0, 1), (1, 2), (2, 3)], 4, allow_partial=True)
+
+
+class TestInitDistributed:
+    def test_single_process_is_noop(self):
+        # CPU test env is single-process; init must not raise and must
+        # report that no multi-host initialization happened
+        assert topology.init_distributed() is False
+        assert topology.is_multihost() is False
