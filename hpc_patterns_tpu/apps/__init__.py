@@ -5,13 +5,15 @@ Each app is a self-validating benchmark in the reference's sense (§4):
 it measures its own claim, validates against an analytic oracle where one
 exists, prints grep-able SUCCESS/FAILURE lines, and exits 0/1.
 
-| reference binary                      | app module               |
+| reference binary / config             | app module               |
 |---------------------------------------|--------------------------|
 | allreduce-mpi-sycl / -omp-offload     | ``allreduce_app``        |
 | (BASELINE.json pt2pt ping-pong)       | ``pingpong_app``         |
 | sycl_con / omp_con / omp_con_meta     | ``concurrency_app``      |
 | concurency/run.sh                     | ``sweep``                |
 | interop_omp_ze_sycl                   | ``interop_app``          |
+| (BASELINE.json halo-exchange stencil) | ``stencil_app``          |
+| (flagship model, beyond parity)       | ``train_app``            |
 
 Run any app as ``python -m hpc_patterns_tpu.apps.<name> --help``.
 """

@@ -47,6 +47,9 @@ def build_parser():
                    help="expert-parallel axis (requires --n-experts)")
     p.add_argument("--n-experts", type=int, default=0,
                    help="MoE experts per layer (0 = dense MLP)")
+    p.add_argument("--prefetch", type=int, default=0, metavar="DEPTH",
+                   help="stream fresh synthetic batches through the async "
+                        "prefetch loader (0 = one static batch)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--resume-check", action="store_true",
                    help="save+restore mid-run and verify identical losses")
@@ -55,6 +58,10 @@ def build_parser():
 
 def run(args) -> int:
     log = RunLog(args.log, truncate=not args.log_append)
+    if args.prefetch < 0:
+        log.print(f"ERROR: --prefetch must be >= 0, got {args.prefetch}")
+        log.print("FAILURE")
+        return 1
     if args.ep > 1 and not args.n_experts:
         log.print("ERROR: --ep requires --n-experts")
         log.print("FAILURE")
@@ -88,20 +95,40 @@ def run(args) -> int:
     step_fn = make_train_step(cfg, mesh)
     tokens = make_batch(jax.random.PRNGKey(1), cfg, args.batch, args.seq, mesh)
 
+    if args.prefetch:
+        from hpc_patterns_tpu.models.sharding import batch_sharding
+        from hpc_patterns_tpu.utils.data import PrefetchLoader, synthetic_tokens
+
+        if mesh is not None:
+            sharding = batch_sharding(mesh, cfg)
+            place = lambda b: jax.device_put(b, sharding)
+        else:
+            place = jax.device_put
+        batch_iter = iter(PrefetchLoader(
+            synthetic_tokens(jax.random.PRNGKey(1), batch=args.batch,
+                             seq=args.seq, vocab=cfg.vocab, steps=args.steps),
+            depth=args.prefetch, place=place,
+        ))
+    else:
+        batch_iter = None
+
     losses = []
     t_steps = []
     ckpt_path = None
     for i in range(args.steps):
         t0 = time.perf_counter()
-        loss, params, opt_state = step_fn(params, opt_state, tokens)
+        batch = next(batch_iter) if batch_iter is not None else tokens
+        loss, params, opt_state = step_fn(params, opt_state, batch)
         loss_val = float(loss)  # blocks: readback is the completion fence
         t_steps.append(time.perf_counter() - t0)
         losses.append(loss_val)
         log.emit(kind="step", step=i, loss=loss_val, dt_s=t_steps[-1])
 
     finite = all(l == l and abs(l) != float("inf") for l in losses)
-    # a 1-step run has nothing to compare — finiteness is its check
-    learned = args.steps < 2 or losses[-1] < losses[0]
+    # a 1-step run has nothing to compare, and with --prefetch each step
+    # sees a fresh i.i.d. batch (loss noise can exceed a few steps of
+    # progress) — finiteness is the check in those modes
+    learned = args.steps < 2 or bool(args.prefetch) or losses[-1] < losses[0]
 
     resume_ok = True
     if args.resume_check:
@@ -114,8 +141,9 @@ def run(args) -> int:
         ckdir = args.checkpoint_dir or tempfile.mkdtemp(prefix="hpcpat_ckpt_")
         ckpt_path = save_checkpoint(ckdir, params, opt_state, step=args.steps)
         r_params, r_opt, r_step = restore_checkpoint(ckdir, params, opt_state)
-        loss_a, *_ = step_fn(params, opt_state, tokens)
-        loss_b, *_ = step_fn(r_params, r_opt, tokens)
+        check_batch = tokens
+        loss_a, *_ = step_fn(params, opt_state, check_batch)
+        loss_b, *_ = step_fn(r_params, r_opt, check_batch)
         resume_ok = float(loss_a) == float(loss_b) and r_step == args.steps
         log.print(f"resume-check: saved {ckpt_path}, losses "
                   f"{float(loss_a):.6f} vs {float(loss_b):.6f}")

@@ -8,3 +8,4 @@ state (checkpoint.py).
 """
 
 from hpc_patterns_tpu.utils.checkpoint import save_checkpoint, restore_checkpoint  # noqa: F401
+from hpc_patterns_tpu.utils.data import PrefetchLoader, synthetic_tokens  # noqa: F401
