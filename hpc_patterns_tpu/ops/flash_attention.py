@@ -85,9 +85,40 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
 
 
 @functools.partial(
-    jax.jit,
-    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
 )
+def _flash_with_vjp(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k,
+                          interpret=interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal=causal, scale=scale,
+                         block_q=block_q, block_k=block_k,
+                         interpret=interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
+    # Recompute-based backward: the kernel and the dense formula compute
+    # the same function, so differentiating the dense math on the saved
+    # inputs gives exact gradients. Costs the O(T^2) score matrix in the
+    # bwd only (the fwd stays O(block)); a Pallas bwd kernel is the
+    # future upgrade (see pallas_guide "Patterns: Custom VJP").
+    from hpc_patterns_tpu.parallel.ring_attention import full_attention
+
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q, k, v: full_attention(q, k, v, causal=causal, scale=scale),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash_with_vjp.defvjp(_flash_fwd, _flash_bwd)
+
+
 def flash_attention(
     q,
     k,
@@ -104,8 +135,27 @@ def flash_attention(
     Numerically equal to parallel.ring_attention.full_attention (the
     oracle in tests); O(block) VMEM instead of the (T, T) score matrix.
     Sequence length must divide by the block sizes (pad upstream — the
-    model keeps T a multiple of 128).
+    model keeps T a multiple of 128). Differentiable: custom VJP with a
+    recompute-from-inputs backward.
     """
+    return _flash_with_vjp(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+)
+def _flash_forward(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+):
     if q.ndim != 4:
         raise ValueError(f"want (batch, seq, heads, head_dim), got {q.shape}")
     B, T, H, D = q.shape

@@ -1,0 +1,114 @@
+// Native sweep driver — the C++ face of the benchmark harness.
+//
+// The reference's batch runner is a bash script that executes each
+// configuration, tees a log, and greps a SUCCESS/FAILURE summary
+// (concurency/run.sh:4-18); its build harness registers binaries as
+// CTest cases (src/CMakeLists.txt:39-50). This driver is both, in one
+// native tool: it runs benchmark commands (each a framework app), then
+// parses the shared JSONL run log (harness/runlog.py format) and exits
+// 0 iff at least one result record exists and none failed — usable as
+// the single test entry point from any CI, no Python wrapper needed.
+// When --run commands are given the log is truncated first, so each
+// sweep's verdict covers exactly that sweep's records.
+//
+// Usage:
+//   hpcpat-sweep --log run.jsonl [--] CMD...   # run CMD (one per --run)
+//   hpcpat-sweep --log run.jsonl               # parse/summarize only
+// Each --run argument is executed via the shell, in order, before the
+// log is parsed. Exit: 0 all SUCCESS, 1 any FAILURE or a command error.
+
+#include <sys/wait.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <string>
+#include <vector>
+
+namespace {
+
+// Minimal JSONL scan: a result record is a line containing
+// "kind": "result"; its verdict is the value of "success". This parses
+// exactly what runlog.py emits (flat JSON objects, one per line).
+bool line_has(const std::string& line, const char* key, const char* value) {
+  std::string pat = std::string("\"") + key + "\": " + value;
+  if (line.find(pat) != std::string::npos) return true;
+  pat = std::string("\"") + key + "\":" + value;  // no-space variant
+  return line.find(pat) != std::string::npos;
+}
+
+bool line_has_str(const std::string& line, const char* key, const char* value) {
+  std::string pat = std::string("\"") + key + "\": \"" + value + "\"";
+  if (line.find(pat) != std::string::npos) return true;
+  pat = std::string("\"") + key + "\":\"" + value + "\"";
+  return line.find(pat) != std::string::npos;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string log_path;
+  std::vector<std::string> commands;
+  for (int i = 1; i < argc; ++i) {
+    if (std::strcmp(argv[i], "--log") == 0 && i + 1 < argc) {
+      log_path = argv[++i];
+    } else if (std::strcmp(argv[i], "--run") == 0 && i + 1 < argc) {
+      commands.emplace_back(argv[++i]);
+    } else if (std::strcmp(argv[i], "--help") == 0) {
+      std::printf(
+          "usage: %s --log FILE [--run CMD]...\n"
+          "runs each CMD, then summarizes FILE (JSONL run log): exit 0 iff "
+          "at least one result record exists and every one has "
+          "\"success\": true\n",
+          argv[0]);
+      return 0;
+    } else {
+      std::fprintf(stderr, "unknown argument: %s (see --help)\n", argv[i]);
+      return 2;
+    }
+  }
+  if (log_path.empty()) {
+    std::fprintf(stderr, "--log FILE is required\n");
+    return 2;
+  }
+
+  bool ran_ok = true;
+  if (!commands.empty()) {
+    // Fresh log per sweep: apps opened with --log-append would otherwise
+    // count stale records from a previous run, and apps that truncate
+    // would silently drop earlier commands' FAILURE records.
+    std::ofstream(log_path, std::ios::trunc);
+  }
+  for (const auto& cmd : commands) {
+    std::printf("=== %s ===\n", cmd.c_str());
+    std::fflush(stdout);
+    int rc = std::system(cmd.c_str());
+    if (rc != 0) {
+      int code = WIFEXITED(rc) ? WEXITSTATUS(rc) : -1;
+      std::printf("command exited with %d\n", code);
+      ran_ok = false;  // still parse the log: the verdict lines matter
+    }
+  }
+
+  std::ifstream in(log_path);
+  if (!in) {
+    std::fprintf(stderr, "cannot open log %s\n", log_path.c_str());
+    return 2;
+  }
+  long ok = 0, bad = 0;
+  std::string line;
+  while (std::getline(in, line)) {
+    if (!line_has_str(line, "kind", "result")) continue;
+    if (line_has(line, "success", "true")) {
+      ++ok;
+    } else if (line_has(line, "success", "false")) {
+      ++bad;
+    }
+  }
+  // the grep-able contract of run.sh:17-18
+  std::printf("SUCCESS count: %ld\n", ok);
+  std::printf("FAILURE count: %ld\n", bad);
+  return (bad == 0 && ran_ok && ok > 0) ? 0 : 1;
+}

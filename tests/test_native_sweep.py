@@ -1,0 +1,87 @@
+"""Native sweep driver (native/sweep.cpp) tests: build, parse, verdict."""
+
+import json
+import subprocess
+from pathlib import Path
+
+import pytest
+
+NATIVE = Path(__file__).resolve().parent.parent / "native"
+DRIVER = NATIVE / "hpcpat-sweep"
+
+
+@pytest.fixture(scope="module", autouse=True)
+def build_driver():
+    # always invoke make: its dependency tracking makes the no-op case
+    # free, and a stale binary after sweep.cpp edits would test old code
+    r = subprocess.run(["make", "-C", str(NATIVE), "hpcpat-sweep"],
+                       capture_output=True, timeout=120)
+    if r.returncode != 0:
+        pytest.skip(f"native build failed: {r.stderr.decode()[:200]}")
+
+
+def _write_log(path, records):
+    path.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+
+
+def _run(*args):
+    return subprocess.run([str(DRIVER), *args], capture_output=True,
+                          text=True, timeout=60)
+
+
+class TestNativeSweep:
+    def test_all_success_exits_zero(self, tmp_path):
+        log = tmp_path / "run.jsonl"
+        _write_log(log, [
+            {"kind": "result", "name": "a", "success": True},
+            {"kind": "step", "loss": 1.0},  # non-result lines ignored
+            {"kind": "result", "name": "b", "success": True},
+        ])
+        r = _run("--log", str(log))
+        assert r.returncode == 0, r.stdout
+        assert "SUCCESS count: 2" in r.stdout
+        assert "FAILURE count: 0" in r.stdout
+
+    def test_any_failure_exits_one(self, tmp_path):
+        log = tmp_path / "run.jsonl"
+        _write_log(log, [
+            {"kind": "result", "name": "a", "success": True},
+            {"kind": "result", "name": "b", "success": False},
+        ])
+        r = _run("--log", str(log))
+        assert r.returncode == 1
+        assert "FAILURE count: 1" in r.stdout
+
+    def test_empty_log_is_failure(self, tmp_path):
+        log = tmp_path / "run.jsonl"
+        log.write_text("")
+        assert _run("--log", str(log)).returncode == 1
+
+    def test_runs_commands_before_parsing(self, tmp_path):
+        log = tmp_path / "run.jsonl"
+        record = json.dumps({"kind": "result", "name": "x", "success": True})
+        r = _run("--log", str(log), "--run", f"echo '{record}' > {log}")
+        assert r.returncode == 0, r.stdout
+        assert "SUCCESS count: 1" in r.stdout
+
+    def test_failing_command_fails_run(self, tmp_path):
+        log = tmp_path / "run.jsonl"
+        record = json.dumps({"kind": "result", "name": "a", "success": True})
+        r = _run("--log", str(log),
+                 "--run", f"echo '{record}' >> {log}",
+                 "--run", "false")
+        assert r.returncode == 1
+        assert "command exited with 1" in r.stdout  # decoded, not raw 256
+
+    def test_stale_log_truncated_before_run(self, tmp_path):
+        log = tmp_path / "run.jsonl"
+        _write_log(log, [{"kind": "result", "name": "stale", "success": False}])
+        record = json.dumps({"kind": "result", "name": "fresh", "success": True})
+        r = _run("--log", str(log), "--run", f"echo '{record}' >> {log}")
+        assert r.returncode == 0, r.stdout
+        assert "SUCCESS count: 1" in r.stdout
+        assert "FAILURE count: 0" in r.stdout
+
+    def test_missing_log_is_usage_error(self, tmp_path):
+        assert _run("--log", str(tmp_path / "nope.jsonl")).returncode == 2
+        assert _run().returncode == 2

@@ -42,6 +42,22 @@ class TestFlashAttention:
         want = full_attention(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_grad_matches_oracle(self, causal):
+        q, k, v = _qkv(jax.random.PRNGKey(3), B=1, T=64, H=2, D=16)
+
+        def loss_flash(q, k, v):
+            return flash_attention(q, k, v, causal=causal,
+                                   block_q=32, block_k=32).sum()
+
+        def loss_dense(q, k, v):
+            return full_attention(q, k, v, causal=causal).sum()
+
+        got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=2e-5)
+
     def test_model_flash_matches_full(self):
         from hpc_patterns_tpu.models import TransformerConfig, forward, init_params
 
