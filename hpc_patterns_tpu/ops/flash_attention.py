@@ -15,8 +15,10 @@ Standard flash-attention dataflow, TPU-shaped:
   f32)``; bf16 inputs stay bf16 into the MXU.
 - causal masking skips nothing but masks with a finite -1e30 (inf-free,
   like ring_attention), and whole K/V blocks strictly above the diagonal
-  are skipped via ``lax.cond`` on the block index — half the FLOPs for
-  causal.
+  are skipped via the loop bound — half the FLOPs for causal.
+- backward (Dao 2023 §B): Δ = rowsum(dO ⊙ O), then two blockwise passes
+  — dQ over K blocks, dK/dV over Q blocks — recomputing P from the
+  forward's saved per-row logsumexp. O(block) VMEM in both directions.
 
 Single-device kernel: under a mesh, distribute with
 parallel.ring_attention / ulysses and let each rank call this locally
@@ -36,9 +38,29 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
-            causal: bool):
-    # q_ref: (BLOCK_Q, D); k_ref/v_ref: (T, D); o_ref: (BLOCK_Q, D)
+def _causal_mask(s, q_start, k_start):
+    """Mask score block ``s`` so position (i, j) survives iff the global
+    key index k_start+j is at or before the global query index q_start+i.
+    Shared by the forward and both backward kernels — the mask must be
+    identical or the recomputed P diverges from the forward's."""
+    q_pos = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_pos = k_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(k_pos <= q_pos, s, _NEG_INF)
+
+
+def _kv_block_bound(q_start, block_q, block_k, n_kv, causal):
+    """Number of K/V blocks a query block must visit: all of them, or —
+    causal — only blocks starting at or before the query block's end
+    (strictly-above-diagonal blocks contribute nothing)."""
+    if not causal:
+        return n_kv
+    return jnp.minimum((q_start + block_q - 1) // block_k + 1, n_kv)
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *lse_ref, block_k: int,
+            scale: float, causal: bool):
+    # q_ref: (BLOCK_Q, D); k_ref/v_ref: (T, D); o_ref: (BLOCK_Q, D);
+    # optional lse_ref: (BLOCK_Q, 1) per-row logsumexp for the backward
     block_q, d = q_ref.shape
     t = k_ref.shape[0]
     n_kv = t // block_k
@@ -57,13 +79,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
         v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         if causal:
-            q_pos = q_start + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ki * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+            s = _causal_mask(s, q_start, ki * block_k)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         rescale = jnp.exp(m - m_new)
@@ -73,50 +89,173 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
         )
         return m_new, l_new, acc_new
 
-    if causal:
-        # K/V blocks strictly above the diagonal contribute nothing:
-        # walk only blocks with start <= q block end
-        last = (q_start + block_q - 1) // block_k + 1
-        n_iter = jnp.minimum(last, n_kv)
-    else:
-        n_iter = n_kv
+    n_iter = _kv_block_bound(q_start, block_q, block_k, n_kv, causal)
     m, l, acc = lax.fori_loop(0, n_iter, body, (m0, l0, acc0))
-    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l = jnp.maximum(l, 1e-30)
+    o_ref[:] = (acc / l).astype(o_ref.dtype)
+    if lse_ref:
+        lse_ref[0][:] = m + jnp.log(l)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               block_k: int, scale: float, causal: bool):
+    # One program per query block: walk K/V blocks, accumulate dQ.
+    # dS = P * (dO·Vᵀ − Δ); dQ = scale · dS·K, with P recomputed from the
+    # saved per-row logsumexp (no (T,T) matrix ever materialized).
+    block_q, d = q_ref.shape
+    t = k_ref.shape[0]
+    n_kv = t // block_k
+    qi = pl.program_id(1)
+    q_start = qi * block_q
+
+    q = q_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:]      # (BLOCK_Q, 1)
+    delta = delta_ref[:]  # (BLOCK_Q, 1)
+
+    def body(ki, dq):
+        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, q_start, ki * block_k)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    n_iter = _kv_block_bound(q_start, block_q, block_k, n_kv, causal)
+    dq = lax.fori_loop(0, n_iter, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                dk_ref, dv_ref, *, block_q: int, scale: float, causal: bool):
+    # One program per K/V block: walk query blocks, accumulate dK and dV.
+    # dV = Pᵀ·dO; dK = scale · dSᵀ·Q. Causal: query blocks strictly above
+    # this K block see none of it — start the walk at the diagonal.
+    block_k, d = k_ref.shape
+    t = q_ref.shape[0]
+    n_q = t // block_q
+    ki = pl.program_id(1)
+    k_start = ki * block_k
+
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+
+    def body(qi, state):
+        dk, dv = state
+        q_blk = q_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(qi * block_q, block_q), :]
+        delta = delta_ref[pl.ds(qi * block_q, block_q), :]
+        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi * block_q, k_start)
+        p = jnp.exp(s - lse)
+        dv_new = dv + jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_new = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    start = k_start // block_q if causal else 0
+    dk, dv = lax.fori_loop(
+        start, n_q, body,
+        (jnp.zeros((block_k, d), jnp.float32),
+         jnp.zeros((block_k, d), jnp.float32)),
+    )
+    dk_ref[:] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
 )
 def _flash_with_vjp(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal=causal, scale=scale,
-                          block_q=block_q, block_k=block_k,
-                          interpret=interpret)
+    out, _ = _flash_forward(q, k, v, causal=causal, scale=scale,
+                            block_q=block_q, block_k=block_k,
+                            interpret=interpret, with_residuals=False)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal=causal, scale=scale,
-                         block_q=block_q, block_k=block_k,
-                         interpret=interpret)
-    return out, (q, k, v)
+    # residuals stay in kernel layout (B·H, T, D) — the backward consumes
+    # them directly, so the fwd's transposes aren't repeated
+    out, residuals = _flash_forward(q, k, v, causal=causal, scale=scale,
+                                    block_q=block_q, block_k=block_k,
+                                    interpret=interpret, with_residuals=True)
+    return out, residuals
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
-    # Recompute-based backward: the kernel and the dense formula compute
-    # the same function, so differentiating the dense math on the saved
-    # inputs gives exact gradients. Costs the O(T^2) score matrix in the
-    # bwd only (the fwd stays O(block)); a Pallas bwd kernel is the
-    # future upgrade (see pallas_guide "Patterns: Custom VJP").
-    from hpc_patterns_tpu.parallel.ring_attention import full_attention
-
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q, k, v: full_attention(q, k, v, causal=causal, scale=scale),
-        q, k, v,
-    )
-    return vjp(g)
+    qr, kr, vr, outr, lse = residuals
+    return _flash_backward(qr, kr, vr, outr, lse, g, causal=causal,
+                           scale=scale, block_q=block_q, block_k=block_k,
+                           interpret=interpret)
 
 
 _flash_with_vjp.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+)
+def _flash_backward(
+    qr, kr, vr, outr, lse, g, *,
+    causal: bool,
+    scale: float | None,
+    block_q: int,
+    block_k: int,
+    interpret: bool | None,
+):
+    B, T, H, D = g.shape
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    dor = jnp.einsum("bthd->bhtd", g).reshape(B * H, T, D)
+    delta = jnp.sum(
+        dor.astype(jnp.float32) * outr.astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )  # (B·H, T, 1) — trailing unit dim keeps TPU block shapes legal
+
+    row = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    blk_q = row((None, block_q, D), lambda bh, i: (bh, i, 0))
+    blk_k = row((None, block_k, D), lambda bh, i: (bh, i, 0))
+    full = row((None, T, D), lambda bh, i: (bh, 0, 0))
+    vec_q = row((None, block_q, 1), lambda bh, i: (bh, i, 0))
+    vec_full = row((None, T, 1), lambda bh, i: (bh, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_k=block_k, scale=float(scale),
+                          causal=causal),
+        grid=(B * H, T // block_q),
+        in_specs=[blk_q, full, full, blk_q, vec_q, vec_q],
+        out_specs=blk_q,
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), qr.dtype),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=block_q, scale=float(scale),
+                          causal=causal),
+        grid=(B * H, T // block_k),
+        in_specs=[full, full, vec_full, vec_full, blk_k, blk_k],
+        out_specs=(blk_k, blk_k),
+        out_shape=(
+            jax.ShapeDtypeStruct((B * H, T, D), kr.dtype),
+            jax.ShapeDtypeStruct((B * H, T, D), vr.dtype),
+        ),
+        interpret=interpret,
+    )(qr, dor, lse, delta, kr, vr)
+
+    back = lambda x: x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    return back(dq), back(dk), back(dv)
 
 
 def flash_attention(
@@ -135,15 +274,18 @@ def flash_attention(
     Numerically equal to parallel.ring_attention.full_attention (the
     oracle in tests); O(block) VMEM instead of the (T, T) score matrix.
     Sequence length must divide by the block sizes (pad upstream — the
-    model keeps T a multiple of 128). Differentiable: custom VJP with a
-    recompute-from-inputs backward.
+    model keeps T a multiple of 128). Differentiable: custom VJP whose
+    backward is two blockwise Pallas kernels (dQ pass, dK/dV pass)
+    recomputing P from the forward's saved logsumexp — O(block) VMEM in
+    both directions.
     """
     return _flash_with_vjp(q, k, v, causal, scale, block_q, block_k, interpret)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret",
+                     "with_residuals"),
 )
 def _flash_forward(
     q,
@@ -155,6 +297,7 @@ def _flash_forward(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool | None = None,
+    with_residuals: bool = False,
 ):
     if q.ndim != 4:
         raise ValueError(f"want (batch, seq, heads, head_dim), got {q.shape}")
@@ -176,20 +319,30 @@ def _flash_forward(
     kernel = functools.partial(
         _kernel, block_k=block_k, scale=float(scale), causal=causal,
     )
-    out = pl.pallas_call(
+    blk_q = pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0),
+                         memory_space=pltpu.VMEM)
+    full = pl.BlockSpec((None, T, D), lambda bh, qi: (bh, 0, 0),
+                        memory_space=pltpu.VMEM)
+    out_specs = [blk_q]
+    out_shape = [jax.ShapeDtypeStruct((B * H, T, D), q.dtype)]
+    if with_residuals:
+        # the lse write is skipped entirely on the primal (inference) path
+        out_specs.append(
+            pl.BlockSpec((None, block_q, 1), lambda bh, qi: (bh, qi, 0),
+                         memory_space=pltpu.VMEM)
+        )
+        out_shape.append(jax.ShapeDtypeStruct((B * H, T, 1), jnp.float32))
+
+    results = pl.pallas_call(
         kernel,
         grid=(B * H, T // block_q),
-        in_specs=[
-            pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((None, T, D), lambda bh, qi: (bh, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((None, T, D), lambda bh, qi: (bh, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        in_specs=[blk_q, full, full],
+        out_specs=tuple(out_specs),
+        out_shape=tuple(out_shape),
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)  # -> (B, T, H, D)
+    outr = results[0]
+    out = outr.reshape(B, H, T, D).transpose(0, 2, 1, 3)  # -> (B, T, H, D)
+    if with_residuals:
+        return out, (qr, kr, vr, outr, results[1])
+    return out, None
