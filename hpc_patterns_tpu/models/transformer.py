@@ -7,10 +7,12 @@ Architecture choices driven by the hardware (SURVEY.md preamble +
   multiples of 128, no per-layer Python loop — layers are stacked on a
   leading axis and driven by ``lax.scan`` (one traced layer body);
 - attention is pluggable: ``"full"`` (single-device oracle),
-  ``"flash"`` (the Pallas blockwise kernel, ops/flash_attention.py),
+  ``"flash"`` (the Pallas blockwise kernel, ops/flash_attention.py —
+  single device, or any mesh that leaves the sequence unsharded),
   ``"ring"`` (context parallelism over the ``sp`` mesh axis — the
-  reference's ring dataflow, parallel/ring_attention.py), or
-  ``"ulysses"`` (all-to-all SP);
+  reference's ring dataflow, parallel/ring_attention.py),
+  ``"ring_flash"`` (the same ring with the Pallas kernel as each
+  step's local compute), or ``"ulysses"`` (all-to-all SP);
 - activation sharding is annotated with ``with_sharding_constraint``;
   parameter shardings live in models/sharding.py (Megatron column/row
   rules, ≙ parallel/tensor.py helpers);
@@ -35,7 +37,7 @@ from hpc_patterns_tpu.models.sharding_util import mesh_axis_size, resolve_spec
 from hpc_patterns_tpu.parallel.ring_attention import full_attention, ring_attention
 from hpc_patterns_tpu.parallel.ulysses import ulysses_attention
 
-ATTENTION_IMPLS = ("full", "flash", "ring", "ulysses")
+ATTENTION_IMPLS = ("full", "flash", "ring", "ring_flash", "ulysses")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,7 +49,7 @@ class TransformerConfig:
     d_ff: int = 3072
     max_seq: int = 2048
     dtype: str = "bfloat16"  # compute dtype (MXU-native)
-    attention: str = "full"  # full | flash | ring | ulysses
+    attention: str = "full"  # full | flash | ring | ring_flash | ulysses
     remat: bool = False
     # mixture-of-experts: 0 = dense MLP; >0 = Switch-style top-1 MoE
     # with experts sharded over the ep axis (parallel/moe.py)
@@ -120,21 +122,33 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
     rank-local kernels in ``shard_map`` over (dp, sp, tp) — sequence
     travels the ``sp`` ring while heads stay tensor-sharded."""
     if cfg.attention == "flash":
-        if mesh is not None:
-            raise ValueError(
-                "attention='flash' is the single-device kernel; distribute "
-                "with 'ring' or 'ulysses' on a mesh (each rank's local "
-                "compute can then use ops.flash_attention internally)"
-            )
         from hpc_patterns_tpu.ops import flash_attention
 
-        return flash_attention(q, k, v, causal=True)
+        if mesh is None:
+            return flash_attention(q, k, v, causal=True)
+        if mesh_axis_size(mesh, cfg.axis_sp) > 1:
+            raise ValueError(
+                "attention='flash' needs the sequence unsharded (sp=1); "
+                "use 'ring_flash' to run the Pallas kernel per ring step "
+                "over a sharded sequence"
+            )
+        # sequence unsharded: the kernel runs per-(dp, tp) shard on the
+        # full local sequence
+        spec = resolve_spec(P(cfg.axis_dp, None, cfg.axis_tp, None), mesh,
+                            cfg.mesh_axes)
+        return jax.shard_map(
+            partial(flash_attention, causal=True), mesh=mesh,
+            in_specs=(spec, spec, spec), out_specs=spec,
+        )(q, k, v)
     if cfg.attention == "full" or mesh is None:
         return full_attention(q, k, v, causal=True)
     spec = resolve_spec(P(cfg.axis_dp, cfg.axis_sp, cfg.axis_tp, None), mesh,
                         cfg.mesh_axes)
-    impl = ring_attention if cfg.attention == "ring" else ulysses_attention
-    fn = partial(impl, axis=cfg.axis_sp, causal=True)
+    if cfg.attention == "ulysses":
+        fn = partial(ulysses_attention, axis=cfg.axis_sp, causal=True)
+    else:
+        fn = partial(ring_attention, axis=cfg.axis_sp, causal=True,
+                     impl="flash" if cfg.attention == "ring_flash" else "dense")
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)

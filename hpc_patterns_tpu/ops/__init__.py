@@ -17,4 +17,7 @@ The concurrency suite's kernels (busy-wait, DMA/compute pipeline) stay
 in :mod:`hpc_patterns_tpu.concurrency` next to their benchmarks.
 """
 
-from hpc_patterns_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from hpc_patterns_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_attention_block,
+)

@@ -2,7 +2,7 @@
 
 Standard flash-attention dataflow, TPU-shaped:
 
-- grid = (batch·heads, T/BLOCK_Q): one program per query block per head;
+- grid = (batch·heads, Tq/BLOCK_Q): one program per query block per head;
   Pallas auto-pipelines each program's HBM→VMEM block loads against the
   previous program's compute (the same DMA/compute overlap the
   concurrency suite measures, here for free from the grid).
@@ -13,21 +13,33 @@ Standard flash-attention dataflow, TPU-shaped:
   runs this dataflow *across chips*).
 - block matmuls hit the MXU via ``jnp.dot(..., preferred_element_type=
   f32)``; bf16 inputs stay bf16 into the MXU.
-- causal masking skips nothing but masks with a finite -1e30 (inf-free,
-  like ring_attention), and whole K/V blocks strictly above the diagonal
-  are skipped via the loop bound — half the FLOPs for causal.
+- causal masking is in GLOBAL positions: the kernel takes (q_offset,
+  k_offset) scalars in SMEM, so the same kernel serves the single-device
+  case (offsets 0) and one ring-attention step (q at rank·T, the
+  visiting K/V block at src·S). Masked entries get a finite -1e30
+  (inf-free, like ring_attention); whole K/V blocks outside the causal
+  triangle are skipped via the (dynamic) loop bounds — a fully-future
+  block costs zero iterations.
 - backward (Dao 2023 §B): Δ = rowsum(dO ⊙ O), then two blockwise passes
   — dQ over K blocks, dK/dV over Q blocks — recomputing P from the
   forward's saved per-row logsumexp. O(block) VMEM in both directions.
 
-Single-device kernel: under a mesh, distribute with
-parallel.ring_attention / ulysses and let each rank call this locally
-(mesh=None path of models.transformer).
+Two public entry points:
+
+- :func:`flash_attention` — full softmax attention, square (Tq == Tk),
+  offsets 0. Drop-in equal to parallel.ring_attention.full_attention.
+- :func:`flash_attention_block` — one *partial* attention over a K/V
+  block at a global offset, returning (out, lse) so partial results
+  merge by logsumexp (parallel/ring_attention's flash path does this
+  per ring step). Differentiable in q, k, v AND through lse: the lse
+  cotangent folds into Δ (d lse/d s = P, so ds = P∘(dP − Δ + ḡ_lse)).
 """
 
 from __future__ import annotations
 
 import functools
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -42,36 +54,42 @@ def _causal_mask(s, q_start, k_start):
     """Mask score block ``s`` so position (i, j) survives iff the global
     key index k_start+j is at or before the global query index q_start+i.
     Shared by the forward and both backward kernels — the mask must be
-    identical or the recomputed P diverges from the forward's."""
+    identical or the recomputed P diverges from the forward's. Offsets
+    may be traced (dynamic) values."""
     q_pos = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
     k_pos = k_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
     return jnp.where(k_pos <= q_pos, s, _NEG_INF)
 
 
-def _kv_block_bound(q_start, block_q, block_k, n_kv, causal):
-    """Number of K/V blocks a query block must visit: all of them, or —
-    causal — only blocks starting at or before the query block's end
-    (strictly-above-diagonal blocks contribute nothing)."""
-    if not causal:
-        return n_kv
-    return jnp.minimum((q_start + block_q - 1) // block_k + 1, n_kv)
+def _kv_block_bound(q_end_g, k_off, block_k, n_kv):
+    """Number of leading K/V blocks a query block must visit under the
+    causal mask: those starting at or before the query block's global
+    end. 0 when the whole K/V side is in the future."""
+    return jnp.clip((q_end_g - k_off) // block_k + 1, 0, n_kv)
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *lse_ref, block_k: int,
+def _q_block_start(k_start_g, q_off, block_q, n_q):
+    """First query block (index) that can see a K block starting at
+    global position ``k_start_g`` under the causal mask; n_q when none."""
+    return jnp.clip((k_start_g - q_off) // block_q, 0, n_q)
+
+
+def _kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, *lse_ref, block_k: int,
             scale: float, causal: bool):
-    # q_ref: (BLOCK_Q, D); k_ref/v_ref: (T, D); o_ref: (BLOCK_Q, D);
+    # offs_ref: (1, 2) int32 SMEM [q_offset, k_offset] global positions;
+    # q_ref: (BLOCK_Q, D); k_ref/v_ref: (Tk, D); o_ref: (BLOCK_Q, D);
     # optional lse_ref: (BLOCK_Q, 1) per-row logsumexp for the backward
     block_q, d = q_ref.shape
-    t = k_ref.shape[0]
-    n_kv = t // block_k
+    tk = k_ref.shape[0]
+    n_kv = tk // block_k
     qi = pl.program_id(1)
     q = q_ref[:].astype(jnp.float32) * scale
+    q_start_g = offs_ref[0, 0] + qi * block_q
+    k_off = offs_ref[0, 1]
 
     m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
-
-    q_start = qi * block_q
 
     def body(ki, state):
         m, l, acc = state
@@ -79,7 +97,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *lse_ref, block_k: int,
         v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         if causal:
-            s = _causal_mask(s, q_start, ki * block_k)
+            s = _causal_mask(s, q_start_g, k_off + ki * block_k)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         rescale = jnp.exp(m - m_new)
@@ -89,24 +107,31 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *lse_ref, block_k: int,
         )
         return m_new, l_new, acc_new
 
-    n_iter = _kv_block_bound(q_start, block_q, block_k, n_kv, causal)
+    n_iter = (_kv_block_bound(q_start_g + block_q - 1, k_off, block_k, n_kv)
+              if causal else n_kv)
     m, l, acc = lax.fori_loop(0, n_iter, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
-    o_ref[:] = (acc / l).astype(o_ref.dtype)
+    out = acc / l
+    if causal:
+        # rows with nothing visible (m never rose): out 0, lse -> -1e30,
+        # matching _dense_forward — not an average of whatever was visited
+        out = jnp.where(m <= _NEG_INF * 0.5, 0.0, out)
+    o_ref[:] = out.astype(o_ref.dtype)
     if lse_ref:
         lse_ref[0][:] = m + jnp.log(l)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               block_k: int, scale: float, causal: bool):
+def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, *, block_k: int, scale: float, causal: bool):
     # One program per query block: walk K/V blocks, accumulate dQ.
     # dS = P * (dO·Vᵀ − Δ); dQ = scale · dS·K, with P recomputed from the
     # saved per-row logsumexp (no (T,T) matrix ever materialized).
     block_q, d = q_ref.shape
-    t = k_ref.shape[0]
-    n_kv = t // block_k
+    tk = k_ref.shape[0]
+    n_kv = tk // block_k
     qi = pl.program_id(1)
-    q_start = qi * block_q
+    q_start_g = offs_ref[0, 0] + qi * block_q
+    k_off = offs_ref[0, 1]
 
     q = q_ref[:].astype(jnp.float32)
     do = do_ref[:].astype(jnp.float32)
@@ -118,27 +143,33 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, q_start, ki * block_k)
+            s = _causal_mask(s, q_start_g, k_off + ki * block_k)
         p = jnp.exp(s - lse)
+        if causal:
+            # dead rows have lse=-1e30, where exp(s - lse) = 1 on masked
+            # entries; match _dense_backward's explicit zero
+            p = jnp.where(s > _NEG_INF * 0.5, p, 0.0)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
         return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
 
-    n_iter = _kv_block_bound(q_start, block_q, block_k, n_kv, causal)
+    n_iter = (_kv_block_bound(q_start_g + block_q - 1, k_off, block_k, n_kv)
+              if causal else n_kv)
     dq = lax.fori_loop(0, n_iter, body, jnp.zeros((block_q, d), jnp.float32))
     dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+def _dkv_kernel(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
                 dk_ref, dv_ref, *, block_q: int, scale: float, causal: bool):
     # One program per K/V block: walk query blocks, accumulate dK and dV.
-    # dV = Pᵀ·dO; dK = scale · dSᵀ·Q. Causal: query blocks strictly above
+    # dV = Pᵀ·dO; dK = scale · dSᵀ·Q. Causal: query blocks strictly before
     # this K block see none of it — start the walk at the diagonal.
     block_k, d = k_ref.shape
-    t = q_ref.shape[0]
-    n_q = t // block_q
+    tq = q_ref.shape[0]
+    n_q = tq // block_q
     ki = pl.program_id(1)
-    k_start = ki * block_k
+    q_off = offs_ref[0, 0]
+    k_start_g = offs_ref[0, 1] + ki * block_k
 
     k = k_ref[:].astype(jnp.float32)
     v = v_ref[:].astype(jnp.float32)
@@ -151,15 +182,17 @@ def _dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         delta = delta_ref[pl.ds(qi * block_q, block_q), :]
         s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, qi * block_q, k_start)
+            s = _causal_mask(s, q_off + qi * block_q, k_start_g)
         p = jnp.exp(s - lse)
+        if causal:
+            p = jnp.where(s > _NEG_INF * 0.5, p, 0.0)
         dv_new = dv + jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
         dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
         dk_new = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
         return dk_new, dv_new
 
-    start = k_start // block_q if causal else 0
+    start = _q_block_start(k_start_g, q_off, block_q, n_q) if causal else 0
     dk, dv = lax.fori_loop(
         start, n_q, body,
         (jnp.zeros((block_k, d), jnp.float32),
@@ -169,93 +202,244 @@ def _dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
+def _resolve(Tq, Tk, D, scale, block_q, block_k, interpret, *,
+             validate=True):
+    """Resolve the shared per-call parameters (scale default, block
+    clamping, interpret default). ``validate=False`` for the backward,
+    whose shapes the forward already validated — the resolution logic
+    must stay common so fwd and bwd never disagree on block sizes."""
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    if validate and (Tq % block_q or Tk % block_k):
+        raise ValueError(
+            f"seq ({Tq}, {Tk}) must divide by blocks ({block_q}, {block_k})"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return float(scale), block_q, block_k, interpret
+
+
+def _to_kernel_layout(x):
+    B, T, H, D = x.shape
+    return jnp.einsum("bthd->bhtd", x).reshape(B * H, T, D)
+
+
+_SMEM_OFFS = pl.BlockSpec((1, 2), lambda bh, i: (0, 0),
+                          memory_space=pltpu.SMEM)
+
+
+def _align_vma(*arrays):
+    """Bring every array to the union of their varying-mesh-axes sets
+    (``lax.pvary``), so the kernels work inside ``shard_map``
+    (check_vma=True) even when some inputs — e.g. the constant zero
+    offsets — are replicated. Returns (arrays, union_vma)."""
+    vma = frozenset().union(*(jax.typeof(x).vma for x in arrays))
+    out = tuple(
+        lax.pcast(x, tuple(vma - jax.typeof(x).vma), to='varying') if vma - jax.typeof(x).vma
+        else x
+        for x in arrays
+    )
+    return out, vma
+
+
+def _masked_scores(qr, kr, offs, scale, causal):
+    """(N, Tq, Tk) scaled scores with the global causal mask — the dense
+    mirror of the kernels' per-block ``_causal_mask`` walk."""
+    s = jnp.einsum(
+        "ntd,nsd->nts", qr.astype(jnp.float32), kr.astype(jnp.float32)
+    ) * scale
+    if causal:
+        q_pos = offs[0, 0] + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        k_pos = offs[0, 1] + lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+    return s
+
+
+def _dense_forward(qr, kr, vr, offs, *, causal, scale, need_lse, out_dtype):
+    """jnp mirror of ``_kernel`` (same outputs, clamps, and dead-row
+    semantics), used where Pallas interpret mode can't run — inside
+    ``shard_map`` on CPU (its vma tracking rejects kernel-internal
+    constants). Real-TPU execution always takes the kernel path."""
+    s = _masked_scores(qr, kr, offs, scale, causal)
+    m = s.max(-1, keepdims=True)
+    p = jnp.exp(s - m) * (s > _NEG_INF / 2)  # fully-masked rows stay 0
+    l = jnp.maximum(p.sum(-1, keepdims=True), 1e-30)
+    outr = (
+        jnp.einsum("nts,nsd->ntd", p, vr.astype(jnp.float32)) / l
+    ).astype(out_dtype)
+    lse = (m + jnp.log(l)) if need_lse else None
+    return outr, lse
+
+
+def _dense_backward(qr, kr, vr, dor, lse, delta, offs, *, causal, scale):
+    """jnp mirror of ``_dq_kernel``/``_dkv_kernel`` (same P recompute from
+    lse and the same Δ shift); see ``_dense_forward`` for when."""
+    s = _masked_scores(qr, kr, offs, scale, causal)
+    p = jnp.exp(s - lse) * (s > _NEG_INF / 2)
+    dp = jnp.einsum(
+        "ntd,nsd->nts", dor.astype(jnp.float32), vr.astype(jnp.float32)
+    )
+    ds = p * (dp - delta)
+    dq = jnp.einsum("nts,nsd->ntd", ds, kr.astype(jnp.float32)) * scale
+    dk = jnp.einsum("nts,ntd->nsd", ds, qr.astype(jnp.float32)) * scale
+    dv = jnp.einsum("nts,ntd->nsd", p, dor.astype(jnp.float32))
+    return dq.astype(qr.dtype), dk.astype(kr.dtype), dv.astype(vr.dtype)
+
+
+def _forward_impl(q, k, v, offs, *, causal, scale, block_q, block_k,
+                  interpret, need_lse):
+    """Shared forward. ``offs``: (1, 2) int32 [q_offset, k_offset].
+    Returns (out, residuals) — residuals in kernel layout (B·H, T, D),
+    lse (B·H, Tq, 1) f32; both None-lse when ``need_lse`` is False (the
+    inference path skips the lse work entirely)."""
+    if q.ndim != 4:
+        raise ValueError(f"want (batch, seq, heads, head_dim), got {q.shape}")
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale, block_q, block_k, interpret = _resolve(
+        Tq, Tk, D, scale, block_q, block_k, interpret
+    )
+
+    qr, kr, vr = map(_to_kernel_layout, (q, k, v))
+
+    kernel = functools.partial(
+        _kernel, block_k=block_k, scale=scale, causal=causal,
+    )
+    blk_q = pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0),
+                         memory_space=pltpu.VMEM)
+    full_k = pl.BlockSpec((None, Tk, D), lambda bh, qi: (bh, 0, 0),
+                          memory_space=pltpu.VMEM)
+    (offs, qr, kr, vr), vma = _align_vma(offs, qr, kr, vr)
+    if interpret and vma:
+        outr, lse = _dense_forward(qr, kr, vr, offs, causal=causal,
+                                   scale=scale, need_lse=need_lse,
+                                   out_dtype=q.dtype)
+        out = outr.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+        return out, (qr, kr, vr, outr, lse)
+    out_specs = [blk_q]
+    out_shape = [jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype, vma=vma)]
+    if need_lse:
+        out_specs.append(
+            pl.BlockSpec((None, block_q, 1), lambda bh, qi: (bh, qi, 0),
+                         memory_space=pltpu.VMEM)
+        )
+        out_shape.append(
+            jax.ShapeDtypeStruct((B * H, Tq, 1), jnp.float32, vma=vma)
+        )
+
+    results = pl.pallas_call(
+        kernel,
+        grid=(B * H, Tq // block_q),
+        in_specs=[_SMEM_OFFS, blk_q, full_k, full_k],
+        out_specs=tuple(out_specs),
+        out_shape=tuple(out_shape),
+        interpret=interpret,
+    )(offs, qr, kr, vr)
+    outr = results[0]
+    out = outr.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)  # -> (B, Tq, H, D)
+    lse = results[1] if need_lse else None
+    return out, (qr, kr, vr, outr, lse)
+
+
+def _backward_impl(qr, kr, vr, outr, lse, offs, g, g_lse, *, causal, scale,
+                   block_q, block_k, interpret):
+    """Shared backward. ``g``: (B, Tq, H, D) out-cotangent; ``g_lse``:
+    (B, Tq, H) lse-cotangent or None. Returns (dq, dk, dv) user-layout."""
+    B, Tq, H, D = g.shape
+    Tk = kr.shape[1]
+    scale, block_q, block_k, interpret = _resolve(
+        Tq, Tk, D, scale, block_q, block_k, interpret, validate=False
+    )
+
+    dor = _to_kernel_layout(g)
+    delta = jnp.sum(
+        dor.astype(jnp.float32) * outr.astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )  # (B·H, Tq, 1) — trailing unit dim keeps TPU block shapes legal
+    if g_lse is not None:
+        # d lse/d s = P, so the lse cotangent enters ds = P∘(dP − Δ + ḡ)
+        # — i.e. it just shifts Δ.
+        delta = delta - jnp.einsum("bth->bht", g_lse).reshape(B * H, Tq, 1)
+
+    (offs, qr, kr, vr, dor, lse, delta), vma = _align_vma(
+        offs, qr, kr, vr, dor, lse, delta
+    )
+    if interpret and vma:
+        dq, dk, dv = _dense_backward(qr, kr, vr, dor, lse, delta, offs,
+                                     causal=causal, scale=scale)
+        back = lambda x, t: x.reshape(B, H, t, D).transpose(0, 2, 1, 3)
+        return back(dq, Tq), back(dk, Tk), back(dv, Tk)
+    row = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    blk_q = row((None, block_q, D), lambda bh, i: (bh, i, 0))
+    blk_k = row((None, block_k, D), lambda bh, i: (bh, i, 0))
+    full_q = row((None, Tq, D), lambda bh, i: (bh, 0, 0))
+    full_k = row((None, Tk, D), lambda bh, i: (bh, 0, 0))
+    vec_q = row((None, block_q, 1), lambda bh, i: (bh, i, 0))
+    vec_full = row((None, Tq, 1), lambda bh, i: (bh, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_k=block_k, scale=scale,
+                          causal=causal),
+        grid=(B * H, Tq // block_q),
+        in_specs=[_SMEM_OFFS, blk_q, full_k, full_k, blk_q, vec_q, vec_q],
+        out_specs=blk_q,
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), qr.dtype, vma=vma),
+        interpret=interpret,
+    )(offs, qr, kr, vr, dor, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=block_q, scale=scale,
+                          causal=causal),
+        grid=(B * H, Tk // block_k),
+        in_specs=[_SMEM_OFFS, full_q, full_q, vec_full, vec_full,
+                  blk_k, blk_k],
+        out_specs=(blk_k, blk_k),
+        out_shape=(
+            jax.ShapeDtypeStruct((B * H, Tk, D), kr.dtype, vma=vma),
+            jax.ShapeDtypeStruct((B * H, Tk, D), vr.dtype, vma=vma),
+        ),
+        interpret=interpret,
+    )(offs, qr, dor, lse, delta, kr, vr)
+
+    back = lambda x, t: x.reshape(B, H, t, D).transpose(0, 2, 1, 3)
+    return back(dq, Tq), back(dk, Tk), back(dv, Tk)
+
+
+def _zero_offs():
+    return jnp.zeros((1, 2), jnp.int32)
+
+
+# ---------------------------------------------------------------- square
+
+
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
 )
 def _flash_with_vjp(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, _ = _flash_forward(q, k, v, causal=causal, scale=scale,
-                            block_q=block_q, block_k=block_k,
-                            interpret=interpret, with_residuals=False)
+    out, _ = _forward_impl(q, k, v, _zero_offs(), causal=causal, scale=scale,
+                           block_q=block_q, block_k=block_k,
+                           interpret=interpret, need_lse=False)
     return out
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    # residuals stay in kernel layout (B·H, T, D) — the backward consumes
-    # them directly, so the fwd's transposes aren't repeated
-    out, residuals = _flash_forward(q, k, v, causal=causal, scale=scale,
-                                    block_q=block_q, block_k=block_k,
-                                    interpret=interpret, with_residuals=True)
+    out, residuals = _forward_impl(q, k, v, _zero_offs(), causal=causal,
+                                   scale=scale, block_q=block_q,
+                                   block_k=block_k, interpret=interpret,
+                                   need_lse=True)
     return out, residuals
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
     qr, kr, vr, outr, lse = residuals
-    return _flash_backward(qr, kr, vr, outr, lse, g, causal=causal,
-                           scale=scale, block_q=block_q, block_k=block_k,
-                           interpret=interpret)
+    return _backward_impl(qr, kr, vr, outr, lse, _zero_offs(), g, None,
+                          causal=causal, scale=scale, block_q=block_q,
+                          block_k=block_k, interpret=interpret)
 
 
 _flash_with_vjp.defvjp(_flash_fwd, _flash_bwd)
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
-)
-def _flash_backward(
-    qr, kr, vr, outr, lse, g, *,
-    causal: bool,
-    scale: float | None,
-    block_q: int,
-    block_k: int,
-    interpret: bool | None,
-):
-    B, T, H, D = g.shape
-    if scale is None:
-        scale = 1.0 / (D ** 0.5)
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-
-    dor = jnp.einsum("bthd->bhtd", g).reshape(B * H, T, D)
-    delta = jnp.sum(
-        dor.astype(jnp.float32) * outr.astype(jnp.float32),
-        axis=-1, keepdims=True,
-    )  # (B·H, T, 1) — trailing unit dim keeps TPU block shapes legal
-
-    row = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
-    blk_q = row((None, block_q, D), lambda bh, i: (bh, i, 0))
-    blk_k = row((None, block_k, D), lambda bh, i: (bh, i, 0))
-    full = row((None, T, D), lambda bh, i: (bh, 0, 0))
-    vec_q = row((None, block_q, 1), lambda bh, i: (bh, i, 0))
-    vec_full = row((None, T, 1), lambda bh, i: (bh, 0, 0))
-
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, block_k=block_k, scale=float(scale),
-                          causal=causal),
-        grid=(B * H, T // block_q),
-        in_specs=[blk_q, full, full, blk_q, vec_q, vec_q],
-        out_specs=blk_q,
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), qr.dtype),
-        interpret=interpret,
-    )(qr, kr, vr, dor, lse, delta)
-
-    dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, block_q=block_q, scale=float(scale),
-                          causal=causal),
-        grid=(B * H, T // block_k),
-        in_specs=[full, full, vec_full, vec_full, blk_k, blk_k],
-        out_specs=(blk_k, blk_k),
-        out_shape=(
-            jax.ShapeDtypeStruct((B * H, T, D), kr.dtype),
-            jax.ShapeDtypeStruct((B * H, T, D), vr.dtype),
-        ),
-        interpret=interpret,
-    )(qr, dor, lse, delta, kr, vr)
-
-    back = lambda x: x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
-    return back(dq), back(dk), back(dv)
 
 
 def flash_attention(
@@ -282,67 +466,86 @@ def flash_attention(
     return _flash_with_vjp(q, k, v, causal, scale, block_q, block_k, interpret)
 
 
+# ----------------------------------------------------------------- block
+
+
 @functools.partial(
-    jax.jit,
-    static_argnames=("causal", "scale", "block_q", "block_k", "interpret",
-                     "with_residuals"),
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8)
 )
-def _flash_forward(
+def _flash_block_with_vjp(q, k, v, offs_i, causal, scale, block_q, block_k,
+                          interpret):
+    offs = offs_i.reshape(1, 2)
+    out, (_, _, _, _, lse) = _forward_impl(
+        q, k, v, offs, causal=causal, scale=scale, block_q=block_q,
+        block_k=block_k, interpret=interpret, need_lse=True,
+    )
+    B, Tq, H, _ = q.shape
+    lse_user = jnp.einsum("bht->bth", lse.reshape(B, H, Tq))
+    return out, lse_user
+
+
+def _flash_block_fwd(q, k, v, offs_i, causal, scale, block_q, block_k,
+                     interpret):
+    offs = offs_i.reshape(1, 2)
+    out, residuals = _forward_impl(
+        q, k, v, offs, causal=causal, scale=scale, block_q=block_q,
+        block_k=block_k, interpret=interpret, need_lse=True,
+    )
+    B, Tq, H, _ = q.shape
+    lse = residuals[4]
+    lse_user = jnp.einsum("bht->bth", lse.reshape(B, H, Tq))
+    return (out, lse_user), (*residuals, offs)
+
+
+def _flash_block_bwd(causal, scale, block_q, block_k, interpret,
+                     residuals, g):
+    qr, kr, vr, outr, lse, offs = residuals
+    g_out, g_lse = g
+    dq, dk, dv = _backward_impl(
+        qr, kr, vr, outr, lse, offs, g_out, g_lse, causal=causal,
+        scale=scale, block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    # offsets are integer positions: their cotangent is the symbolic
+    # float0 zero (also exempt from shard_map's varying-axes check)
+    return dq, dk, dv, np.zeros((2,), jax.dtypes.float0)
+
+
+_flash_block_with_vjp.defvjp(_flash_block_fwd, _flash_block_bwd)
+
+
+def flash_attention_block(
     q,
     k,
     v,
+    q_offset,
+    k_offset,
     *,
     causal: bool = True,
     scale: float | None = None,
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool | None = None,
-    with_residuals: bool = False,
 ):
-    if q.ndim != 4:
-        raise ValueError(f"want (batch, seq, heads, head_dim), got {q.shape}")
-    B, T, H, D = q.shape
-    if scale is None:
-        scale = 1.0 / (D ** 0.5)
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
-    if T % block_q or T % block_k:
-        raise ValueError(f"seq {T} must divide by blocks ({block_q}, {block_k})")
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    """One *partial* attention: local queries ``q`` (global position
+    ``q_offset``) against one visiting K/V block (global position
+    ``k_offset``); Tq and Tk may differ. Returns ``(out, lse)`` —
+    the softmax attention restricted to this block, normalized within
+    it, plus the per-row logsumexp (B, Tq, H) f32 — so partials over
+    disjoint K/V blocks merge exactly:
 
-    # (B, T, H, D) -> (B*H, T, D): one grid row per (batch, head)
-    qr = jnp.einsum("bthd->bhtd", q).reshape(B * H, T, D)
-    kr = jnp.einsum("bthd->bhtd", k).reshape(B * H, T, D)
-    vr = jnp.einsum("bthd->bhtd", v).reshape(B * H, T, D)
+        m = max(lse_a, lse_b); e_x = exp(lse_x - m)
+        out = (e_a·out_a + e_b·out_b) / (e_a + e_b);  lse = m + log(e_a+e_b)
 
-    kernel = functools.partial(
-        _kernel, block_k=block_k, scale=float(scale), causal=causal,
-    )
-    blk_q = pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0),
-                         memory_space=pltpu.VMEM)
-    full = pl.BlockSpec((None, T, D), lambda bh, qi: (bh, 0, 0),
-                        memory_space=pltpu.VMEM)
-    out_specs = [blk_q]
-    out_shape = [jax.ShapeDtypeStruct((B * H, T, D), q.dtype)]
-    if with_residuals:
-        # the lse write is skipped entirely on the primal (inference) path
-        out_specs.append(
-            pl.BlockSpec((None, block_q, 1), lambda bh, qi: (bh, qi, 0),
-                         memory_space=pltpu.VMEM)
-        )
-        out_shape.append(jax.ShapeDtypeStruct((B * H, T, 1), jnp.float32))
-
-    results = pl.pallas_call(
-        kernel,
-        grid=(B * H, T // block_q),
-        in_specs=[blk_q, full, full],
-        out_specs=tuple(out_specs),
-        out_shape=tuple(out_shape),
-        interpret=interpret,
-    )(qr, kr, vr)
-    outr = results[0]
-    out = outr.reshape(B, H, T, D).transpose(0, 2, 1, 3)  # -> (B, T, H, D)
-    if with_residuals:
-        return out, (qr, kr, vr, outr, results[1])
-    return out, None
+    This is the per-step compute of ring attention (the reference's
+    ring exchange-accumulate, allreduce-mpi-sycl.cpp:173-182, with
+    attention as the combine). Offsets may be traced (e.g. derived from
+    ``axis_index`` inside shard_map). A fully-future block (causal,
+    k_offset > all query positions) runs zero kernel iterations and
+    returns out=0, lse≈-1e30, which the merge weights to zero.
+    Differentiable in q, k, v, including gradient flow through lse.
+    """
+    offs_i = jnp.stack([
+        jnp.asarray(q_offset, jnp.int32), jnp.asarray(k_offset, jnp.int32)
+    ])
+    return _flash_block_with_vjp(q, k, v, offs_i, causal, scale, block_q,
+                                 block_k, interpret)

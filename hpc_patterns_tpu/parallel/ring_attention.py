@@ -63,6 +63,9 @@ def ring_attention(
     *,
     causal: bool = False,
     scale: float | None = None,
+    impl: str = "dense",
+    block_q: int = 128,
+    block_k: int = 128,
 ):
     """Attention over a sequence sharded on mesh ``axis`` (rank-local; run
     inside ``shard_map``).
@@ -71,19 +74,34 @@ def ring_attention(
     sequence block; global sequence = blocks in rank order. Returns the
     local block of the softmax attention output, same shape/dtype as
     ``q``, numerically equal to attending the gathered sequence.
+
+    ``impl``: per-step local compute. ``"dense"`` materializes the
+    (T_local, S) score block (any shape); ``"flash"`` runs the Pallas
+    blockwise kernel per visiting block (ops.flash_attention_block) and
+    merges partials by logsumexp — O(block) VMEM on-chip, MXU-shaped,
+    and causally-skipped blocks cost zero kernel iterations. Requires
+    the local sequence to divide by the (clamped) block sizes.
     """
     if q.ndim != 4:
         raise ValueError(f"want (batch, seq, heads, head_dim), got {q.shape}")
+    if impl not in ("dense", "flash"):
+        raise ValueError(f"impl {impl!r} not in ('dense', 'flash')")
     size = ring.axis_size(axis)
     me = ring.axis_index(axis)
     B, T, H, D = q.shape
     if scale is None:
         scale = 1.0 / (D ** 0.5)
+    q_offset = me * T
+
+    if impl == "flash":
+        return _ring_attention_flash(
+            q, k, v, axis, size=size, me=me, q_offset=q_offset,
+            causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        )
 
     acc = jnp.zeros((B, H, T, D), jnp.float32)
     m = jnp.full((B, H, T), _NEG_INF, jnp.float32)
     l = jnp.zeros((B, H, T), jnp.float32)
-    q_offset = me * T
 
     kv = (k, v)
     for step in range(size):
@@ -102,6 +120,38 @@ def ring_attention(
 
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return jnp.einsum("bhtd->bthd", out).astype(q.dtype)
+
+
+def _ring_attention_flash(q, k, v, axis, *, size, me, q_offset, causal,
+                          scale, block_q, block_k):
+    """Flash per-step ring attention: each visiting K/V block is one
+    Pallas partial attention (normalized within the block, with its
+    logsumexp), merged into the running result by the standard
+    logsumexp combine. Same ring dataflow, kernel-grade local compute."""
+    from hpc_patterns_tpu.ops import flash_attention_block
+
+    out = jnp.zeros(q.shape, jnp.float32)           # (B, T, H, D)
+    lse = jnp.full(q.shape[:3], _NEG_INF, jnp.float32)  # (B, T, H)
+
+    kv = (k, v)
+    for step in range(size):
+        k_blk, v_blk = kv
+        src = (me - step) % size
+        o_b, lse_b = flash_attention_block(
+            q, k_blk, v_blk, q_offset, src * k_blk.shape[1],
+            causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        )
+        m = jnp.maximum(lse, lse_b)
+        e_run = jnp.exp(lse - m)
+        e_b = jnp.exp(lse_b - m)
+        denom = e_run + e_b
+        out = (out * e_run[..., None]
+               + o_b.astype(jnp.float32) * e_b[..., None]) / denom[..., None]
+        lse = m + jnp.log(denom)
+        if step + 1 < size:
+            kv = jax.tree.map(lambda x: ring.ring_shift(x, axis, 1), kv)
+
+    return out.astype(q.dtype)
 
 
 def full_attention(q, k, v, *, causal: bool = False, scale: float | None = None):

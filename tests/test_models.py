@@ -67,7 +67,7 @@ class TestForward:
 
 
 class TestShardedOracle:
-    @pytest.mark.parametrize("attention", ["ring", "ulysses"])
+    @pytest.mark.parametrize("attention", ["ring", "ring_flash", "ulysses"])
     def test_sharded_loss_matches_single_device(self, mesh_dp_sp_tp, attention):
         cfg_local = TransformerConfig(**TINY)
         cfg_mesh = TransformerConfig(**{**TINY, "attention": attention})

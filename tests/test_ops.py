@@ -8,7 +8,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from hpc_patterns_tpu.ops import flash_attention
+from hpc_patterns_tpu.ops import flash_attention, flash_attention_block
 from hpc_patterns_tpu.parallel.ring_attention import full_attention
 
 
@@ -43,6 +43,68 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
     @pytest.mark.parametrize("causal", [True, False])
+    def test_block_partials_merge_to_full(self, causal):
+        # two half-sequence K/V blocks at their global offsets, merged by
+        # logsumexp, must equal attention over the whole sequence
+        T = 64
+        q, k, v = _qkv(jax.random.PRNGKey(4), B=1, T=T, H=2, D=16)
+        half = T // 2
+
+        def merged(q, k, v):
+            out = jnp.zeros(q.shape, jnp.float32)
+            lse = jnp.full(q.shape[:3], -1e30, jnp.float32)
+            for i in (0, 1):
+                o_b, lse_b = flash_attention_block(
+                    q, k[:, i * half:(i + 1) * half],
+                    v[:, i * half:(i + 1) * half],
+                    0, i * half, causal=causal, block_q=32, block_k=32,
+                )
+                m = jnp.maximum(lse, lse_b)
+                e_run, e_b = jnp.exp(lse - m), jnp.exp(lse_b - m)
+                denom = e_run + e_b
+                out = (out * e_run[..., None]
+                       + o_b.astype(jnp.float32) * e_b[..., None]) \
+                    / denom[..., None]
+                lse = m + jnp.log(denom)
+            return out.astype(q.dtype)
+
+        np.testing.assert_allclose(
+            np.asarray(merged(q, k, v)),
+            np.asarray(full_attention(q, k, v, causal=causal)),
+            atol=2e-5,
+        )
+
+        # gradient flows through BOTH out and lse of each partial
+        g_got = jax.grad(lambda *a: merged(*a).sum(), argnums=(0, 1, 2))(q, k, v)
+        g_want = jax.grad(
+            lambda *a: full_attention(*a, causal=causal).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(g_got, g_want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+    def test_block_dead_rows_inside_iterating_block_are_zero(self):
+        # rows 32-47 see nothing of a K block at offset 48, but share a
+        # query block with rows 48-63 which do — the kernel must zero
+        # them, not average the visited V rows
+        q, k, v = _qkv(jax.random.PRNGKey(6), B=1, T=64, H=2, D=16)
+        o_b, lse_b = flash_attention_block(q, k[:, :32], v[:, :32], 0, 48,
+                                           causal=True, block_q=32,
+                                           block_k=32)
+        dead = np.asarray(o_b)[:, 32:48]
+        assert np.all(dead == 0), np.abs(dead).max()
+        assert np.all(np.asarray(lse_b)[:, 32:48] < -1e29)
+
+    def test_block_fully_future_is_masked(self):
+        # causal block entirely in the future: zero kernel iterations,
+        # zero weight in the merge
+        q, k, v = _qkv(jax.random.PRNGKey(5), B=1, T=32, H=2, D=16)
+        o_b, lse_b = flash_attention_block(q, k, v, 0, 1000, causal=True,
+                                           block_q=32, block_k=32)
+        assert np.all(np.asarray(o_b) == 0)
+        assert np.all(np.asarray(lse_b) < -1e29)
+
+    @pytest.mark.parametrize("causal", [True, False])
     def test_grad_matches_oracle(self, causal):
         q, k, v = _qkv(jax.random.PRNGKey(3), B=1, T=64, H=2, D=16)
 
@@ -69,6 +131,25 @@ class TestFlashAttention:
         b = forward(params, tokens, TransformerConfig(**base, attention="flash"))
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
+    def test_flash_on_mesh_sp1_allowed(self, mesh8):
+        from hpc_patterns_tpu.models import TransformerConfig, forward, init_params
+
+        # mesh8 has one axis "x"; treat it as dp (sequence unsharded)
+        cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=1,
+                                d_ff=64, max_seq=32, dtype="float32",
+                                attention="flash", axis_dp="x", axis_sp="sp",
+                                axis_tp="tp", axis_ep="ep")
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 64,
+                                    "int32")
+        got = forward(params, tokens, cfg, mesh8)
+        want = forward(params, tokens,
+                       TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                         n_layers=1, d_ff=64, max_seq=32,
+                                         dtype="float32"))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-4)
+
     def test_flash_on_mesh_rejected(self, mesh_dp_sp_tp):
         from hpc_patterns_tpu.models import TransformerConfig, forward, init_params
 
@@ -76,5 +157,5 @@ class TestFlashAttention:
                                 d_ff=64, max_seq=32, attention="flash")
         params = init_params(jax.random.PRNGKey(0), cfg)
         tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 64, "int32")
-        with pytest.raises(ValueError, match="single-device"):
+        with pytest.raises(ValueError, match="ring_flash"):
             forward(params, tokens, cfg, mesh_dp_sp_tp)

@@ -53,6 +53,47 @@ class TestRingAttention:
             np.asarray(got, np.float32), np.asarray(want, np.float32), atol=3e-2
         )
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_flash_impl_matches_full_attention(self, mesh8, causal):
+        q, k, v = _qkv(jax.random.PRNGKey(2))
+        got = _shmap_seq(
+            mesh8,
+            lambda q, k, v: parallel.ring_attention(
+                q, k, v, "x", causal=causal, impl="flash"
+            ),
+            q, k, v,
+        )
+        want = full_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    def test_flash_impl_grad_matches_oracle(self, mesh8):
+        q, k, v = _qkv(jax.random.PRNGKey(3))
+        spec = P(None, "x", None, None)
+        ringed = jax.shard_map(
+            lambda q, k, v: parallel.ring_attention(
+                q, k, v, "x", causal=True, impl="flash"
+            ),
+            mesh=mesh8, in_specs=(spec,) * 3, out_specs=spec,
+        )
+        g_got = jax.jit(jax.grad(
+            lambda q, k, v: ringed(q, k, v).sum(), argnums=(0, 1, 2)
+        ))(q, k, v)
+        g_want = jax.grad(
+            lambda q, k, v: full_attention(q, k, v, causal=True).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(g_got, g_want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+    def test_rejects_bad_impl(self, mesh8):
+        with pytest.raises(ValueError, match="impl"):
+            jax.shard_map(
+                lambda q: parallel.ring_attention(q, q, q, "x", impl="nope"),
+                mesh=mesh8,
+                in_specs=P(None, "x", None, None),
+                out_specs=P(None, "x", None, None),
+            )(jnp.zeros((B, T, H, D)))
+
     def test_rejects_bad_rank(self, mesh8):
         with pytest.raises(ValueError, match="head_dim"):
             jax.shard_map(
