@@ -24,7 +24,7 @@ import jax
 from hpc_patterns_tpu import topology
 from hpc_patterns_tpu.harness import RunLog, Verdict
 from hpc_patterns_tpu.harness.cli import base_parser
-from hpc_patterns_tpu.models import TransformerConfig
+from hpc_patterns_tpu.models import ATTENTION_IMPLS, TransformerConfig
 from hpc_patterns_tpu.models.train import init_train_state, make_batch, make_train_step
 
 
@@ -38,7 +38,7 @@ def build_parser():
     p.add_argument("--n-heads", type=int, default=8)
     p.add_argument("--vocab", type=int, default=1024)
     p.add_argument("--attention", default="full",
-                   choices=["full", "flash", "ring", "ulysses"])
+                   choices=list(ATTENTION_IMPLS))
     p.add_argument("--remat", action="store_true")
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1)
@@ -77,12 +77,13 @@ def run(args) -> int:
         attention=args.attention, remat=args.remat, n_experts=args.n_experts,
     )
     n_mesh = args.dp * args.sp * args.tp * args.ep
-    if args.attention == "flash" and n_mesh > 1:
-        log.print("ERROR: attention='flash' is single-device; "
-                  "use ring/ulysses with a mesh")
+    if args.attention == "flash" and args.sp > 1:
+        log.print("ERROR: attention='flash' needs the sequence unsharded "
+                  "(--sp 1); use ring_flash for a sharded sequence")
         log.print("FAILURE")
         return 1
-    use_mesh = n_mesh > 1 or args.attention in ("ring", "ulysses")
+    # every impl except the two single-path ones needs a mesh to shard over
+    use_mesh = n_mesh > 1 or args.attention not in ("full", "flash")
     mesh = None
     if use_mesh:
         devices = topology.get_devices(args.backend)

@@ -19,6 +19,7 @@ compile per model, not per layer), optional ``jax.checkpoint`` remat.
 """
 
 from hpc_patterns_tpu.models.transformer import (  # noqa: F401
+    ATTENTION_IMPLS,
     TransformerConfig,
     init_params,
     forward,

@@ -12,7 +12,9 @@ Architecture choices driven by the hardware (SURVEY.md preamble +
   ``"ring"`` (context parallelism over the ``sp`` mesh axis — the
   reference's ring dataflow, parallel/ring_attention.py),
   ``"ring_flash"`` (the same ring with the Pallas kernel as each
-  step's local compute), or ``"ulysses"`` (all-to-all SP);
+  step's local compute), ``"ulysses"`` (all-to-all SP), or
+  ``"ulysses_flash"`` (Ulysses with the Pallas kernel as the
+  rank-local full-sequence attention);
 - activation sharding is annotated with ``with_sharding_constraint``;
   parameter shardings live in models/sharding.py (Megatron column/row
   rules, ≙ parallel/tensor.py helpers);
@@ -37,7 +39,8 @@ from hpc_patterns_tpu.models.sharding_util import mesh_axis_size, resolve_spec
 from hpc_patterns_tpu.parallel.ring_attention import full_attention, ring_attention
 from hpc_patterns_tpu.parallel.ulysses import ulysses_attention
 
-ATTENTION_IMPLS = ("full", "flash", "ring", "ring_flash", "ulysses")
+ATTENTION_IMPLS = ("full", "flash", "ring", "ring_flash", "ulysses",
+                   "ulysses_flash")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -49,7 +52,7 @@ class TransformerConfig:
     d_ff: int = 3072
     max_seq: int = 2048
     dtype: str = "bfloat16"  # compute dtype (MXU-native)
-    attention: str = "full"  # full | flash | ring | ring_flash | ulysses
+    attention: str = "full"  # full | flash | ring[_flash] | ulysses[_flash]
     remat: bool = False
     # mixture-of-experts: 0 = dense MLP; >0 = Switch-style top-1 MoE
     # with experts sharded over the ep axis (parallel/moe.py)
@@ -144,11 +147,10 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
         return full_attention(q, k, v, causal=True)
     spec = resolve_spec(P(cfg.axis_dp, cfg.axis_sp, cfg.axis_tp, None), mesh,
                         cfg.mesh_axes)
-    if cfg.attention == "ulysses":
-        fn = partial(ulysses_attention, axis=cfg.axis_sp, causal=True)
-    else:
-        fn = partial(ring_attention, axis=cfg.axis_sp, causal=True,
-                     impl="flash" if cfg.attention == "ring_flash" else "dense")
+    base, _, variant = cfg.attention.partition("_")
+    local_impl = variant or "dense"
+    impl_fn = ulysses_attention if base == "ulysses" else ring_attention
+    fn = partial(impl_fn, axis=cfg.axis_sp, causal=True, impl=local_impl)
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)

@@ -28,6 +28,7 @@ def ulysses_attention(
     *,
     causal: bool = False,
     scale: float | None = None,
+    impl: str = "dense",
 ):
     """Attention over a sequence sharded on ``axis`` via head scattering
     (rank-local; run inside ``shard_map``).
@@ -35,9 +36,17 @@ def ulysses_attention(
     ``q``/``k``/``v``: (batch, seq_local, heads, head_dim) with ``heads``
     divisible by the axis size. Returns the local sequence block of the
     full attention output, same shape as ``q``.
+
+    ``impl``: the rank-local full-sequence attention — ``"dense"``
+    (oracle math, any shape) or ``"flash"`` (ops.flash_attention: after
+    the first all-to-all each rank holds the FULL sequence for its head
+    slice, exactly the square kernel's shape; requires the global
+    sequence to divide by the clamped block sizes).
     """
     if q.ndim != 4:
         raise ValueError(f"want (batch, seq, heads, head_dim), got {q.shape}")
+    if impl not in ("dense", "flash"):
+        raise ValueError(f"impl {impl!r} not in ('dense', 'flash')")
     size = ring.axis_size(axis)
     H = q.shape[2]
     if H % size:
@@ -51,5 +60,10 @@ def ulysses_attention(
         return collectives.all_to_all(x, axis, split_axis=1, concat_axis=2)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = full_attention(qh, kh, vh, causal=causal, scale=scale)
+    if impl == "flash":
+        from hpc_patterns_tpu.ops import flash_attention
+
+        out = flash_attention(qh, kh, vh, causal=causal, scale=scale)
+    else:
+        out = full_attention(qh, kh, vh, causal=causal, scale=scale)
     return heads_to_seq(out)

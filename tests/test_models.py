@@ -67,7 +67,9 @@ class TestForward:
 
 
 class TestShardedOracle:
-    @pytest.mark.parametrize("attention", ["ring", "ring_flash", "ulysses"])
+    @pytest.mark.parametrize(
+        "attention", ["ring", "ring_flash", "ulysses", "ulysses_flash"]
+    )
     def test_sharded_loss_matches_single_device(self, mesh_dp_sp_tp, attention):
         cfg_local = TransformerConfig(**TINY)
         cfg_mesh = TransformerConfig(**{**TINY, "attention": attention})

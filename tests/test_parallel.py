@@ -114,6 +114,39 @@ class TestUlysses:
         want = full_attention(q, k, v, causal=causal)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_flash_impl_matches_full_attention(self, mesh8, causal):
+        q, k, v = _qkv(jax.random.PRNGKey(4))
+        got = _shmap_seq(
+            mesh8,
+            lambda q, k, v: parallel.ulysses_attention(
+                q, k, v, "x", causal=causal, impl="flash"
+            ),
+            q, k, v,
+        )
+        want = full_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    def test_flash_impl_grad_matches_oracle(self, mesh8):
+        # flash's custom VJP composed with the all-to-all backward
+        q, k, v = _qkv(jax.random.PRNGKey(5))
+        spec = P(None, "x", None, None)
+        mapped = jax.shard_map(
+            lambda q, k, v: parallel.ulysses_attention(
+                q, k, v, "x", causal=True, impl="flash"
+            ),
+            mesh=mesh8, in_specs=(spec,) * 3, out_specs=spec,
+        )
+        g_got = jax.jit(jax.grad(
+            lambda q, k, v: mapped(q, k, v).sum(), argnums=(0, 1, 2)
+        ))(q, k, v)
+        g_want = jax.grad(
+            lambda q, k, v: full_attention(q, k, v, causal=True).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(g_got, g_want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
     def test_heads_must_divide(self, mesh8):
         q = jnp.zeros((B, T, 6, D))  # 6 heads, 8 ranks
         with pytest.raises(Exception, match="divisible|not divisible"):
