@@ -127,10 +127,11 @@ def amortized_seconds(
     iters: int = 64,
     repetitions: int = 5,
     warmup: int = 1,
+    base_iters: int = 1,
 ) -> float:
     """Per-iteration device time via differencing: run the workload with
-    ``iters`` internal repetitions and with 1, both completion-forced, and
-    return ``(t_iters - t_1) / (iters - 1)``.
+    ``iters`` internal repetitions and with ``base_iters``, both
+    completion-forced, and return ``(t_iters - t_base) / (iters - base)``.
 
     This cancels dispatch/readback latency (~100 ms through tunneled
     backends) and any per-call constant, leaving pure steady-state device
@@ -138,16 +139,25 @@ def amortized_seconds(
     for environments where wall-clocking a single dispatch is meaningless.
     ``run_with_iters(n)`` must return an array depending on all n
     iterations (e.g. a Pallas kernel looping n passes internally).
+
+    The default ``base_iters=1`` suits fast per-iteration work; when
+    dispatch-latency *variance* (tens of ms through a tunnel) rivals the
+    difference being measured, pick a large base (e.g. ``iters // 2``) so
+    both timed calls are device-time-dominated and the noise divides by a
+    large (iters - base).
     """
     if iters < 2:
         raise ValueError("iters must be >= 2")
+    if not 1 <= base_iters < iters:
+        raise ValueError(f"need 1 <= base_iters < iters, got {base_iters}")
     t_many = measure_forced(
         lambda: run_with_iters(iters), repetitions=repetitions, warmup=warmup
     ).min_s
-    t_one = measure_forced(
-        lambda: run_with_iters(1), repetitions=repetitions, warmup=warmup
+    t_base = measure_forced(
+        lambda: run_with_iters(base_iters), repetitions=repetitions,
+        warmup=warmup
     ).min_s
-    return max(t_many - t_one, 0.0) / (iters - 1)
+    return max(t_many - t_base, 0.0) / (iters - base_iters)
 
 
 def max_across_processes(seconds: float) -> float:
