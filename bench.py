@@ -27,7 +27,6 @@ import sys
 import jax
 
 from hpc_patterns_tpu.concurrency import pipeline
-from hpc_patterns_tpu.harness.timing import amortized_seconds, measure_forced
 
 # 16 x (2048, 128) f32 = 16 MiB working set. Fewer, larger chunks than
 # the DMA-granularity minimum: the ~0.3 us/chunk loop+semaphore cost is
@@ -42,29 +41,15 @@ PROBE_TRIPS = 64
 MAX_TRIPS = 4096
 
 
-# pass counts: calibrate so each timed call runs ~TARGET_S of device
-# time; tunnel latency jitter (10s of ms between calls) then divides by
-# tens of thousands of passes instead of corrupting the estimate
-TARGET_S = 1.0
+# measurement protocol (calibrated pass counts, jitter-proof
+# differencing) lives in pipeline.per_pass_seconds, shared with the
+# concurrency app's on-chip engine
 CAL_PASSES = 1000
 
 
-def per_pass_seconds(x, mode, tripcount, cal_passes=CAL_PASSES,
-                     repetitions=3):
-    run = lambda p: pipeline.overlap_run(x, mode=mode, tripcount=tripcount,
-                                         passes=p)
-    # differenced calibration pair: dispatch latency cancels, so fast
-    # modes are sized to the full TARGET_S of device time too; if noise
-    # makes the difference non-positive, fall back to the latency-biased
-    # single-call estimate (bias only shrinks the pass count)
-    t_two = measure_forced(lambda: run(2 * cal_passes), repetitions=1).min_s
-    t_one = measure_forced(lambda: run(cal_passes), repetitions=1).min_s
-    est = (t_two - t_one) / cal_passes
-    if est <= 0:
-        est = max(t_two / (2 * cal_passes), 1e-7)
-    hi = int(min(max(TARGET_S / est, 2 * cal_passes), 120_000))
-    return amortized_seconds(run, iters=hi, repetitions=repetitions,
-                             base_iters=hi // 2)
+def per_pass_seconds(x, mode, tripcount, cal_passes=CAL_PASSES):
+    return pipeline.per_pass_seconds(x, mode, tripcount,
+                                     cal_passes=cal_passes)
 
 
 def main() -> int:
@@ -83,21 +68,13 @@ def main() -> int:
         # pathological tripcount; fall through to the degenerate emitter
         trips, t_comp, t_serial, t_overlap = 0, 0.0, 0.0, 0.0
     else:
-        # balance compute to DMA (linear in tripcount), C12-style, with a
-        # refinement pass: a single probe's error would otherwise leave
-        # the commands unbalanced (max_speedup <= 1.5 is the reference's
-        # own "unbalanced" warning regime, sycl_con.cpp:282-283)
+        # balance compute to DMA (the shared C12 balance step)
         trips = min(max(1, int(PROBE_TRIPS * t_dma / t_comp_probe)),
                     MAX_TRIPS)
-        t_comp = per_pass_seconds(x, "compute", trips, cal)
-        for _ in range(2):
-            if t_comp <= 0:
-                break
-            new_trips = min(max(1, int(trips * t_dma / t_comp)), MAX_TRIPS)
-            if abs(new_trips - trips) <= max(2, trips // 10):
-                break
-            trips = new_trips
-            t_comp = per_pass_seconds(x, "compute", trips, cal)
+        trips, t_comp = pipeline.balance_tripcount(
+            lambda m, t: per_pass_seconds(x, m, t, cal), t_dma, "compute",
+            trips, max_trips=MAX_TRIPS,
+        )
 
         t_serial = per_pass_seconds(x, "serial", trips, cal)
         t_overlap = per_pass_seconds(x, "overlap", trips, cal)

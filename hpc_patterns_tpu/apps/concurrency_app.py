@@ -59,6 +59,15 @@ def build_parser():
                    help="devices to round-robin commands over (queue pool analog)")
     p.add_argument("--rule", default="sycl", choices=["sycl", "omp"],
                    help="verdict rule: sycl speedup (sycl_con) or omp absolute (omp_con)")
+    p.add_argument("--engine", default="auto",
+                   choices=["auto", "dispatch", "onchip"],
+                   help="overlap mechanism: 'dispatch' races jit calls "
+                        "across devices/streams (the queue-pool analog); "
+                        "'onchip' runs the whole experiment inside ONE "
+                        "Pallas kernel (HBM<->VMEM DMA vs VPU compute) — "
+                        "where single-chip TPU concurrency actually "
+                        "lives; 'auto' picks onchip on a TPU backend "
+                        "(n_queues<=1, supported pair)")
     p.add_argument("--enable_profiling", action="store_true",
                    help="jax.profiler trace around the concurrent run")
     p.add_argument("--trace-dir", default=None, help="profiler output dir")
@@ -112,9 +121,133 @@ def build_commands(args, devices) -> tuple[list[cmds.Command], dict]:
     return built, tune_info
 
 
+# on-chip engine: command pair -> ((command, baseline mode) per command,
+# serial mode, overlap mode). Resources: C occupies the (sequential)
+# TensorCore, copies share HBM bandwidth — the verdict floor is
+# resource-aware.
+_ONCHIP_PAIRS = {
+    ("C", "M2D"): (
+        (("M2D", "dma"), ("C", "compute")), "serial", "overlap"),
+    ("C", "D2M"): (
+        (("D2M", "dma_out"), ("C", "compute")), "serial_out", "overlap_out"),
+    ("D2M", "M2D"): (
+        (("M2D", "dma"), ("D2M", "dma_out")), "pair_serial", "pair_overlap"),
+    ("C", "C"): (
+        (("C", "compute"), ("C", "compute")), "compute2", "compute2"),
+}
+_RESOURCE = {"C": "core", "M2D": "hbm", "D2M": "hbm"}
+_ONCHIP_CHUNKS = 16
+
+
+def _onchip_supported(args, mode) -> bool:
+    kinds = tuple(sorted(k.upper() for k in args.commands))
+    import jax
+
+    return (
+        kinds in _ONCHIP_PAIRS
+        and mode in ("serial", "async")
+        and args.n_queues <= 1
+        and jax.default_backend() == "tpu"
+    )
+
+
+def run_onchip(args, log, mode) -> int:
+    """C1's experiment as ONE Pallas kernel: the copy commands are
+    HBM↔VMEM DMA streams, the compute command is the busy-wait chain,
+    and overlap is double-buffering inside the kernel — the TPU-native
+    location of single-device copy/compute concurrency (async dispatch
+    between jit calls serializes on one TensorCore, so the reference's
+    queue-race formulation physically cannot overlap there)."""
+    import jax
+
+    from hpc_patterns_tpu.concurrency import pipeline
+
+    kinds = tuple(sorted(k.upper() for k in args.commands))
+    baselines, serial_mode, overlap_mode = _ONCHIP_PAIRS[kinds]
+    (name_a, base_a), (name_b, base_b) = baselines
+    names = [name_a, name_b]
+
+    elems = (
+        _ONCHIP_CHUNKS * 2048 * 128
+        if args.copy_elements == AUTO else args.copy_elements
+    )
+    rows = max(8, (elems // (_ONCHIP_CHUNKS * 128) + 7) // 8 * 8)
+    x = jax.block_until_ready(pipeline.make_hbm_array(_ONCHIP_CHUNKS, rows))
+    per_pass = lambda m, t: pipeline.per_pass_seconds(x, m, t, repetitions=5)
+
+    # C12 balance: tripcount so the chain matches the copy baseline
+    # (shared pipeline.balance_tripcount); pure-copy and pure-compute
+    # pairs skip it
+    trips = args.tripcount if args.tripcount != AUTO else 64
+    t_a = per_pass(base_a, trips)
+    t_b = per_pass(base_b, trips)
+    if args.tripcount == AUTO and "C" in kinds and base_a != base_b:
+        trips, t_b = pipeline.balance_tripcount(per_pass, t_a, base_b, trips)
+        log.emit(kind="autotune", which="onchip_tripcount", tripcount=trips,
+                 t_copy_us=t_a * 1e6, t_compute_us=t_b * 1e6)
+        log.print(f"autotune[onchip_tripcount]: trips={trips} "
+                  f"copy {t_a * 1e6:.2f} us vs compute {t_b * 1e6:.2f} us")
+
+    per_times = [t_a, t_b]
+    for name, t in zip(names, per_times):
+        log.print(f"serial {name}: {t * 1e6:.3f} us/pass")
+
+    if mode == "serial":
+        log.emit(kind="result", name="concurrency[onchip:serial]",
+                 success=True, commands=names,
+                 per_command_us=[t * 1e6 for t in per_times])
+        log.print("SUCCESS")
+        return 0
+
+    if serial_mode == "compute2":  # C C: one chain, doubled work
+        t_serial = per_pass("compute", 2 * trips)
+        t_concurrent = per_pass("compute", 2 * trips)
+    else:
+        t_serial = per_pass(serial_mode, trips)
+        t_concurrent = per_pass(overlap_mode, trips)
+    log.print(f"measured serial total: {t_serial * 1e6:.3f} us/pass")
+
+    with maybe_trace(args.enable_profiling, args.trace_dir) as trace_dir:
+        if trace_dir:
+            # one traced run so the profiler artifact shows the kernel
+            jax.block_until_ready(pipeline.overlap_run(
+                x, mode=overlap_mode if serial_mode != "compute2"
+                else "compute", tripcount=trips, passes=100))
+            log.print(f"profiler trace: {trace_dir}")
+
+    resources = [_RESOURCE[k] for k in names]
+    verdict = concurrency_verdict(
+        per_times, t_concurrent, rule=args.rule, resources=resources
+    )
+    log.result(
+        f"concurrency[onchip:{'+'.join(names)}]",
+        verdict,
+        commands=names,
+        mode=mode,
+        engine="onchip",
+        rule=args.rule,
+        resources=resources,
+        tripcount=trips,
+        serial_us=t_serial * 1e6,
+        concurrent_us=t_concurrent * 1e6,
+        per_command_us=[t * 1e6 for t in per_times],
+    )
+    return verdict.exit_code
+
+
 def run(args) -> int:
     log = RunLog(args.log, truncate=not args.log_append)
     mode = engine.canonical_mode(args.mode)
+    if args.engine == "onchip" or (
+        args.engine == "auto" and _onchip_supported(args, mode)
+    ):
+        if args.engine == "onchip" and not _onchip_supported(args, mode):
+            log.print("ERROR: --engine onchip needs a real TPU backend, "
+                      "mode serial/async (aliases included), n_queues<=1, "
+                      f"and a supported command pair {sorted(_ONCHIP_PAIRS)}")
+            log.print("FAILURE")
+            return 1
+        return run_onchip(args, log, mode)
     devices = topology.get_devices(args.backend)
     command_list, tune_info = build_commands(args, devices)
     names = [c.name for c in command_list]

@@ -113,3 +113,26 @@ def test_runlog_jsonl_and_summary(tmp_path, capsys):
     lines = [json.loads(l) for l in (tmp_path / "run.jsonl").read_text().splitlines()]
     assert [l["name"] for l in lines] == ["a", "b"]
     assert lines[0]["commands"] == ["C", "M2D"]
+
+
+def test_resource_aware_verdict():
+    from hpc_patterns_tpu.harness import concurrency_verdict
+
+    # two commands on DIFFERENT resources: classic 2x bar (must overlap)
+    v = concurrency_verdict([1.0, 1.0], 1.9, resources=["core", "hbm"])
+    assert not v.success and v.max_theoretical_speedup == 2.0
+    v = concurrency_verdict([1.0, 1.0], 1.05, resources=["core", "hbm"])
+    assert v.success
+
+    # two commands SHARING a resource: floor is the sum — no overlap is
+    # physically possible, so ~1x passes and the 2x bar is never applied
+    v = concurrency_verdict([1.0, 1.0], 2.1, resources=["hbm", "hbm"])
+    assert v.success and v.max_theoretical_speedup == 1.0
+    v = concurrency_verdict([1.0, 1.0], 2.8, resources=["hbm", "hbm"])
+    assert not v.success  # >1.3x slower than the resource floor
+
+    # misaligned resources rejected
+    import pytest
+
+    with pytest.raises(ValueError, match="align"):
+        concurrency_verdict([1.0, 1.0], 1.0, resources=["core"])

@@ -40,6 +40,24 @@ class TestOverlapKernel:
             out = pipeline.overlap_run(hbm, mode=mode, tripcount=2)
             assert np.asarray(out).shape == (8, 128)
 
+    def test_out_direction_checksum_parity(self, hbm):
+        # overlap_out's writeback flies under compute; the chain result
+        # must be identical to the strictly-serialized walk
+        a = pipeline.overlap_run(hbm, mode="overlap_out", tripcount=3, passes=2)
+        b = pipeline.overlap_run(hbm, mode="serial_out", tripcount=3, passes=2)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_pair_modes_checksum_parity(self, hbm):
+        # the copy-through pipeline must read the same chunks as the
+        # strictly-serialized in/out walk
+        a = pipeline.overlap_run(hbm, mode="pair_overlap", passes=2)
+        b = pipeline.overlap_run(hbm, mode="pair_serial", passes=2)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_dma_out_mode_runs(self, hbm):
+        out = pipeline.overlap_run(hbm, mode="dma_out", tripcount=1)
+        assert np.asarray(out).shape == (8, 128)
+
     def test_bad_mode_and_shape(self, hbm):
         with pytest.raises(ValueError, match="mode"):
             pipeline.overlap_run(hbm, mode="warp")
