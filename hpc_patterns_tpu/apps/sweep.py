@@ -24,8 +24,14 @@ DEFAULT_MATRIX = [["C", "C"], ["C", "M2D"], ["C", "D2M"], ["M2D", "D2M"]]
 
 def build_parser():
     p = base_parser(__doc__.splitlines()[0])
-    p.add_argument("--modes", nargs="*", default=["async", "threads"],
-                   help="dispatch modes to sweep (run.sh sweeps out_of_order, in_order)")
+    p.add_argument("--modes", nargs="*", default=None,
+                   help="dispatch modes to sweep (run.sh sweeps out_of_order, "
+                        "in_order); default: async+threads on a multi-device "
+                        "backend, async alone on a single TPU (threads-style "
+                        "dispatch cannot overlap on one sequential core)")
+    p.add_argument("--engine", default="auto",
+                   choices=["auto", "dispatch", "onchip"],
+                   help="passed through to the concurrency app")
     p.add_argument("--copy-elements", type=int, default=-1)
     p.add_argument("--tripcount", type=int, default=-1)
     p.add_argument("--rule", default="sycl", choices=["sycl", "omp"])
@@ -37,9 +43,17 @@ def build_parser():
 def run(args) -> int:
     log = RunLog(args.log, truncate=not args.log_append)  # harness owns the log
     app_parser = concurrency_app.build_parser()
+    modes = args.modes
+    if modes is None:
+        import jax
+
+        single_tpu = (jax.default_backend() == "tpu"
+                      and len(jax.devices()) == 1)
+        modes = ["async"] if single_tpu else ["async", "threads"]
     for commands in DEFAULT_MATRIX:
-        for mode in args.modes:
+        for mode in modes:
             argv = [mode, *commands,
+                    "--engine", args.engine,
                     "--copy-elements", str(args.copy_elements),
                     "--tripcount", str(args.tripcount),
                     "--rule", args.rule,

@@ -30,4 +30,8 @@ from hpc_patterns_tpu.parallel.tensor import (  # noqa: F401
     row_parallel,
     tp_mlp,
 )
-from hpc_patterns_tpu.parallel.pipeline import pipeline_forward  # noqa: F401
+from hpc_patterns_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_forward,
+    pipeline_train_1f1b,
+    schedule_1f1b,
+)

@@ -1,12 +1,22 @@
-"""Pipeline parallelism: microbatch fill-drain over the pt2pt ring.
+"""Pipeline parallelism: microbatch schedules over the pt2pt ring.
 
 The reference's pairwise blocking Send/Recv between ring neighbors is
 "the core of PP" (SURVEY.md §2.2): a pipeline stage boundary is exactly
 one neighbor handoff per tick. This module turns that primitive
 (comm.ring.ring_shift — deadlock-free ppermute, vs the reference's
-even/odd ordering trick, allreduce-mpi-sycl.cpp:50-58) into a GPipe-style
-forward schedule: rank r runs stage r; microbatch m enters at tick m,
-reaches stage r at tick m+r, exits after M + P - 1 ticks.
+even/odd ordering trick, allreduce-mpi-sycl.cpp:50-58) into two
+schedules:
+
+- :func:`pipeline_forward` — GPipe-style forward fill-drain: rank r runs
+  stage r; microbatch m enters at tick m, reaches stage r at tick m+r,
+  exits after M + P - 1 ticks.
+- :func:`pipeline_train_1f1b` — the 1F1B training schedule: each stage
+  runs its warmup forwards, then alternates one-forward-one-backward, so
+  at most P - r microbatch activations are ever stashed on stage r
+  (vs all M under GPipe) — the input stash here is sized min(P, M) and
+  ring-indexed, the real 1F1B memory bound. Backward is recompute-based
+  (``jax.vjp`` of the stage on the stashed input), the standard PP
+  memory/FLOPs trade.
 
 SPMD subtlety: inside ``shard_map`` every rank executes the same program,
 so "is my buffer valid at this tick" is data (a mask), not control flow —
@@ -19,6 +29,7 @@ from __future__ import annotations
 
 from typing import Callable
 
+import jax
 import jax.numpy as jnp
 
 from hpc_patterns_tpu.comm import ring
@@ -72,3 +83,162 @@ def pipeline_forward(
         buf = ring.ring_shift(y, axis, 1)
 
     return outs
+
+
+def schedule_1f1b(P: int, M: int):
+    """The 1F1B tick table (pure Python — testable without devices).
+
+    Unit fwd/bwd costs. Returns ``(fwd, bwd)`` dicts mapping
+    ``(stage, microbatch) -> tick``:
+
+    - forward:  warmup ``t_f(r, m) = m + r`` for the first ``P - r``
+      microbatches (streamed back-to-back), then steady-state
+      ``t_f(r, m) = 2m + r`` — each forward follows the backward of
+      microbatch ``m - (P - r)`` (the one-forward-one-backward
+      alternation; earlier stages idle between warmup and their first
+      backward, which is the 1F1B bubble).
+    - backward: ``t_b(r, m) = 2P - 1 - r + 2m`` — microbatch m's
+      backward leaves the last stage right after its forward and walks
+      back one stage per tick.
+
+    Properties (asserted by tests): per stage, no two ops share a tick;
+    an activation is produced >= 1 tick before its consumer needs it;
+    the number of stashed activations on stage r never exceeds
+    ``min(P - r, M)`` — the 1F1B memory bound.
+    """
+    fwd = {}
+    bwd = {}
+    for r in range(P):
+        for m in range(M):
+            fwd[(r, m)] = m + r if m <= P - 1 - r else 2 * m + r
+            bwd[(r, m)] = 2 * P - 1 - r + 2 * m
+    return fwd, bwd
+
+
+def pipeline_train_1f1b(
+    stage_fn: Callable,
+    stage_params,
+    x_microbatches,
+    targets,
+    loss_fn: Callable,
+    axis: str,
+):
+    """One 1F1B pipeline training pass (rank-local; run inside
+    ``shard_map``): forward every microbatch through the P stages,
+    seed each backward with d(loss)/dy on the last stage, and return
+    this stage's accumulated parameter gradients.
+
+    ``stage_fn(params, x) -> y`` must preserve the microbatch shape
+    (project in/out inside); ``loss_fn(y, target) -> scalar`` is applied
+    per microbatch on the LAST stage. ``x_microbatches``: (M, ...) read
+    on rank 0; ``targets``: (M, ...) read on rank P-1 (other ranks pass
+    same-shaped arrays). Returns ``(mean_loss, grads)`` where mean_loss
+    is valid on the last rank (zeros elsewhere) and ``grads`` matches
+    ``stage_params`` (this stage's gradient, summed over microbatches —
+    divide by M upstream for a mean-loss gradient if desired; here the
+    seed is grad of ``loss_fn`` itself per microbatch, accumulated).
+
+    Scheduling follows :func:`schedule_1f1b`; the input stash and the
+    activation/cotangent mailboxes are ring-indexed with ``min(P, M)``
+    slots — the 1F1B in-flight bound (GPipe would need all M).
+    """
+    P = ring.axis_size(axis)
+    me = ring.axis_index(axis)
+    M = x_microbatches.shape[0]
+    mb_shape = x_microbatches.shape[1:]
+    S = min(P, M)  # stash slots: the 1F1B in-flight bound
+    f32 = jnp.float32
+
+    in_stash = jnp.zeros((S, *mb_shape), x_microbatches.dtype)
+    fwd_mail = jnp.zeros((S, *mb_shape), x_microbatches.dtype)
+    bwd_mail = jnp.zeros((S, *mb_shape), f32)
+    grads = jax.tree.map(lambda p: jnp.zeros(p.shape, f32), stage_params)
+    loss_sum = jnp.zeros((), f32)
+
+    def fwd_microbatch_at(t):
+        """(m, valid) for this rank's forward at tick t (traced me)."""
+        warm = t - me  # warmup: t_f = m + r
+        warm_ok = jnp.logical_and(warm >= 0, warm <= P - 1 - me)
+        steady = (t - me) // 2  # steady: t_f = 2m + r
+        steady_ok = jnp.logical_and(
+            (t - me) % 2 == 0, steady > P - 1 - me
+        )
+        m = jnp.where(warm_ok, warm, steady)
+        ok = jnp.logical_and(
+            jnp.logical_or(warm_ok, steady_ok),
+            jnp.logical_and(m >= 0, m < M),
+        )
+        return m, ok
+
+    def bwd_microbatch_at(t):
+        num = t - (2 * P - 1 - me)
+        m = num // 2
+        ok = jnp.logical_and(
+            jnp.logical_and(num >= 0, num % 2 == 0),
+            m < M,
+        )
+        return m, ok
+
+    def masked_bank(mail, m, ok, payload):
+        slot = m % S
+        cur = mail[slot]
+        return mail.at[slot].set(
+            jnp.where(ok, payload.astype(mail.dtype), cur)
+        )
+
+    n_ticks = 2 * M + 2 * P - 3 + 1
+    for t in range(n_ticks):
+        m_f, f_ok = fwd_microbatch_at(t)
+        m_b, b_ok = bwd_microbatch_at(t)
+        x_f = jnp.where(
+            me == 0, x_microbatches[jnp.clip(m_f, 0, M - 1)],
+            fwd_mail[m_f % S],
+        )
+        x_b = in_stash[m_b % S]
+        in_stash = masked_bank(in_stash, m_f, f_ok, x_f)
+
+        # ONE stage evaluation serves both units: per stage, forward and
+        # backward never share a tick (schedule invariant), so select
+        # the input and run a single vjp — y is the forward's output on
+        # f_ok ticks, the recomputed activation on b_ok ticks
+        x_sel = jnp.where(b_ok, x_b, x_f)
+        y, pullback = jax.vjp(stage_fn, stage_params, x_sel)
+
+        is_last = me == P - 1
+        tgt = targets[jnp.clip(m_b, 0, M - 1)]
+        loss_m, dloss = jax.value_and_grad(loss_fn)(
+            y.astype(f32), tgt
+        )
+        dy = jnp.where(is_last, dloss, bwd_mail[m_b % S]).astype(y.dtype)
+        dparams, dx = pullback(dy)
+        b_mask = b_ok.astype(f32)
+        grads = jax.tree.map(
+            lambda g, d: g + b_mask * d.astype(f32), grads, dparams
+        )
+        loss_sum = loss_sum + jnp.where(
+            jnp.logical_and(b_ok, is_last), loss_m, 0.0
+        )
+
+        # ---- neighbor handoffs (every tick, masked payloads): the
+        # activation hops forward, the cotangent hops backward, each
+        # tagged with its microbatch index for the mailbox
+        y_send = jnp.where(f_ok, y, jnp.zeros_like(y))
+        y_recv = ring.ring_shift(y_send, axis, 1)
+        mf_recv = ring.ring_shift(jnp.stack([m_f, f_ok.astype(m_f.dtype)]),
+                                  axis, 1)
+        fwd_mail = masked_bank(
+            fwd_mail, mf_recv[0],
+            jnp.logical_and(mf_recv[1] == 1, me != 0), y_recv,
+        )
+
+        dx_send = jnp.where(b_ok, dx.astype(f32), jnp.zeros(mb_shape, f32))
+        dx_recv = ring.ring_shift(dx_send, axis, -1)
+        mb_recv = ring.ring_shift(jnp.stack([m_b, b_ok.astype(m_b.dtype)]),
+                                  axis, -1)
+        bwd_mail = masked_bank(
+            bwd_mail, mb_recv[0],
+            jnp.logical_and(mb_recv[1] == 1, me != P - 1), dx_recv,
+        )
+
+    mean_loss = jnp.where(me == P - 1, loss_sum / M, 0.0)
+    return mean_loss, grads
