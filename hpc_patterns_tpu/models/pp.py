@@ -1,0 +1,199 @@
+"""Pipeline-parallel training for the flagship transformer.
+
+The missing member of the parallelism matrix (dp/sp/tp/ep live in
+models/transformer.py + models/sharding.py): layers split into P
+contiguous stages over the ``pp`` mesh axis, driven by the 1F1B schedule
+(parallel/pipeline.py — itself built on the reference's pt2pt ring,
+SURVEY.md §2.2 "pairwise pt2pt: the core of PP").
+
+Decomposition:
+
+- **embedding** (embed + pos_embed): computed outside the pipeline on
+  every rank (replicated math); its gradient comes back through the
+  pipeline's input cotangents (``return_input_grads``).
+- **stages**: the stacked layer params' leading ``n_layers`` axis is
+  sharded over ``pp`` — each rank scans its ``L/P`` layers as one
+  shape-preserving ``stage_fn``.
+- **head** (ln_f_scale + lm_head): the last stage's loss head,
+  differentiated via the pipeline's ``loss_params`` hook.
+
+Gradients for the replicated pieces are psum'd over ``pp`` (only one
+rank produces nonzero values — rank 0 for the embedding, rank P-1 for
+the head — so the psum is a broadcast), exactly the §2.3 backend
+property: collectives on device-resident shards, no host staging.
+
+Composes with data parallelism: on a ("dp", "pp") mesh the batch is
+dp-sharded outside, the pipeline runs per dp-slice, and gradients are
+pmean'd over dp.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import optax
+
+from hpc_patterns_tpu.models.transformer import (
+    TransformerConfig,
+    _layer,
+    _rmsnorm,
+    init_params,
+    masked_causal_nll,
+)
+from hpc_patterns_tpu.models.train import make_optimizer
+from hpc_patterns_tpu.parallel.pipeline import pipeline_train_1f1b
+
+
+def _embed(outer, tokens, cfg):
+    dt = jnp.dtype(cfg.dtype)
+    T = tokens.shape[-1]
+    return (outer["embed"].astype(dt)[tokens]
+            + outer["pos_embed"].astype(dt)[:T])
+
+
+def _stage_fn(layers_shard, h, cfg):
+    """One pipeline stage: scan this rank's L/P layers (shape-preserving,
+    single-device math — mesh=None inside the pp rank)."""
+    def body(x, lp):
+        x, _ = _layer(x, lp, cfg, mesh=None, act_spec=None)
+        return x, None
+
+    h, _ = lax.scan(body, h, layers_shard)
+    return h
+
+
+def _loss_head(lp, y, target_tokens):
+    """Final-norm + LM head + the shared masked causal NLL
+    (transformer.masked_causal_nll — identical loss semantics to
+    transformer.loss_fn by construction)."""
+    x = _rmsnorm(y, lp["ln_f_scale"])
+    logits = jnp.dot(x, lp["lm_head"].astype(y.dtype)).astype(jnp.float32)
+    return masked_causal_nll(logits, target_tokens)
+
+
+def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
+                      *, microbatches: int, axis_pp: str = "pp",
+                      axis_dp: str | None = None):
+    """Mean causal-LM loss and full-parameter gradients via a 1F1B
+    pipeline over ``axis_pp`` (optionally data-parallel over ``axis_dp``).
+
+    ``params``: the standard init_params pytree (layers stacked on
+    n_layers, which must divide by the pp axis size); ``tokens``:
+    (batch, seq) int32, batch divisible by microbatches (× dp size).
+    Loss and gradients are replicated on return (pipeline-internal
+    validity masks are resolved by psum/pmean over the mesh axes).
+    """
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "pipeline-parallel MoE: the load-balance aux loss is not "
+            "threaded through the 1F1B schedule yet — use the dp/ep "
+            "train path (models/train.py) for MoE models"
+        )
+    M = microbatches
+    pp = mesh.shape[axis_pp]
+    L = cfg.n_layers
+    if L % pp:
+        raise ValueError(f"n_layers {L} must divide by pp={pp}")
+    B = tokens.shape[0]
+    dp = mesh.shape[axis_dp] if axis_dp else 1
+    if B % (M * dp):
+        raise ValueError(f"batch {B} must divide by microbatches*dp={M * dp}")
+
+    outer = {"embed": params["embed"], "pos_embed": params["pos_embed"]}
+    head = {"ln_f_scale": params["ln_f_scale"], "lm_head": params["lm_head"]}
+
+    def local(outer, layers_shard, head, tokens_local):
+        toks = tokens_local.reshape(M, -1, tokens_local.shape[-1])
+        x_mb = _embed(outer, toks, cfg)
+
+        loss, layer_grads, extras = pipeline_train_1f1b(
+            partial(_stage_fn, cfg=cfg),
+            layers_shard,
+            x_mb,
+            toks,
+            _loss_head,
+            axis_pp,
+            loss_params=head,
+            return_input_grads=True,
+        )
+
+        # embedding backward: cotangents of the pipeline inputs (nonzero
+        # on pp rank 0) pulled through the replicated embedding math
+        _, embed_vjp = jax.vjp(lambda o: _embed(o, toks, cfg), outer)
+        (outer_grads,) = embed_vjp(extras["input_grads"].astype(x_mb.dtype))
+
+        # replicate the rank-local pieces: loss and head grads live on
+        # the last pp rank, embedding grads on rank 0, so psum = broadcast
+        loss = lax.psum(loss, axis_pp)
+        head_grads = jax.tree.map(lambda g: lax.psum(g, axis_pp),
+                                  extras["loss_grads"])
+        outer_grads = jax.tree.map(
+            lambda g: lax.psum(
+                jnp.where(lax.axis_index(axis_pp) == 0, g.astype(jnp.float32),
+                          jnp.zeros_like(g, jnp.float32)),
+                axis_pp,
+            ),
+            outer_grads,
+        )
+        grads_all = (outer_grads, layer_grads, head_grads)
+        if axis_dp:
+            loss = lax.pmean(loss, axis_dp)
+            grads_all = jax.tree.map(lambda g: lax.pmean(g, axis_dp),
+                                     grads_all)
+        # grads are summed over microbatches; the loss head is per-
+        # microbatch mean, so divide by M for the mean-loss gradient
+        return loss[None], *jax.tree.map(lambda g: g / M, grads_all)
+
+    layer_spec = P(axis_pp)   # leading n_layers axis -> L/P per rank
+    tok_spec = P(axis_dp) if axis_dp else P()
+    loss_r, outer_g, layer_g, head_g = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), layer_spec, P(), tok_spec),
+        out_specs=(P(axis_pp) if not axis_dp else P((axis_dp, axis_pp)),
+                   P(), layer_spec, P()),
+        check_vma=False,  # validity masks + psum-broadcasts aren't VMA-provable
+    )(outer, params["layers"], head, tokens)
+
+    loss = loss_r[0]
+    grads = {
+        "embed": outer_g["embed"],
+        "pos_embed": outer_g["pos_embed"],
+        "layers": layer_g,
+        "ln_f_scale": head_g["ln_f_scale"],
+        "lm_head": head_g["lm_head"],
+    }
+    return loss, grads
+
+
+def make_pp_train_step(cfg: TransformerConfig, mesh, *, microbatches: int,
+                       axis_pp: str = "pp", axis_dp: str | None = None,
+                       optimizer=None):
+    """Jitted ``step(params, opt_state, tokens) -> (loss, params,
+    opt_state)`` training the full model through the 1F1B pipeline."""
+    optimizer = optimizer or make_optimizer()
+
+    def step(params, opt_state, tokens):
+        loss, grads = pp_loss_and_grads(
+            params, tokens, cfg, mesh, microbatches=microbatches,
+            axis_pp=axis_pp, axis_dp=axis_dp,
+        )
+        grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return loss, params, opt_state
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def init_pp_train_state(key, cfg: TransformerConfig, optimizer=None):
+    """f32 params + opt state (replicated; the layer stack's leading axis
+    is what the pp shard_map slices)."""
+    optimizer = optimizer or make_optimizer()
+    params = init_params(key, cfg)
+    return params, optimizer.init(params)

@@ -288,6 +288,19 @@ def forward(params, tokens, cfg: TransformerConfig, mesh=None, *,
     return logits
 
 
+def masked_causal_nll(logits, tokens):
+    """Mean next-token NLL with the final position masked out — shared by
+    loss_fn and the pipeline-parallel loss head (models/pp.py), so loss
+    semantics can't drift between the two training paths."""
+    B, T = tokens.shape
+    targets = jnp.roll(tokens, -1, axis=1)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - gold
+    mask = (lax.broadcasted_iota(jnp.int32, (B, T), 1) < T - 1).astype(nll.dtype)
+    return jnp.sum(nll * mask) / jnp.sum(mask)
+
+
 def loss_fn(params, tokens, cfg: TransformerConfig, mesh=None):
     """Causal LM loss: predict token t+1 from prefix ≤ t (mean NLL).
 
@@ -295,14 +308,8 @@ def loss_fn(params, tokens, cfg: TransformerConfig, mesh=None):
     position is masked out of the loss — rather than slicing to seq-1 —
     so sequence shardings (seq % sp == 0) survive into the activations.
     """
-    B, T = tokens.shape
     logits, aux = forward(params, tokens, cfg, mesh, return_aux=True)
-    targets = jnp.roll(tokens, -1, axis=1)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    nll = logz - gold
-    mask = (lax.broadcasted_iota(jnp.int32, (B, T), 1) < T - 1).astype(nll.dtype)
-    loss = jnp.sum(nll * mask) / jnp.sum(mask)
+    loss = masked_causal_nll(logits, tokens)
     if cfg.n_experts:
         loss = loss + cfg.moe_aux_weight * aux
     return loss

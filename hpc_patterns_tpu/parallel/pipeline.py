@@ -122,6 +122,9 @@ def pipeline_train_1f1b(
     targets,
     loss_fn: Callable,
     axis: str,
+    *,
+    loss_params=None,
+    return_input_grads: bool = False,
 ):
     """One 1F1B pipeline training pass (rank-local; run inside
     ``shard_map``): forward every microbatch through the P stages,
@@ -138,6 +141,17 @@ def pipeline_train_1f1b(
     divide by M upstream for a mean-loss gradient if desired; here the
     seed is grad of ``loss_fn`` itself per microbatch, accumulated).
 
+    ``loss_params`` (optional): a pytree the last stage's loss head
+    differentiates through — ``loss_fn(loss_params, y, target)`` — e.g.
+    the LM head + final norm of a pipelined transformer; their gradient
+    is returned too (nonzero on the last rank; psum over the axis to
+    replicate). ``return_input_grads``: also return d(loss)/d(x_m) as an
+    (M, ...) f32 array (nonzero on rank 0) — the hook for differentiating
+    whatever produced the pipeline inputs (e.g. the embedding).
+    With either option the return becomes ``(mean_loss, grads, extras)``
+    with ``extras = {"loss_grads": ..., "input_grads": ...}`` (the
+    requested keys only); plain calls keep the 2-tuple.
+
     Scheduling follows :func:`schedule_1f1b`; the input stash and the
     activation/cotangent mailboxes are ring-indexed with ``min(P, M)``
     slots — the 1F1B in-flight bound (GPipe would need all M).
@@ -153,6 +167,10 @@ def pipeline_train_1f1b(
     fwd_mail = jnp.zeros((S, *mb_shape), x_microbatches.dtype)
     bwd_mail = jnp.zeros((S, *mb_shape), f32)
     grads = jax.tree.map(lambda p: jnp.zeros(p.shape, f32), stage_params)
+    loss_grads = (None if loss_params is None else jax.tree.map(
+        lambda p: jnp.zeros(p.shape, f32), loss_params))
+    in_grads = (jnp.zeros((M, *mb_shape), f32)
+                if return_input_grads else None)
     loss_sum = jnp.zeros((), f32)
 
     def fwd_microbatch_at(t):
@@ -188,57 +206,96 @@ def pipeline_train_1f1b(
 
     n_ticks = 2 * M + 2 * P - 3 + 1
     for t in range(n_ticks):
-        m_f, f_ok = fwd_microbatch_at(t)
-        m_b, b_ok = bwd_microbatch_at(t)
-        x_f = jnp.where(
-            me == 0, x_microbatches[jnp.clip(m_f, 0, M - 1)],
-            fwd_mail[m_f % S],
-        )
-        x_b = in_stash[m_b % S]
-        in_stash = masked_bank(in_stash, m_f, f_ok, x_f)
-
-        # ONE stage evaluation serves both units: per stage, forward and
-        # backward never share a tick (schedule invariant), so select
-        # the input and run a single vjp — y is the forward's output on
-        # f_ok ticks, the recomputed activation on b_ok ticks
-        x_sel = jnp.where(b_ok, x_b, x_f)
-        y, pullback = jax.vjp(stage_fn, stage_params, x_sel)
-
+        # static tick phases: before tick P no rank can run a backward
+        # (first is t_b(P-1, 0) = P), after tick 2M+P-3 no rank forwards
+        # (last is t_f(P-1, M-1)) — skip the corresponding unit entirely
+        # instead of emitting fully-masked compute
+        has_fwd = t <= 2 * M + P - 3
+        has_bwd = t >= P
         is_last = me == P - 1
-        tgt = targets[jnp.clip(m_b, 0, M - 1)]
-        loss_m, dloss = jax.value_and_grad(loss_fn)(
-            y.astype(f32), tgt
-        )
-        dy = jnp.where(is_last, dloss, bwd_mail[m_b % S]).astype(y.dtype)
-        dparams, dx = pullback(dy)
-        b_mask = b_ok.astype(f32)
-        grads = jax.tree.map(
-            lambda g, d: g + b_mask * d.astype(f32), grads, dparams
-        )
-        loss_sum = loss_sum + jnp.where(
-            jnp.logical_and(b_ok, is_last), loss_m, 0.0
-        )
 
-        # ---- neighbor handoffs (every tick, masked payloads): the
-        # activation hops forward, the cotangent hops backward, each
-        # tagged with its microbatch index for the mailbox
-        y_send = jnp.where(f_ok, y, jnp.zeros_like(y))
-        y_recv = ring.ring_shift(y_send, axis, 1)
-        mf_recv = ring.ring_shift(jnp.stack([m_f, f_ok.astype(m_f.dtype)]),
-                                  axis, 1)
-        fwd_mail = masked_bank(
-            fwd_mail, mf_recv[0],
-            jnp.logical_and(mf_recv[1] == 1, me != 0), y_recv,
-        )
+        if has_fwd:
+            m_f, f_ok = fwd_microbatch_at(t)
+            x_f = jnp.where(
+                me == 0, x_microbatches[jnp.clip(m_f, 0, M - 1)],
+                fwd_mail[m_f % S],
+            )
+            in_stash = masked_bank(in_stash, m_f, f_ok, x_f)
+        if has_bwd:
+            m_b, b_ok = bwd_microbatch_at(t)
+            x_b = in_stash[m_b % S]
 
-        dx_send = jnp.where(b_ok, dx.astype(f32), jnp.zeros(mb_shape, f32))
-        dx_recv = ring.ring_shift(dx_send, axis, -1)
-        mb_recv = ring.ring_shift(jnp.stack([m_b, b_ok.astype(m_b.dtype)]),
-                                  axis, -1)
-        bwd_mail = masked_bank(
-            bwd_mail, mb_recv[0],
-            jnp.logical_and(mb_recv[1] == 1, me != P - 1), dx_recv,
-        )
+        if not has_bwd:
+            # fwd-only tick: plain stage evaluation, no pullback, no loss
+            y = stage_fn(stage_params, x_f)
+        else:
+            # ONE stage evaluation serves both units: per stage, forward
+            # and backward never share a tick (schedule invariant), so
+            # select the input and run a single vjp — y is the forward's
+            # output on f_ok ticks, the recomputed activation on b_ok
+            x_sel = jnp.where(b_ok, x_b, x_f) if has_fwd else x_b
+            y, pullback = jax.vjp(stage_fn, stage_params, x_sel)
+
+            tgt = targets[jnp.clip(m_b, 0, M - 1)]
+            if loss_params is None:
+                loss_m, dloss = jax.value_and_grad(loss_fn)(
+                    y.astype(f32), tgt
+                )
+            else:
+                loss_m, (dlp, dloss) = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1)
+                )(loss_params, y.astype(f32), tgt)
+                lp_mask = jnp.logical_and(b_ok, is_last).astype(f32)
+                loss_grads = jax.tree.map(
+                    lambda g, d: g + lp_mask * d.astype(f32), loss_grads, dlp
+                )
+            dy = jnp.where(is_last, dloss, bwd_mail[m_b % S]).astype(y.dtype)
+            dparams, dx = pullback(dy)
+            b_mask = b_ok.astype(f32)
+            grads = jax.tree.map(
+                lambda g, d: g + b_mask * d.astype(f32), grads, dparams
+            )
+            if return_input_grads:
+                take = jnp.logical_and(b_ok, me == 0)
+                idx = jnp.clip(m_b, 0, M - 1)
+                in_grads = in_grads.at[idx].set(
+                    jnp.where(take, dx.astype(f32), in_grads[idx])
+                )
+            loss_sum = loss_sum + jnp.where(
+                jnp.logical_and(b_ok, is_last), loss_m, 0.0
+            )
+
+        # ---- neighbor handoffs (masked payloads; only phases that can
+        # carry data hop): the activation hops forward, the cotangent
+        # hops backward, each tagged with its microbatch index
+        if has_fwd:
+            y_send = jnp.where(f_ok, y, jnp.zeros_like(y))
+            y_recv = ring.ring_shift(y_send, axis, 1)
+            mf_recv = ring.ring_shift(
+                jnp.stack([m_f, f_ok.astype(m_f.dtype)]), axis, 1
+            )
+            fwd_mail = masked_bank(
+                fwd_mail, mf_recv[0],
+                jnp.logical_and(mf_recv[1] == 1, me != 0), y_recv,
+            )
+        if has_bwd:
+            dx_send = jnp.where(b_ok, dx.astype(f32),
+                                jnp.zeros(mb_shape, f32))
+            dx_recv = ring.ring_shift(dx_send, axis, -1)
+            mb_recv = ring.ring_shift(
+                jnp.stack([m_b, b_ok.astype(m_b.dtype)]), axis, -1
+            )
+            bwd_mail = masked_bank(
+                bwd_mail, mb_recv[0],
+                jnp.logical_and(mb_recv[1] == 1, me != P - 1), dx_recv,
+            )
 
     mean_loss = jnp.where(me == P - 1, loss_sum / M, 0.0)
+    extras = {}
+    if loss_params is not None:
+        extras["loss_grads"] = loss_grads
+    if return_input_grads:
+        extras["input_grads"] = in_grads
+    if extras:
+        return mean_loss, grads, extras
     return mean_loss, grads
