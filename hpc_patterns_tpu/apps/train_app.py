@@ -43,6 +43,12 @@ def build_parser():
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1)
     p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel stages (1F1B schedule, "
+                        "models/pp.py); layers must divide by it")
+    p.add_argument("--microbatches", type=int, default=4,
+                   help="microbatches per step for --pp (batch must "
+                        "divide by microbatches*dp)")
     p.add_argument("--ep", type=int, default=1,
                    help="expert-parallel axis (requires --n-experts)")
     p.add_argument("--n-experts", type=int, default=0,
@@ -56,45 +62,14 @@ def build_parser():
     return p
 
 
-def run(args) -> int:
-    log = RunLog(args.log, truncate=not args.log_append)
-    if args.prefetch < 0:
-        log.print(f"ERROR: --prefetch must be >= 0, got {args.prefetch}")
-        log.print("FAILURE")
-        return 1
-    if args.ep > 1 and not args.n_experts:
-        log.print("ERROR: --ep requires --n-experts")
-        log.print("FAILURE")
-        return 1
-    if args.n_experts and args.n_experts % max(args.ep, 1):
-        log.print(f"ERROR: --n-experts {args.n_experts} must divide by "
-                  f"--ep {args.ep}")
-        log.print("FAILURE")
-        return 1
-    cfg = TransformerConfig(
-        vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
-        n_layers=args.n_layers, d_ff=4 * args.d_model, max_seq=args.seq,
-        attention=args.attention, remat=args.remat, n_experts=args.n_experts,
-    )
-    n_mesh = args.dp * args.sp * args.tp * args.ep
-    if args.attention == "flash" and args.sp > 1:
-        log.print("ERROR: attention='flash' needs the sequence unsharded "
-                  "(--sp 1); use ring_flash for a sharded sequence")
-        log.print("FAILURE")
-        return 1
-    # every impl except the two single-path ones needs a mesh to shard over
-    use_mesh = n_mesh > 1 or args.attention not in ("full", "flash")
-    mesh = None
-    if use_mesh:
-        devices = topology.get_devices(args.backend)
-        axes = {"dp": args.dp, "sp": args.sp, "tp": args.tp}
-        if args.ep > 1:
-            axes["ep"] = args.ep
-        mesh = topology.make_mesh(axes, devices[:n_mesh])
-
-    params, opt_state = init_train_state(jax.random.PRNGKey(0), cfg, mesh)
-    step_fn = make_train_step(cfg, mesh)
-    tokens = make_batch(jax.random.PRNGKey(1), cfg, args.batch, args.seq, mesh)
+def _train_loop(args, log, cfg, mesh, params, opt_state, step_fn, *,
+                name, result_extra):
+    """The shared training loop + self-validation: prefetch (optional),
+    timed steps, finite/decreasing-loss checks, --resume-check, verdict.
+    Both the sharded-train path and the --pp 1F1B path run through here
+    so the loss/verdict semantics cannot drift between them."""
+    tokens = make_batch(jax.random.PRNGKey(1), cfg, args.batch, args.seq,
+                        mesh)
 
     if args.prefetch:
         from hpc_patterns_tpu.models.sharding import batch_sharding
@@ -155,20 +130,119 @@ def run(args) -> int:
     step_s = min(steady)
     tokens_per_s = args.batch * args.seq / step_s
     log.emit(
-        kind="result", name="train", success=ok,
+        kind="result", name=name, success=ok,
         steps=args.steps, loss_first=losses[0], loss_last=losses[-1],
         step_time_s=step_s, tokens_per_s=tokens_per_s,
         mesh=dict(mesh.shape) if mesh else None,
         attention=args.attention, checkpoint=ckpt_path,
+        **result_extra,
     )
+    label = result_extra.get("label", args.attention)
     log.print(
-        f"train[{args.attention}] {args.steps} steps: loss "
+        f"train[{label}] {args.steps} steps: loss "
         f"{losses[0]:.4f}->{losses[-1]:.4f}, {step_s * 1e3:.1f} ms/step, "
         f"{tokens_per_s:,.0f} tok/s"
     )
     verdict = Verdict(success=ok, messages=("SUCCESS" if ok else "FAILURE",))
     log.print(verdict.summary_line())
     return verdict.exit_code
+
+
+def _run_pp(args, log, cfg) -> int:
+    """--pp path: 1F1B pipeline training (models/pp.py), optionally
+    data-parallel; stage-local math only (no sp/tp/ep inside stages)."""
+    from hpc_patterns_tpu.models import pp as pplib
+
+    if args.sp > 1 or args.tp > 1 or args.ep > 1 or args.n_experts:
+        log.print("ERROR: --pp composes with --dp only (stage-local "
+                  "math; no sp/tp/ep inside pipeline stages yet)")
+        log.print("FAILURE")
+        return 1
+    if args.attention not in ("full", "flash"):
+        log.print("ERROR: --pp needs a stage-local attention "
+                  "(--attention full or flash)")
+        log.print("FAILURE")
+        return 1
+    if args.microbatches < 1:
+        log.print(f"ERROR: --microbatches must be >= 1, "
+                  f"got {args.microbatches}")
+        log.print("FAILURE")
+        return 1
+    if args.n_layers % args.pp:
+        log.print(f"ERROR: --n-layers {args.n_layers} must divide by "
+                  f"--pp {args.pp}")
+        log.print("FAILURE")
+        return 1
+    if args.batch % (args.microbatches * args.dp):
+        log.print(f"ERROR: --batch {args.batch} must divide by "
+                  f"--microbatches*--dp = {args.microbatches * args.dp}")
+        log.print("FAILURE")
+        return 1
+
+    devices = topology.get_devices(args.backend)
+    axes = ({"dp": args.dp, "pp": args.pp} if args.dp > 1
+            else {"pp": args.pp})
+    mesh = topology.make_mesh(axes, devices[:args.dp * args.pp])
+    params, opt_state = pplib.init_pp_train_state(jax.random.PRNGKey(0), cfg)
+    step_fn = pplib.make_pp_train_step(
+        cfg, mesh, microbatches=args.microbatches,
+        axis_dp="dp" if args.dp > 1 else None,
+    )
+    return _train_loop(
+        args, log, cfg, mesh, params, opt_state, step_fn, name="train_pp",
+        result_extra={"microbatches": args.microbatches,
+                      "label": f"pp={args.pp} 1f1b"},
+    )
+
+
+def run(args) -> int:
+    log = RunLog(args.log, truncate=not args.log_append)
+    if args.prefetch < 0:
+        log.print(f"ERROR: --prefetch must be >= 0, got {args.prefetch}")
+        log.print("FAILURE")
+        return 1
+    if args.steps < 1:
+        log.print(f"ERROR: --steps must be >= 1, got {args.steps}")
+        log.print("FAILURE")
+        return 1
+    if args.ep > 1 and not args.n_experts:
+        log.print("ERROR: --ep requires --n-experts")
+        log.print("FAILURE")
+        return 1
+    if args.n_experts and args.n_experts % max(args.ep, 1):
+        log.print(f"ERROR: --n-experts {args.n_experts} must divide by "
+                  f"--ep {args.ep}")
+        log.print("FAILURE")
+        return 1
+    cfg = TransformerConfig(
+        vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
+        n_layers=args.n_layers, d_ff=4 * args.d_model, max_seq=args.seq,
+        attention=args.attention, remat=args.remat, n_experts=args.n_experts,
+    )
+    if args.pp > 1:
+        return _run_pp(args, log, cfg)
+    n_mesh = args.dp * args.sp * args.tp * args.ep
+    if args.attention == "flash" and args.sp > 1:
+        log.print("ERROR: attention='flash' needs the sequence unsharded "
+                  "(--sp 1); use ring_flash for a sharded sequence")
+        log.print("FAILURE")
+        return 1
+    # every impl except the two single-path ones needs a mesh to shard over
+    use_mesh = n_mesh > 1 or args.attention not in ("full", "flash")
+    mesh = None
+    if use_mesh:
+        devices = topology.get_devices(args.backend)
+        axes = {"dp": args.dp, "sp": args.sp, "tp": args.tp}
+        if args.ep > 1:
+            axes["ep"] = args.ep
+        mesh = topology.make_mesh(axes, devices[:n_mesh])
+
+    params, opt_state = init_train_state(jax.random.PRNGKey(0), cfg, mesh)
+    step_fn = make_train_step(cfg, mesh)
+    return _train_loop(
+        args, log, cfg, mesh, params, opt_state, step_fn, name="train",
+        result_extra={},
+    )
 
 
 def main(argv=None) -> int:

@@ -88,6 +88,26 @@ class TestTrainApp:
         assert code == 0, out
         assert "SUCCESS" in out and "tok/s" in out
 
+    def test_pp_run(self, capsys):
+        from hpc_patterns_tpu.apps import train_app
+
+        code = train_app.main(
+            ["--steps", "3", "--batch", "4", "--seq", "8", "--d-model", "16",
+             "--n-layers", "2", "--n-heads", "2", "--vocab", "32",
+             "--pp", "2", "--microbatches", "2"]
+        )
+        out = capsys.readouterr().out
+        assert code == 0, out
+        assert "1f1b" in out and "SUCCESS" in out
+
+    def test_pp_rejects_tp(self, capsys):
+        from hpc_patterns_tpu.apps import train_app
+
+        code = train_app.main(["--pp", "2", "--tp", "2"])
+        out = capsys.readouterr().out
+        assert code == 1
+        assert "composes with --dp only" in out
+
     def test_mesh_run_with_resume(self, capsys, tmp_path):
         from hpc_patterns_tpu.apps import train_app
 
