@@ -2,27 +2,29 @@
 
 Standard flash-attention dataflow, TPU-shaped:
 
-- grid = (batch·heads, Tq/BLOCK_Q): one program per query block per head;
-  Pallas auto-pipelines each program's HBM→VMEM block loads against the
-  previous program's compute (the same DMA/compute overlap the
-  concurrency suite measures, here for free from the grid).
-- K/V for the whole (small) sequence sit in VMEM per program; the kernel
-  walks K/V blocks with ``lax.fori_loop``, maintaining the online
-  softmax state (m, l, acc) in f32 — numerically identical to the
-  two-pass softmax (same accumulator as parallel/ring_attention, which
-  runs this dataflow *across chips*).
-- block matmuls hit the MXU via ``jnp.dot(..., preferred_element_type=
-  f32)``; bf16 inputs stay bf16 into the MXU.
-- causal masking is in GLOBAL positions: the kernel takes (q_offset,
-  k_offset) scalars in SMEM, so the same kernel serves the single-device
-  case (offsets 0) and one ring-attention step (q at rank·T, the
-  visiting K/V block at src·S). Masked entries get a finite -1e30
-  (inf-free, like ring_attention); whole K/V blocks outside the causal
-  triangle are skipped via the (dynamic) loop bounds — a fully-future
-  block costs zero iterations.
+- grid = (batch·heads, Tq/BLOCK_Q, Tk/BLOCK_K): K/V stream through VMEM
+  one block per grid step while the online-softmax state (m, l, acc)
+  carries across the kv axis in f32 scratch — sequence length is
+  HBM-bounded, not VMEM-bounded (same accumulator as
+  parallel/ring_attention, which runs this dataflow *across chips*).
+  Pallas auto-pipelines each step's HBM→VMEM block loads against the
+  previous step's compute (the same DMA/compute overlap the concurrency
+  suite measures, here for free from the grid).
+- big blocks by default (512×1024): grid-step overhead amortizes over
+  the MXU-shaped block matmuls (``jnp.dot(...,
+  preferred_element_type=f32)``; bf16 inputs stay bf16 into the MXU).
+- causal masking is in GLOBAL positions: the kernels take (q_offset,
+  k_offset) scalars via scalar prefetch, so the same kernel serves the
+  single-device case (offsets 0) and one ring-attention step (q at
+  rank·T, the visiting K/V block at src·S). Masked entries get a finite
+  -1e30 (inf-free, like ring_attention); blocks outside the causal
+  triangle skip their compute via ``pl.when`` AND their HBM fetch — the
+  index map clamps to the last visible block, and Pallas elides the
+  repeated fetch.
 - backward (Dao 2023 §B): Δ = rowsum(dO ⊙ O), then two blockwise passes
-  — dQ over K blocks, dK/dV over Q blocks — recomputing P from the
-  forward's saved per-row logsumexp. O(block) VMEM in both directions.
+  — dQ streaming K blocks, dK/dV streaming Q blocks — recomputing P
+  from the forward's saved per-row logsumexp. O(block) VMEM in both
+  directions.
 
 Two public entry points:
 
@@ -61,157 +63,212 @@ def _causal_mask(s, q_start, k_start):
     return jnp.where(k_pos <= q_pos, s, _NEG_INF)
 
 
-def _kv_block_bound(q_end_g, k_off, block_k, n_kv):
-    """Number of leading K/V blocks a query block must visit under the
-    causal mask: those starting at or before the query block's global
-    end. 0 when the whole K/V side is in the future."""
-    return jnp.clip((q_end_g - k_off) // block_k + 1, 0, n_kv)
+def _kv_index_map(block_q, block_k, causal):
+    """kv-block index map for grid (bh, qi, ki): causal clamps ki to the
+    last block visible from this query block, so every fully-future grid
+    step revisits the previous block and Pallas skips its HBM fetch."""
+    if not causal:
+        return lambda bh, qi, ki, offs: (bh, ki, 0)
+
+    def idx(bh, qi, ki, offs):
+        q_end_g = offs[0] + (qi + 1) * block_q - 1
+        last = jnp.maximum((q_end_g - offs[1]) // block_k, 0)
+        return bh, jnp.minimum(ki, last), 0
+
+    return idx
 
 
-def _q_block_start(k_start_g, q_off, block_q, n_q):
-    """First query block (index) that can see a K block starting at
-    global position ``k_start_g`` under the causal mask; n_q when none."""
-    return jnp.clip((k_start_g - q_off) // block_q, 0, n_q)
+def _q_index_map(block_q, block_k, causal, n_q):
+    """q-block index map for grid (bh, ki, qi): causal clamps qi UP to
+    the first block that can see this K block (earlier steps revisit it,
+    skipping the fetch)."""
+    if not causal:
+        return lambda bh, ki, qi, offs: (bh, qi, 0)
+
+    def idx(bh, ki, qi, offs):
+        k_start_g = offs[1] + ki * block_k
+        first = jnp.clip((k_start_g - offs[0]) // block_q, 0, n_q - 1)
+        return bh, jnp.maximum(qi, first), 0
+
+    return idx
 
 
-def _kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, *lse_ref, block_k: int,
-            scale: float, causal: bool):
-    # offs_ref: (1, 2) int32 SMEM [q_offset, k_offset] global positions;
-    # q_ref: (BLOCK_Q, D); k_ref/v_ref: (Tk, D); o_ref: (BLOCK_Q, D);
-    # optional lse_ref: (BLOCK_Q, 1) per-row logsumexp for the backward
+def _kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
+            causal: bool, with_lse: bool):
+    # grid (B·H, n_q, n_kv): K/V stream through VMEM one block per grid
+    # step (no whole-sequence residency — T is bounded by HBM, not VMEM);
+    # the online-softmax state (m, l, acc) carries across the kv axis in
+    # scratch. offs_ref: (2,) int32 scalar-prefetch [q_offset, k_offset].
+    if with_lse:
+        lse_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        m_ref, l_ref, acc_ref = rest
     block_q, d = q_ref.shape
-    tk = k_ref.shape[0]
-    n_kv = tk // block_k
-    qi = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32) * scale
-    q_start_g = offs_ref[0, 0] + qi * block_q
-    k_off = offs_ref[0, 1]
+    block_k = k_ref.shape[0]
+    ki = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+    q_start_g = offs_ref[0] + pl.program_id(1) * block_q
+    k_start_g = offs_ref[1] + ki * block_k
 
-    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(ki == 0)
+    def _():
+        m_ref[:] = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+        l_ref[:] = jnp.zeros((block_q, 1), jnp.float32)
+        acc_ref[:] = jnp.zeros((block_q, d), jnp.float32)
 
-    def body(ki, state):
-        m, l, acc = state
-        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+    # causal: a K/V block fully in the future contributes nothing — its
+    # compute is skipped here and its fetch was already elided by the
+    # clamped index map (the streamed analog of the loop-bound skip)
+    visible = (k_start_g <= q_start_g + block_q - 1) if causal else True
+
+    @pl.when(visible)
+    def _():
+        q = q_ref[:].astype(jnp.float32) * scale
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if causal:
-            s = _causal_mask(s, q_start_g, k_off + ki * block_k)
+            s = _causal_mask(s, q_start_g, k_start_g)
+        m = m_ref[:]
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         rescale = jnp.exp(m - m_new)
-        l_new = l * rescale + p.sum(axis=-1, keepdims=True)
-        acc_new = acc * rescale + jnp.dot(
-            p, v_blk, preferred_element_type=jnp.float32
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * rescale + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * rescale + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
         )
-        return m_new, l_new, acc_new
 
-    n_iter = (_kv_block_bound(q_start_g + block_q - 1, k_off, block_k, n_kv)
-              if causal else n_kv)
-    m, l, acc = lax.fori_loop(0, n_iter, body, (m0, l0, acc0))
-    l = jnp.maximum(l, 1e-30)
-    out = acc / l
-    if causal:
-        # rows with nothing visible (m never rose): out 0, lse -> -1e30,
-        # matching _dense_forward — not an average of whatever was visited
-        out = jnp.where(m <= _NEG_INF * 0.5, 0.0, out)
-    o_ref[:] = out.astype(o_ref.dtype)
-    if lse_ref:
-        lse_ref[0][:] = m + jnp.log(l)
+    @pl.when(ki == n_kv - 1)
+    def _():
+        m = m_ref[:]
+        l = jnp.maximum(l_ref[:], 1e-30)
+        out = acc_ref[:] / l
+        if causal:
+            # rows with nothing visible (m never rose): out 0,
+            # lse -> -1e30, matching _dense_forward
+            out = jnp.where(m <= _NEG_INF * 0.5, 0.0, out)
+        o_ref[:] = out.astype(o_ref.dtype)
+        if with_lse:
+            lse_ref[:] = m + jnp.log(l)
 
 
 def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, *, block_k: int, scale: float, causal: bool):
-    # One program per query block: walk K/V blocks, accumulate dQ.
+               dq_ref, dq_acc_ref, *, scale: float, causal: bool):
+    # grid (B·H, n_q, n_kv), dQ carried in scratch across the kv axis.
     # dS = P * (dO·Vᵀ − Δ); dQ = scale · dS·K, with P recomputed from the
     # saved per-row logsumexp (no (T,T) matrix ever materialized).
     block_q, d = q_ref.shape
-    tk = k_ref.shape[0]
-    n_kv = tk // block_k
-    qi = pl.program_id(1)
-    q_start_g = offs_ref[0, 0] + qi * block_q
-    k_off = offs_ref[0, 1]
+    block_k = k_ref.shape[0]
+    ki = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+    q_start_g = offs_ref[0] + pl.program_id(1) * block_q
+    k_start_g = offs_ref[1] + ki * block_k
 
-    q = q_ref[:].astype(jnp.float32)
-    do = do_ref[:].astype(jnp.float32)
-    lse = lse_ref[:]      # (BLOCK_Q, 1)
-    delta = delta_ref[:]  # (BLOCK_Q, 1)
+    @pl.when(ki == 0)
+    def _():
+        dq_acc_ref[:] = jnp.zeros((block_q, d), jnp.float32)
 
-    def body(ki, dq):
-        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+    visible = (k_start_g <= q_start_g + block_q - 1) if causal else True
+
+    @pl.when(visible)
+    def _():
+        q = q_ref[:].astype(jnp.float32)
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        do = do_ref[:].astype(jnp.float32)
+        lse = lse_ref[:]      # (BLOCK_Q, 1)
+        delta = delta_ref[:]  # (BLOCK_Q, 1)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, q_start_g, k_off + ki * block_k)
+            s = _causal_mask(s, q_start_g, k_start_g)
         p = jnp.exp(s - lse)
         if causal:
             # dead rows have lse=-1e30, where exp(s - lse) = 1 on masked
             # entries; match _dense_backward's explicit zero
             p = jnp.where(s > _NEG_INF * 0.5, p, 0.0)
-        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+        dq_acc_ref[:] = dq_acc_ref[:] + jnp.dot(
+            ds, k, preferred_element_type=jnp.float32
+        )
 
-    n_iter = (_kv_block_bound(q_start_g + block_q - 1, k_off, block_k, n_kv)
-              if causal else n_kv)
-    dq = lax.fori_loop(0, n_iter, body, jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(ki == n_kv - 1)
+    def _():
+        dq_ref[:] = (dq_acc_ref[:] * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-                dk_ref, dv_ref, *, block_q: int, scale: float, causal: bool):
-    # One program per K/V block: walk query blocks, accumulate dK and dV.
-    # dV = Pᵀ·dO; dK = scale · dSᵀ·Q. Causal: query blocks strictly before
-    # this K block see none of it — start the walk at the diagonal.
+                dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *, scale: float,
+                causal: bool):
+    # grid (B·H, n_kv, n_q), dK/dV carried in scratch across the q axis.
+    # dV = Pᵀ·dO; dK = scale · dSᵀ·Q. Causal: query blocks strictly
+    # before this K block see none of it — skipped via pl.when.
     block_k, d = k_ref.shape
-    tq = q_ref.shape[0]
-    n_q = tq // block_q
-    ki = pl.program_id(1)
-    q_off = offs_ref[0, 0]
-    k_start_g = offs_ref[0, 1] + ki * block_k
+    block_q = q_ref.shape[0]
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+    q_start_g = offs_ref[0] + qi * block_q
+    k_start_g = offs_ref[1] + pl.program_id(1) * block_k
 
-    k = k_ref[:].astype(jnp.float32)
-    v = v_ref[:].astype(jnp.float32)
+    @pl.when(qi == 0)
+    def _():
+        dk_acc_ref[:] = jnp.zeros((block_k, d), jnp.float32)
+        dv_acc_ref[:] = jnp.zeros((block_k, d), jnp.float32)
 
-    def body(qi, state):
-        dk, dv = state
-        q_blk = q_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(qi * block_q, block_q), :]
-        delta = delta_ref[pl.ds(qi * block_q, block_q), :]
-        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * scale
+    visible = (q_start_g + block_q - 1 >= k_start_g) if causal else True
+
+    @pl.when(visible)
+    def _():
+        q = q_ref[:].astype(jnp.float32)
+        do = do_ref[:].astype(jnp.float32)
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        lse = lse_ref[:]
+        delta = delta_ref[:]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, q_off + qi * block_q, k_start_g)
+            s = _causal_mask(s, q_start_g, k_start_g)
         p = jnp.exp(s - lse)
         if causal:
             p = jnp.where(s > _NEG_INF * 0.5, p, 0.0)
-        dv_new = dv + jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
-        dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
+        dv_acc_ref[:] = dv_acc_ref[:] + jnp.dot(
+            p.T, do, preferred_element_type=jnp.float32
+        )
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        dk_new = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
-        return dk_new, dv_new
+        dk_acc_ref[:] = dk_acc_ref[:] + jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32
+        )
 
-    start = _q_block_start(k_start_g, q_off, block_q, n_q) if causal else 0
-    dk, dv = lax.fori_loop(
-        start, n_q, body,
-        (jnp.zeros((block_k, d), jnp.float32),
-         jnp.zeros((block_k, d), jnp.float32)),
-    )
-    dk_ref[:] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[:] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == n_q - 1)
+    def _():
+        dk_ref[:] = (dk_acc_ref[:] * scale).astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+def _fit_block(block, t):
+    """Clamp ``block`` to ``t`` and halve until it divides, floored at
+    128 (the TPU lane width — smaller blocks would break tiling and
+    waste the MXU). The streamed kernels want big blocks: grid-step
+    overhead amortizes over them. Lengths that no 128-multiple divides
+    still fail validation — pad upstream."""
+    block = min(block, t)
+    while t % block and block >= 256:
+        block //= 2
+    return block
 
 
 def _resolve(Tq, Tk, D, scale, block_q, block_k, interpret, *,
              validate=True):
     """Resolve the shared per-call parameters (scale default, block
-    clamping, interpret default). ``validate=False`` for the backward,
+    fitting, interpret default). ``validate=False`` for the backward,
     whose shapes the forward already validated — the resolution logic
     must stay common so fwd and bwd never disagree on block sizes."""
     if scale is None:
         scale = 1.0 / (D ** 0.5)
-    block_q = min(block_q, Tq)
-    block_k = min(block_k, Tk)
+    block_q = _fit_block(block_q, Tq)
+    block_k = _fit_block(block_k, Tk)
     if validate and (Tq % block_q or Tk % block_k):
         raise ValueError(
             f"seq ({Tq}, {Tk}) must divide by blocks ({block_q}, {block_k})"
@@ -224,10 +281,6 @@ def _resolve(Tq, Tk, D, scale, block_q, block_k, interpret, *,
 def _to_kernel_layout(x):
     B, T, H, D = x.shape
     return jnp.einsum("bthd->bhtd", x).reshape(B * H, T, D)
-
-
-_SMEM_OFFS = pl.BlockSpec((1, 2), lambda bh, i: (0, 0),
-                          memory_space=pltpu.SMEM)
 
 
 def _align_vma(*arrays):
@@ -251,8 +304,8 @@ def _masked_scores(qr, kr, offs, scale, causal):
         "ntd,nsd->nts", qr.astype(jnp.float32), kr.astype(jnp.float32)
     ) * scale
     if causal:
-        q_pos = offs[0, 0] + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        k_pos = offs[0, 1] + lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        q_pos = offs[0] + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        k_pos = offs[1] + lax.broadcasted_iota(jnp.int32, s.shape, 2)
         s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
     return s
 
@@ -305,12 +358,17 @@ def _forward_impl(q, k, v, offs, *, causal, scale, block_q, block_k,
     qr, kr, vr = map(_to_kernel_layout, (q, k, v))
 
     kernel = functools.partial(
-        _kernel, block_k=block_k, scale=scale, causal=causal,
+        _kernel, scale=scale, causal=causal, with_lse=need_lse,
     )
-    blk_q = pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0),
+    # index maps see the prefetched offsets: for causal, clamp the kv
+    # block index to the last visible block — consecutive clamped steps
+    # revisit the same block, so Pallas elides the HBM fetch entirely
+    kv_idx = _kv_index_map(block_q, block_k, causal)
+    blk_q = pl.BlockSpec((None, block_q, D),
+                         lambda bh, qi, ki, offs: (bh, qi, 0),
                          memory_space=pltpu.VMEM)
-    full_k = pl.BlockSpec((None, Tk, D), lambda bh, qi: (bh, 0, 0),
-                          memory_space=pltpu.VMEM)
+    blk_k = pl.BlockSpec((None, block_k, D), kv_idx,
+                         memory_space=pltpu.VMEM)
     (offs, qr, kr, vr), vma = _align_vma(offs, qr, kr, vr)
     if interpret and vma:
         outr, lse = _dense_forward(qr, kr, vr, offs, causal=causal,
@@ -322,7 +380,8 @@ def _forward_impl(q, k, v, offs, *, causal, scale, block_q, block_k,
     out_shape = [jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype, vma=vma)]
     if need_lse:
         out_specs.append(
-            pl.BlockSpec((None, block_q, 1), lambda bh, qi: (bh, qi, 0),
+            pl.BlockSpec((None, block_q, 1),
+                         lambda bh, qi, ki, offs: (bh, qi, 0),
                          memory_space=pltpu.VMEM)
         )
         out_shape.append(
@@ -331,9 +390,17 @@ def _forward_impl(q, k, v, offs, *, causal, scale, block_q, block_k,
 
     results = pl.pallas_call(
         kernel,
-        grid=(B * H, Tq // block_q),
-        in_specs=[_SMEM_OFFS, blk_q, full_k, full_k],
-        out_specs=tuple(out_specs),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B * H, Tq // block_q, Tk // block_k),
+            in_specs=[blk_q, blk_k, blk_k],
+            out_specs=tuple(out_specs),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, 1), jnp.float32),   # running max m
+                pltpu.VMEM((block_q, 1), jnp.float32),   # running sum l
+                pltpu.VMEM((block_q, D), jnp.float32),   # output accumulator
+            ],
+        ),
         out_shape=tuple(out_shape),
         interpret=interpret,
     )(offs, qr, kr, vr)
@@ -372,30 +439,43 @@ def _backward_impl(qr, kr, vr, outr, lse, offs, g, g_lse, *, causal, scale,
         back = lambda x, t: x.reshape(B, H, t, D).transpose(0, 2, 1, 3)
         return back(dq, Tq), back(dk, Tk), back(dv, Tk)
     row = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
-    blk_q = row((None, block_q, D), lambda bh, i: (bh, i, 0))
-    blk_k = row((None, block_k, D), lambda bh, i: (bh, i, 0))
-    full_q = row((None, Tq, D), lambda bh, i: (bh, 0, 0))
-    full_k = row((None, Tk, D), lambda bh, i: (bh, 0, 0))
-    vec_q = row((None, block_q, 1), lambda bh, i: (bh, i, 0))
-    vec_full = row((None, Tq, 1), lambda bh, i: (bh, 0, 0))
+    kv_idx = _kv_index_map(block_q, block_k, causal)
+    q_idx = _q_index_map(block_q, block_k, causal, Tq // block_q)
+    # grid (B·H, n_q, n_kv): q-indexed blocks follow axis 1, kv axis 2
+    q_on1 = row((None, block_q, D), lambda bh, qi, ki, offs: (bh, qi, 0))
+    k_on2 = row((None, block_k, D), kv_idx)
+    vec_on1 = row((None, block_q, 1), lambda bh, qi, ki, offs: (bh, qi, 0))
+    # grid (B·H, n_kv, n_q): kv-indexed blocks follow axis 1, q axis 2
+    k_on1 = row((None, block_k, D), lambda bh, ki, qi, offs: (bh, ki, 0))
+    q_on2 = row((None, block_q, D), q_idx)
+    vec_on2 = row((None, block_q, 1),
+                  lambda bh, ki, qi, offs: q_idx(bh, ki, qi, offs))
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, block_k=block_k, scale=scale,
-                          causal=causal),
-        grid=(B * H, Tq // block_q),
-        in_specs=[_SMEM_OFFS, blk_q, full_k, full_k, blk_q, vec_q, vec_q],
-        out_specs=blk_q,
+        functools.partial(_dq_kernel, scale=scale, causal=causal),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B * H, Tq // block_q, Tk // block_k),
+            in_specs=[q_on1, k_on2, k_on2, q_on1, vec_on1, vec_on1],
+            out_specs=q_on1,
+            scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        ),
         out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), qr.dtype, vma=vma),
         interpret=interpret,
     )(offs, qr, kr, vr, dor, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, block_q=block_q, scale=scale,
-                          causal=causal),
-        grid=(B * H, Tk // block_k),
-        in_specs=[_SMEM_OFFS, full_q, full_q, vec_full, vec_full,
-                  blk_k, blk_k],
-        out_specs=(blk_k, blk_k),
+        functools.partial(_dkv_kernel, scale=scale, causal=causal),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B * H, Tk // block_k, Tq // block_q),
+            in_specs=[q_on2, q_on2, vec_on2, vec_on2, k_on1, k_on1],
+            out_specs=(k_on1, k_on1),
+            scratch_shapes=[
+                pltpu.VMEM((block_k, D), jnp.float32),
+                pltpu.VMEM((block_k, D), jnp.float32),
+            ],
+        ),
         out_shape=(
             jax.ShapeDtypeStruct((B * H, Tk, D), kr.dtype, vma=vma),
             jax.ShapeDtypeStruct((B * H, Tk, D), vr.dtype, vma=vma),
@@ -408,7 +488,7 @@ def _backward_impl(qr, kr, vr, outr, lse, offs, g, g_lse, *, causal, scale,
 
 
 def _zero_offs():
-    return jnp.zeros((1, 2), jnp.int32)
+    return jnp.zeros((2,), jnp.int32)
 
 
 # ---------------------------------------------------------------- square
@@ -449,8 +529,8 @@ def flash_attention(
     *,
     causal: bool = True,
     scale: float | None = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 1024,
     interpret: bool | None = None,
 ):
     """Softmax attention over (batch, seq, heads, head_dim) inputs.
@@ -474,7 +554,7 @@ def flash_attention(
 )
 def _flash_block_with_vjp(q, k, v, offs_i, causal, scale, block_q, block_k,
                           interpret):
-    offs = offs_i.reshape(1, 2)
+    offs = offs_i.reshape(2)
     out, (_, _, _, _, lse) = _forward_impl(
         q, k, v, offs, causal=causal, scale=scale, block_q=block_q,
         block_k=block_k, interpret=interpret, need_lse=True,
@@ -486,7 +566,7 @@ def _flash_block_with_vjp(q, k, v, offs_i, causal, scale, block_q, block_k,
 
 def _flash_block_fwd(q, k, v, offs_i, causal, scale, block_q, block_k,
                      interpret):
-    offs = offs_i.reshape(1, 2)
+    offs = offs_i.reshape(2)
     out, residuals = _forward_impl(
         q, k, v, offs, causal=causal, scale=scale, block_q=block_q,
         block_k=block_k, interpret=interpret, need_lse=True,
@@ -522,8 +602,8 @@ def flash_attention_block(
     *,
     causal: bool = True,
     scale: float | None = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 1024,
     interpret: bool | None = None,
 ):
     """One *partial* attention: local queries ``q`` (global position
@@ -540,9 +620,11 @@ def flash_attention_block(
     ring exchange-accumulate, allreduce-mpi-sycl.cpp:173-182, with
     attention as the combine). Offsets may be traced (e.g. derived from
     ``axis_index`` inside shard_map). A fully-future block (causal,
-    k_offset > all query positions) runs zero kernel iterations and
+    k_offset > all query positions) skips all fetches/matmuls and
     returns out=0, lse≈-1e30, which the merge weights to zero.
     Differentiable in q, k, v, including gradient flow through lse.
+    (A fully-future block's fetches and matmuls are skipped, not just
+    masked.)
     """
     offs_i = jnp.stack([
         jnp.asarray(q_offset, jnp.int32), jnp.asarray(k_offset, jnp.int32)

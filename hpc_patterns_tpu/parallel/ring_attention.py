@@ -64,8 +64,8 @@ def ring_attention(
     causal: bool = False,
     scale: float | None = None,
     impl: str = "dense",
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 1024,
 ):
     """Attention over a sequence sharded on mesh ``axis`` (rank-local; run
     inside ``shard_map``).
@@ -79,7 +79,7 @@ def ring_attention(
     (T_local, S) score block (any shape); ``"flash"`` runs the Pallas
     blockwise kernel per visiting block (ops.flash_attention_block) and
     merges partials by logsumexp — O(block) VMEM on-chip, MXU-shaped,
-    and causally-skipped blocks cost zero kernel iterations. Requires
+    and causally-skipped blocks cost no fetches or matmuls. Requires
     the local sequence to divide by the (clamped) block sizes.
     """
     if q.ndim != 4:
