@@ -36,6 +36,8 @@ def build_parser():
     p.add_argument("--d-model", type=int, default=256)
     p.add_argument("--n-layers", type=int, default=4)
     p.add_argument("--n-heads", type=int, default=8)
+    p.add_argument("--n-kv-heads", type=int, default=0,
+                   help="grouped-query attention KV heads (0 = MHA)")
     p.add_argument("--vocab", type=int, default=1024)
     p.add_argument("--attention", default="full",
                    choices=list(ATTENTION_IMPLS))
@@ -218,6 +220,7 @@ def run(args) -> int:
         vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, d_ff=4 * args.d_model, max_seq=args.seq,
         attention=args.attention, remat=args.remat, n_experts=args.n_experts,
+        n_kv_heads=args.n_kv_heads,
     )
     if args.pp > 1:
         return _run_pp(args, log, cfg)

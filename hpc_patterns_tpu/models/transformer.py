@@ -53,6 +53,13 @@ class TransformerConfig:
     max_seq: int = 2048
     dtype: str = "bfloat16"  # compute dtype (MXU-native)
     attention: str = "full"  # full | flash | ring[_flash] | ulysses[_flash]
+    # grouped-query attention: 0 = MHA (kv heads == n_heads); smaller
+    # values share each KV head across n_heads/n_kv_heads query heads,
+    # shrinking the qkv projection (weights + FLOPs) and any KV cache.
+    # NOTE: attention itself currently expands K/V back to n_heads, so
+    # attention-side activation memory matches MHA; n_heads must divide
+    # by n_kv_heads
+    n_kv_heads: int = 0
     remat: bool = False
     # mixture-of-experts: 0 = dense MLP; >0 = Switch-style top-1 MoE
     # with experts sharded over the ep axis (parallel/moe.py)
@@ -71,6 +78,10 @@ class TransformerConfig:
         return frozenset((self.axis_dp, self.axis_sp, self.axis_tp, self.axis_ep))
 
     @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
     def head_dim(self) -> int:
         if self.d_model % self.n_heads:
             raise ValueError(f"d_model {self.d_model} % n_heads {self.n_heads} != 0")
@@ -80,6 +91,13 @@ class TransformerConfig:
         if self.attention not in ATTENTION_IMPLS:
             raise ValueError(
                 f"attention {self.attention!r} not in {ATTENTION_IMPLS}"
+            )
+        if self.n_kv_heads < 0 or self.n_kv_heads > self.n_heads or (
+            self.n_kv_heads and self.n_heads % self.n_kv_heads
+        ):
+            raise ValueError(
+                f"n_kv_heads {self.n_kv_heads} must be in [1, n_heads] and "
+                f"divide n_heads {self.n_heads} (0 = MHA)"
             )
 
 
@@ -95,7 +113,10 @@ def init_params(key, cfg: TransformerConfig):
     layers = {
         "ln1_scale": jnp.ones((L, D), jnp.float32),
         "ln2_scale": jnp.ones((L, D), jnp.float32),
-        "wqkv": initn((L, D, 3 * D), D ** -0.5),
+        # fused q + k + v projection; with GQA the kv widths shrink to
+        # kv_heads * head_dim
+        "wqkv": initn((L, D, D + 2 * cfg.kv_heads * cfg.head_dim),
+                      D ** -0.5),
         "wo": initn((L, D, D), (2 * D * L) ** -0.5),
     }
     if cfg.n_experts:
@@ -231,11 +252,18 @@ def _layer(x, lp, cfg: TransformerConfig, mesh, act_spec):
         return lax.with_sharding_constraint(y, spec) if mesh is not None else y
 
     h = _rmsnorm(x, lp["ln1_scale"])
-    qkv = jnp.dot(h, lp["wqkv"].astype(dt))  # (B, T, 3D) — column-parallel
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    qkv = jnp.dot(h, lp["wqkv"].astype(dt))  # column-parallel
+    Hkv = cfg.kv_heads
+    kv_dim = Hkv * Dh
+    q, k, v = jnp.split(qkv, [D, D + kv_dim], axis=-1)
     q = q.reshape(B, T, H, Dh)
-    k = k.reshape(B, T, H, Dh)
-    v = v.reshape(B, T, H, Dh)
+    k = k.reshape(B, T, Hkv, Dh)
+    v = v.reshape(B, T, Hkv, Dh)
+    if Hkv != H:
+        # GQA: each KV head serves n_heads/kv_heads query heads; the
+        # expand keeps every attention impl (flash/ring/ulysses) unaware
+        k = jnp.repeat(k, H // Hkv, axis=2)
+        v = jnp.repeat(v, H // Hkv, axis=2)
     o = _attention(q, k, v, cfg, mesh)
     o = jnp.dot(o.reshape(B, T, D), lp["wo"].astype(dt))  # row-parallel
     x = c(x + o, act_spec)

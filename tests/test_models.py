@@ -123,3 +123,68 @@ class TestTrainStep:
         tokens = make_batch(jax.random.PRNGKey(2), cfg, 4, 16, mesh_dp_sp_tp)
         assert tokens.shape == (4, 16)
         assert tokens.sharding.spec == jax.sharding.PartitionSpec("dp", "sp")
+
+
+class TestGQA:
+    def test_kv_heads_equal_heads_is_mha(self):
+        base = dict(vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                    max_seq=16, dtype="float32")
+        cfg_a = TransformerConfig(**base)
+        cfg_b = TransformerConfig(**base, n_kv_heads=4)
+        params = init_params(jax.random.PRNGKey(0), cfg_a)
+        tokens = _tokens(jax.random.PRNGKey(1), b=2, t=16)
+        np.testing.assert_allclose(
+            np.asarray(forward(params, tokens, cfg_a)),
+            np.asarray(forward(params, tokens, cfg_b)),
+        )
+
+    @pytest.mark.parametrize("attention", ["full", "flash"])
+    def test_gqa_impls_agree(self, attention):
+        cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                                d_ff=64, max_seq=16, dtype="float32",
+                                n_kv_heads=2, attention=attention)
+        cfg_full = TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                     n_layers=2, d_ff=64, max_seq=16,
+                                     dtype="float32", n_kv_heads=2)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        assert params["layers"]["wqkv"].shape == (2, 32, 32 + 2 * 2 * 8)
+        tokens = _tokens(jax.random.PRNGKey(1), b=2, t=16)
+        np.testing.assert_allclose(
+            np.asarray(forward(params, tokens, cfg)),
+            np.asarray(forward(params, tokens, cfg_full)),
+            atol=1e-4,
+        )
+
+    def test_gqa_sharded_matches_local(self, mesh_dp_sp_tp):
+        tiny = dict(vocab=64, d_model=32, n_heads=8, n_layers=1, d_ff=64,
+                    max_seq=16, dtype="float32", n_kv_heads=2)
+        cfg_local = TransformerConfig(**tiny)
+        cfg_mesh = TransformerConfig(**{**tiny, "attention": "ring"})
+        params = init_params(jax.random.PRNGKey(0), cfg_local)
+        tokens = _tokens(jax.random.PRNGKey(1), b=4, t=16)
+        want = loss_fn(params, tokens, cfg_local)
+
+        from hpc_patterns_tpu.models.sharding import shard_params
+
+        p_sharded = shard_params(params, mesh_dp_sp_tp, cfg_mesh)
+        got = jax.jit(
+            lambda p, tk: loss_fn(p, tk, cfg_mesh, mesh_dp_sp_tp)
+        )(p_sharded, tokens)
+        np.testing.assert_allclose(float(got), float(want), rtol=2e-5)
+
+    def test_bad_kv_heads_rejected(self):
+        with pytest.raises(ValueError, match="n_kv_heads"):
+            TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=1,
+                              d_ff=64, max_seq=16, n_kv_heads=3)
+
+    def test_gqa_train_learns(self):
+        cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                                d_ff=64, max_seq=16, n_kv_heads=2)
+        params, opt = init_train_state(jax.random.PRNGKey(0), cfg)
+        step = make_train_step(cfg)
+        tokens = _tokens(jax.random.PRNGKey(1), b=8, t=16)
+        losses = []
+        for _ in range(5):
+            loss, params, opt = step(params, opt, tokens)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
