@@ -139,19 +139,33 @@ class TestGQA:
         )
 
     @pytest.mark.parametrize("attention", ["full", "flash"])
-    def test_gqa_impls_agree(self, attention):
-        cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
-                                d_ff=64, max_seq=16, dtype="float32",
-                                n_kv_heads=2, attention=attention)
-        cfg_full = TransformerConfig(vocab=64, d_model=32, n_heads=4,
-                                     n_layers=2, d_ff=64, max_seq=16,
-                                     dtype="float32", n_kv_heads=2)
+    def test_gqa_matches_mha_with_expanded_kv(self, attention):
+        # oracle: an MHA model whose K/V projection columns are the GQA
+        # weights repeated per head group — GQA must equal it exactly
+        base = dict(vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                    max_seq=16, dtype="float32")
+        cfg = TransformerConfig(**base, n_kv_heads=2, attention=attention)
+        cfg_mha = TransformerConfig(**base)
         params = init_params(jax.random.PRNGKey(0), cfg)
-        assert params["layers"]["wqkv"].shape == (2, 32, 32 + 2 * 2 * 8)
+        L, D, Dh, H, Hkv = 2, 32, 8, 4, 2
+        wqkv = np.asarray(params["layers"]["wqkv"])
+        assert wqkv.shape == (L, D, D + 2 * Hkv * Dh)
+        qw = wqkv[..., :D]
+        kw, vw = (
+            wqkv[..., D + i * Hkv * Dh:D + (i + 1) * Hkv * Dh]
+            .reshape(L, D, Hkv, Dh).repeat(H // Hkv, axis=2)
+            .reshape(L, D, H * Dh)
+            for i in (0, 1)
+        )
+        params_mha = jax.tree.map(lambda x: x, params)  # shallow copy
+        params_mha["layers"] = dict(params["layers"])
+        params_mha["layers"]["wqkv"] = jnp.asarray(
+            np.concatenate([qw, kw, vw], axis=-1)
+        )
         tokens = _tokens(jax.random.PRNGKey(1), b=2, t=16)
         np.testing.assert_allclose(
             np.asarray(forward(params, tokens, cfg)),
-            np.asarray(forward(params, tokens, cfg_full)),
+            np.asarray(forward(params_mha, tokens, cfg_mha)),
             atol=1e-4,
         )
 
