@@ -38,7 +38,9 @@ def bwd_looper(attn, q, k, v, n):
 
     def body(_, acc):
         dq, dk, dv = grad(acc, k, v)
-        return (dq + 1e-6 * acc).astype(acc.dtype)
+        # consume dk/dv or XLA dead-code-eliminates the dK/dV pass and
+        # the timed "fwd+bwd" silently drops a third of the backward
+        return (dq + 1e-6 * (acc + jnp.sum(dk) + jnp.sum(dv))).astype(acc.dtype)
 
     return jnp.sum(lax.fori_loop(0, n, body, q).astype(jnp.float32))
 
@@ -71,8 +73,11 @@ def main():
         k = jax.random.normal(kk, shape, jnp.bfloat16)
         v = jax.random.normal(kv, shape, jnp.bfloat16)
 
-        flash = lambda q, k, v: flash_attention(q, k, v)
-        dense = lambda q, k, v: full_attention(q, k, v)
+        # same workload both sides: causal (full_attention defaults to
+        # causal=False — leaving it off would time half the work for
+        # flash and inflate the speedup ~2x)
+        flash = lambda q, k, v: flash_attention(q, k, v, causal=True)
+        dense = lambda q, k, v: full_attention(q, k, v, causal=True)
 
         tf = per_pass(fwd_looper, flash, q, k, v)
         tfg = per_pass(bwd_looper, flash, q, k, v)

@@ -157,11 +157,13 @@ class TestGQA:
             .reshape(L, D, H * Dh)
             for i in (0, 1)
         )
-        params_mha = jax.tree.map(lambda x: x, params)  # shallow copy
-        params_mha["layers"] = dict(params["layers"])
-        params_mha["layers"]["wqkv"] = jnp.asarray(
-            np.concatenate([qw, kw, vw], axis=-1)
-        )
+        params_mha = {
+            **params,
+            "layers": {
+                **params["layers"],
+                "wqkv": jnp.asarray(np.concatenate([qw, kw, vw], axis=-1)),
+            },
+        }
         tokens = _tokens(jax.random.PRNGKey(1), b=2, t=16)
         np.testing.assert_allclose(
             np.asarray(forward(params, tokens, cfg)),
