@@ -11,7 +11,6 @@ Usage: python benchmarks/bench_train.py [--seq=N] [--layers=N] [--attn=flash]
 import sys
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 
 from hpc_patterns_tpu.harness.timing import amortized_seconds
@@ -54,29 +53,30 @@ def main():
                                          optimizer=optimizer)
     tokens = make_batch(jax.random.PRNGKey(1), cfg, batch, seq)
 
-    def one_step(carry, _):
-        params, opt_state = carry
-        loss, grads = jax.value_and_grad(partial(loss_fn, cfg=cfg))(
-            params, tokens
-        )
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return (params, opt_state), loss
-
     # no donation: the timed call runs repeatedly from the same state
     # (donation would invalidate it); inside the scan the carry updates
     # in place anyway, so per-step HBM behavior matches real training
     @partial(jax.jit, static_argnums=(2,))
     def run_t(carry, tokens, n):
+        def one_step(carry, _):
+            params, opt_state = carry
+            loss, grads = jax.value_and_grad(partial(loss_fn, cfg=cfg))(
+                params, tokens
+            )
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), loss
+
         _, losses = lax.scan(one_step, carry, None, length=n)
         return losses[-1]
 
     n_params = sum(x.size for x in jax.tree.leaves(params))
+    iters = arg("iters", 32 if on_tpu else 4, int)
     t_step = amortized_seconds(
         lambda n: run_t((params, opt_state), tokens, n),
-        iters=arg("iters", 32 if on_tpu else 4, int),
+        iters=iters,
         repetitions=3,
-        base_iters=arg("iters", 32 if on_tpu else 4, int) // 2,
+        base_iters=iters // 2,
     )
     tok_per_step = batch * seq
     # decoder FLOPs/token ~ 6*N + 12*L*T*D_head*H (attention)

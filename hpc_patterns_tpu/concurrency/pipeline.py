@@ -50,7 +50,7 @@ from jax.experimental.pallas import tpu as pltpu
 from hpc_patterns_tpu.concurrency.kernels import FMA_UNROLL
 
 MODES = (
-    "overlap", "serial", "dma", "compute",
+    "overlap", "serial", "dma", "compute", "compute2",
     "overlap_out", "serial_out", "dma_out",
     "pair_overlap", "pair_serial",
 )
@@ -72,9 +72,16 @@ def _chain(acc, trips, salt):
 
 
 def _make_in_kernel(mode: str, num_chunks: int):
-    """in-direction modes: overlap | serial | dma | compute."""
+    """in-direction modes: overlap | serial | dma | compute | compute2.
+
+    ``compute2`` is the C+C pair: TWO independent busy-wait chains per
+    chunk (one per scratch slot, distinct salts). They share the one
+    sequential core, so per-pass time ≈ 2x a single chain at the SAME
+    tripcount — which is what the resource-aware verdict floor expects.
+    (Comparing one chain at 2x trips instead is not equivalent: per-trip
+    cost is measurably nonlinear in tripcount on real chips.)"""
     do_dma = mode in ("overlap", "serial", "dma")
-    do_compute = mode in ("overlap", "serial", "compute")
+    do_compute = mode in ("overlap", "serial", "compute", "compute2")
 
     def kernel(scalar_ref, hbm_ref, out_ref):
         trips = scalar_ref[0]
@@ -111,6 +118,10 @@ def _make_in_kernel(mode: str, num_chunks: int):
                         # (overlap == serial) covers every DMA'd block, not
                         # just the last one
                         csum = csum + acc[:8]
+                        if mode == "compute2":
+                            acc2 = _chain(scratch[1 - slot], trips,
+                                          salt + jnp.float32(0.5))
+                            csum = csum + acc2[:8]
                     return csum
 
                 return lax.fori_loop(0, num_chunks, chunk_step, checksum)
@@ -264,7 +275,7 @@ def _make_pair_kernel(mode: str, num_chunks: int):
 
 
 def _make_kernel(mode: str, num_chunks: int):
-    if mode in ("overlap", "serial", "dma", "compute"):
+    if mode in ("overlap", "serial", "dma", "compute", "compute2"):
         return _make_in_kernel(mode, num_chunks)
     if mode in ("overlap_out", "serial_out", "dma_out"):
         return _make_out_kernel(mode, num_chunks)

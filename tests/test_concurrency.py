@@ -246,9 +246,11 @@ class TestOnchipEngine:
         assert code == 0
 
     def test_cc_pair_passes_without_overlap(self, monkeypatch, tmp_path):
+        # two chains serialize on the one core: the two-chain kernel
+        # takes ~2x a single chain, speedup ~1.0 vs the resource floor
         code, _ = self._drive(
             monkeypatch, tmp_path, ["async", "C", "C"],
-            {"compute": 10e-6},
+            {"compute": 10e-6, "compute2": 21e-6},
         )
         assert code == 0
 

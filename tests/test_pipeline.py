@@ -36,7 +36,7 @@ class TestOverlapKernel:
         assert not np.array_equal(np.asarray(a), np.asarray(b))
 
     def test_dma_and_compute_modes_run(self, hbm):
-        for mode in ("dma", "compute"):
+        for mode in ("dma", "compute", "compute2"):
             out = pipeline.overlap_run(hbm, mode=mode, tripcount=2)
             assert np.asarray(out).shape == (8, 128)
 
