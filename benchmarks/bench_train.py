@@ -35,7 +35,7 @@ def arg(name, default, cast):
 def main():
     on_tpu = jax.default_backend() == "tpu"
     cfg = TransformerConfig(
-        vocab=32768 if on_tpu else 256,
+        vocab=arg("vocab", 32768 if on_tpu else 256, int),
         d_model=arg("d", 1024 if on_tpu else 64, int),
         n_heads=arg("heads", 8 if on_tpu else 4, int),
         n_layers=arg("layers", 8 if on_tpu else 2, int),
