@@ -32,7 +32,7 @@ from hpc_patterns_tpu.harness.timing import blocking
 
 from hpc_patterns_tpu.apps import common
 from hpc_patterns_tpu.dtypes import get_traits
-from hpc_patterns_tpu.harness import RunLog, correctness_verdict, measure
+from hpc_patterns_tpu.harness import RunLog, Verdict, correctness_verdict, measure
 from hpc_patterns_tpu.harness.cli import (
     add_memory_kind_args,
     add_msg_size_args,
@@ -108,11 +108,22 @@ def run(args) -> int:
     )
     elapsed = max_across_processes(result.min_s)
 
-    out = np.asarray(step(x))
-    verdict = correctness_verdict(out, comm.expected_allreduce_value(), dtype=traits.dtype)
-    for r in range(world):
-        if verdict.success:
-            log.print(f"Passed {r}")
+    # per-rank validation on addressable shards only: in a multi-process
+    # launch (apps/launch.py) each process asserts its own ranks'
+    # buffers, exactly as each MPI rank validates its own VC
+    # (allreduce-mpi-sycl.cpp:192-206); the verdict is the cross-process
+    # AND of the local ones (vacuously true for a process the even-trim
+    # left without ranks — some other process owns every row)
+    out = step(x)
+    ok_local = True
+    for r, row in common.local_rows(out):
+        v = correctness_verdict(np.asarray(row),
+                                comm.expected_allreduce_value(),
+                                dtype=traits.dtype, rank=r)
+        log.print(f"Passed {r}" if v.success else v.messages[0])
+        ok_local &= v.success
+    ok = common.all_processes_agree(ok_local)
+    verdict = Verdict(success=ok, messages=("SUCCESS" if ok else "FAILURE",))
 
     nbytes = n * traits.itemsize
     busbw = common.allreduce_bus_bandwidth_gbps(nbytes, elapsed, world)

@@ -24,7 +24,12 @@ def make_communicator(
     even-rank-count precondition (allreduce-mpi-sycl.cpp:95-97) by
     dropping the odd device out, rather than failing, because a 1-chip
     dev box is the common case here.
+
+    Joins a launcher rendezvous first when one is in the environment
+    (apps/launch.py ≙ mpirun; init is the MPI_Init analog), so the
+    device list is the GLOBAL multi-process view.
     """
+    topology.init_distributed_from_env()
     devices = topology.get_devices(backend)
     if world == -1:
         world = len(devices)
@@ -48,6 +53,45 @@ def allreduce_bus_bandwidth_gbps(nbytes: int, seconds: float, world: int) -> flo
     if seconds <= 0:
         return float("inf")
     return (nbytes / seconds / 1e9) * (2 * (world - 1) / world)
+
+
+def local_rows(global_array) -> list[tuple[int, "jax.Array"]]:
+    """(rank, row) pairs this process can address, for a (size, ...) array
+    sharded one row per rank. In multi-process runs each process
+    validates only its own ranks' buffers — exactly the reference's
+    per-rank validation (allreduce-mpi-sycl.cpp:192-206); single-process
+    it is every row."""
+    rows = []
+    for shard in global_array.addressable_shards:
+        lead = shard.index[0] if shard.index else slice(0, 1)
+        start = lead.start or 0
+        data = shard.data
+        for i in range(data.shape[0]):
+            rows.append((start + i, data[i]))
+    return sorted(rows, key=lambda rv: rv[0])
+
+
+def reduce_across_processes(value: float, op=None) -> float:
+    """Reduce a host scalar across processes (default max — the
+    reference's MPI_Allreduce(MAX) timing convention). Single-process:
+    identity. The one allgather-and-reduce implementation shared by the
+    app verdicts; harness.timing.max_across_processes is its
+    harness-layer twin."""
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return float(value)
+    from jax.experimental import multihost_utils
+
+    op = np.max if op is None else op
+    return float(op(multihost_utils.process_allgather(np.float64(value))))
+
+
+def all_processes_agree(ok: bool) -> bool:
+    """Cross-process AND of a local verdict (the reference MAX-reduces
+    times and each rank asserts its own buffer; a distributed SUCCESS
+    needs every rank's assert to hold). Single-process: identity."""
+    return reduce_across_processes(0.0 if ok else 1.0) == 0.0
 
 
 def supports_memory_kind(kind: str) -> bool:

@@ -1,0 +1,153 @@
+"""Process launcher: the ``mpirun -np N`` analog (SURVEY.md §4, C11).
+
+The reference registers every miniapp as ``mpirun -np 4 ./app`` under
+CTest (aurora.mpich.miniapps/src/CMakeLists.txt:39-50). Here the same
+role is played by N local processes joined through JAX's distributed
+runtime: each child gets a shared coordinator address plus its process
+id via the ``HPCPAT_*`` env protocol (topology.init_distributed_from_env
+— the MPI_Init analog), and ``--cpu-devices-per-proc`` K virtual CPU
+devices, so an ``-np 2`` launch of the allreduce miniapp is a real
+4-rank SPMD run across two OS processes with zero TPU hardware — the
+multi-host communication path (cross-process collectives, cross-process
+MAX timing) exercised for real, which the reference cannot do without a
+GPU cluster (SURVEY.md §4's gap).
+
+On an actual TPU pod this launcher is not needed: one process per host
+is started by the pod runtime and ``jax.distributed.initialize`` reads
+everything from the environment (topology.init_distributed with no
+args).
+
+Usage:
+    python -m hpc_patterns_tpu.apps.launch -np 2 -- \
+        python -m hpc_patterns_tpu.apps.allreduce_app -p 10
+
+Exit 0 iff every rank exits 0 (the ctest contract); per-rank output is
+echoed with a ``[r]`` prefix and a grep-able summary line closes the
+run (run.sh:17-18 style).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+from hpc_patterns_tpu import topology
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("-np", "--num-processes", type=int, default=2,
+                   help="processes to launch (mpirun -np)")
+    p.add_argument("--cpu-devices-per-proc", type=int, default=2,
+                   help="virtual CPU devices per process "
+                        "(xla_force_host_platform_device_count)")
+    p.add_argument("--port", type=int, default=0,
+                   help="coordinator port (0 = pick a free one)")
+    p.add_argument("--timeout", type=float, default=600.0,
+                   help="per-run timeout in seconds")
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="command to launch, after --")
+    return p
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _child_env(base: dict, coord: str, nprocs: int, pid: int,
+               cpu_devices: int) -> dict:
+    env = dict(base)
+    # children must be CPU SPMD workers, not grab the real chip: drop
+    # the TPU-plugin trigger and force the host platform
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    # override (not inherit) any existing device-count flag — e.g. the
+    # test conftest's 8 — so -np x devices-per-proc is what it says
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={cpu_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env[topology.ENV_COORDINATOR] = coord
+    env[topology.ENV_NUM_PROCESSES] = str(nprocs)
+    env[topology.ENV_PROCESS_ID] = str(pid)
+    # children must resolve `-m hpc_patterns_tpu...` regardless of cwd
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    paths = env.get("PYTHONPATH", "")
+    if pkg_root not in paths.split(os.pathsep):
+        env["PYTHONPATH"] = (
+            f"{pkg_root}{os.pathsep}{paths}" if paths else pkg_root
+        )
+    return env
+
+
+def _pump(prefix: str, stream, sink):
+    for line in iter(stream.readline, ""):
+        sink.write(f"{prefix}{line}")
+        sink.flush()
+    stream.close()
+
+
+def run(args) -> int:
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        print("ERROR: no command given (put it after --)")
+        return 2
+    nprocs = args.num_processes
+    if nprocs < 1:
+        print("ERROR: -np must be >= 1")
+        return 2
+    coord = f"127.0.0.1:{args.port or _free_port()}"
+    procs, pumps = [], []
+    for pid in range(nprocs):
+        proc = subprocess.Popen(
+            cmd,
+            env=_child_env(os.environ, coord, nprocs, pid,
+                           args.cpu_devices_per_proc),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        t = threading.Thread(
+            target=_pump, args=(f"[{pid}] ", proc.stdout, sys.stdout),
+            daemon=True,
+        )
+        t.start()
+        procs.append(proc)
+        pumps.append(t)
+
+    codes = []
+    try:
+        for proc in procs:
+            codes.append(proc.wait(timeout=args.timeout))
+    except subprocess.TimeoutExpired:
+        for proc in procs:
+            proc.kill()
+        print(f"FAILURE: timeout after {args.timeout}s")
+        return 1
+    finally:
+        for t in pumps:
+            t.join(timeout=5)
+
+    ok = all(c == 0 for c in codes)
+    print(f"launch -np {nprocs}: exit codes {codes}")
+    print("SUCCESS" if ok else "FAILURE")
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    return run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -168,6 +168,46 @@ def init_distributed(
         return False
 
 
+# env rendezvous protocol set by apps/launch.py (the local mpirun -np
+# analog); one process per "host", CPU devices standing in for chips
+ENV_COORDINATOR = "HPCPAT_COORDINATOR"
+ENV_NUM_PROCESSES = "HPCPAT_NUM_PROCESSES"
+ENV_PROCESS_ID = "HPCPAT_PROCESS_ID"
+
+
+def init_distributed_from_env(environ=None) -> bool:
+    """Join the rendezvous described by ``HPCPAT_COORDINATOR`` /
+    ``HPCPAT_NUM_PROCESSES`` / ``HPCPAT_PROCESS_ID`` (exported by
+    ``apps/launch.py``, the ``mpirun -np`` analog — the reference's apps
+    learn their rank the same way, from the launcher via MPI_Init).
+
+    No-op (False) when the variables are absent or the runtime is
+    already initialized; True when this call joined the rendezvous.
+    Called by app scaffolding (apps/common.py) so every miniapp is
+    launchable both standalone and under the launcher, like the
+    reference's binaries under ctest/mpirun.
+    """
+    import os
+
+    env = os.environ if environ is None else environ
+    coord = env.get(ENV_COORDINATOR)
+    if not coord:
+        return False
+    try:
+        return init_distributed(
+            coord,
+            int(env[ENV_NUM_PROCESSES]),
+            int(env[ENV_PROCESS_ID]),
+        )
+    except RuntimeError as e:
+        # second app in one process: jax raises "distributed.initialize
+        # should only be called once." (wording varies across versions)
+        msg = str(e).lower()
+        if "already" in msg or "only be called once" in msg:
+            return False
+        raise
+
+
 @dataclasses.dataclass(frozen=True)
 class TopologyInfo:
     """A summary of the visible device topology (for logs and verdicts)."""

@@ -1,0 +1,57 @@
+"""Multi-process launches: the mpirun -np analog end to end.
+
+The reference's distributed tests are `mpirun -np 4 ./app` CTest cases
+(src/CMakeLists.txt:39-50). Here apps/launch.py spawns real OS
+processes joined via jax.distributed over a local coordinator, CPU
+devices standing in for chips — cross-process collectives,
+cross-process MAX timing, and per-rank validation all run for real
+(SURVEY.md §4's hardware-free-testing gap, closed at the process
+level too)."""
+
+import sys
+
+import pytest
+
+from hpc_patterns_tpu.apps import launch
+
+pytestmark = pytest.mark.slow  # each case boots 2 jax processes
+
+
+def _launch(app_args, np_=2, devices=2):
+    return launch.main([
+        "-np", str(np_), "--cpu-devices-per-proc", str(devices), "--",
+        sys.executable, "-m", *app_args,
+    ])
+
+
+class TestLaunch:
+    def test_allreduce_ring_4_ranks_2_processes(self, capsys):
+        code = _launch(["hpc_patterns_tpu.apps.allreduce_app", "-p", "8",
+                        "--repetitions", "2", "--warmup", "1"])
+        out = capsys.readouterr().out
+        assert code == 0, out
+        # every global rank validated, split across the two processes
+        for r in range(4):
+            assert f"Passed {r}" in out
+        assert "world=4" in out
+
+    def test_pingpong_across_processes(self, capsys):
+        code = _launch(["hpc_patterns_tpu.apps.pingpong_app", "-p", "6",
+                        "--min-p", "6", "--repetitions", "2",
+                        "--warmup", "1"])
+        out = capsys.readouterr().out
+        assert code == 0, out
+        assert "ok" in out
+
+    def test_failure_propagates(self, capsys):
+        # a child that exits nonzero must fail the launch (ctest contract)
+        code = launch.main([
+            "-np", "2", "--",
+            sys.executable, "-c", "import sys; sys.exit(3)",
+        ])
+        out = capsys.readouterr().out
+        assert code == 1
+        assert "FAILURE" in out
+
+    def test_no_command_is_an_error(self, capsys):
+        assert launch.main(["-np", "2"]) == 2
