@@ -199,6 +199,10 @@ def _run_pp(args, log, cfg) -> int:
 
 def run(args) -> int:
     log = RunLog(args.log, truncate=not args.log_append)
+    # join a launcher rendezvous when present (apps/launch.py ≙ mpirun):
+    # the mesh below is then global and the train step is true
+    # multi-process SPMD — the multi-host training path, minus hardware
+    topology.init_distributed_from_env()
     if args.prefetch < 0:
         log.print(f"ERROR: --prefetch must be >= 0, got {args.prefetch}")
         log.print("FAILURE")

@@ -43,6 +43,27 @@ class TestLaunch:
         assert code == 0, out
         assert "ok" in out
 
+    def test_train_dp_across_processes(self, capsys):
+        # the flagship train step as true multi-process SPMD: dp=4 over
+        # 2 OS processes, gradient all-reduce crossing the process
+        # boundary
+        code = _launch(["hpc_patterns_tpu.apps.train_app", "--dp", "4",
+                        "--steps", "2", "--batch", "8", "--seq", "32",
+                        "--d-model", "32", "--n-layers", "1",
+                        "--vocab", "128"])
+        out = capsys.readouterr().out
+        assert code == 0, out
+
+    def test_train_pp_stages_in_separate_processes(self, capsys):
+        # 1F1B pipeline with each stage living in a different OS process
+        code = _launch(["hpc_patterns_tpu.apps.train_app", "--pp", "2",
+                        "--steps", "2", "--batch", "4",
+                        "--microbatches", "2", "--seq", "32",
+                        "--d-model", "32", "--n-layers", "2",
+                        "--vocab", "128"], devices=1)
+        out = capsys.readouterr().out
+        assert code == 0, out
+
     def test_failure_propagates(self, capsys):
         # a child that exits nonzero must fail the launch (ctest contract)
         code = launch.main([
