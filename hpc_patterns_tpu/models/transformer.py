@@ -141,6 +141,24 @@ def _rmsnorm(x, scale):
     return (x * lax.rsqrt(var + 1e-6).astype(x.dtype)) * scale.astype(x.dtype)
 
 
+def project_qkv(h, lp, cfg: TransformerConfig):
+    """Fused qkv projection + head split, GQA-narrow K/V (kv_heads, not
+    yet expanded). THE qkv layout definition — shared by the training
+    layer (_layer) and the decode path (models/decode.py) so the two can
+    never disagree on the split or head order. ``h``: (..., d_model);
+    returns q (..., n_heads, Dh), k/v (..., kv_heads, Dh)."""
+    *lead, D = h.shape
+    dt = h.dtype
+    H, Hkv, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    qkv = jnp.dot(h, lp["wqkv"].astype(dt))  # column-parallel
+    q, k, v = jnp.split(qkv, [D, D + Hkv * Dh], axis=-1)
+    return (
+        q.reshape(*lead, H, Dh),
+        k.reshape(*lead, Hkv, Dh),
+        v.reshape(*lead, Hkv, Dh),
+    )
+
+
 def _attention(q, k, v, cfg: TransformerConfig, mesh):
     """Dispatch to the configured attention impl. ring/ulysses wrap the
     rank-local kernels in ``shard_map`` over (dp, sp, tp) — sequence
@@ -252,18 +270,14 @@ def _layer(x, lp, cfg: TransformerConfig, mesh, act_spec):
         return lax.with_sharding_constraint(y, spec) if mesh is not None else y
 
     h = _rmsnorm(x, lp["ln1_scale"])
-    qkv = jnp.dot(h, lp["wqkv"].astype(dt))  # column-parallel
-    Hkv = cfg.kv_heads
-    kv_dim = Hkv * Dh
-    q, k, v = jnp.split(qkv, [D, D + kv_dim], axis=-1)
-    q = q.reshape(B, T, H, Dh)
-    k = k.reshape(B, T, Hkv, Dh)
-    v = v.reshape(B, T, Hkv, Dh)
-    if Hkv != H:
+    q, k, v = project_qkv(h, lp, cfg)
+    if cfg.kv_heads != H:
         # GQA: each KV head serves n_heads/kv_heads query heads; the
-        # expand keeps every attention impl (flash/ring/ulysses) unaware
-        k = jnp.repeat(k, H // Hkv, axis=2)
-        v = jnp.repeat(v, H // Hkv, axis=2)
+        # expand keeps every attention impl (flash/ring/ulysses) unaware.
+        # (The decode path instead groups q and attends against the
+        # unexpanded cache — models/decode.py.)
+        k = jnp.repeat(k, H // cfg.kv_heads, axis=2)
+        v = jnp.repeat(v, H // cfg.kv_heads, axis=2)
     o = _attention(q, k, v, cfg, mesh)
     o = jnp.dot(o.reshape(B, T, D), lp["wo"].astype(dt))  # row-parallel
     x = c(x + o, act_spec)

@@ -11,7 +11,6 @@ import json
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from hpc_patterns_tpu.concurrency import autotune, commands, engine, kernels

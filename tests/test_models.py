@@ -78,7 +78,7 @@ class TestShardedOracle:
 
         want = loss_fn(params, tokens, cfg_local)
 
-        from hpc_patterns_tpu.models.sharding import shard_params, batch_sharding
+        from hpc_patterns_tpu.models.sharding import shard_params
 
         p_sharded = shard_params(params, mesh_dp_sp_tp, cfg_mesh)
         # tokens (b, t): full length feeds forward, divisible by sp=2

@@ -6,7 +6,6 @@ import numpy as np
 import pytest
 
 import jax
-import jax.numpy as jnp
 
 from hpc_patterns_tpu import topology
 from hpc_patterns_tpu.models import TransformerConfig, init_params, loss_fn
