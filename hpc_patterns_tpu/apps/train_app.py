@@ -61,6 +61,10 @@ def build_parser():
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--resume-check", action="store_true",
                    help="save+restore mid-run and verify identical losses")
+    p.add_argument("--generate", type=int, default=0, metavar="N",
+                   help="after training, greedy-decode N tokens from a "
+                        "prompt with the trained params (KV-cache decode, "
+                        "models/decode.py) and validate them")
     return p
 
 
@@ -126,7 +130,36 @@ def _train_loop(args, log, cfg, mesh, params, opt_state, step_fn, *,
         log.print(f"resume-check: saved {ckpt_path}, losses "
                   f"{float(loss_a):.6f} vs {float(loss_b):.6f}")
 
-    ok = finite and learned and resume_ok
+    generate_ok = True
+    if args.generate and name != "train":
+        log.print("note: --generate skipped (pp params are stage-local; "
+                  "decode serves the unpipelined flagship)")
+    elif args.generate:
+        # serving leg: greedy KV-cache decode from the trained params
+        # (single-controller; sharded params are gathered to host first)
+        import jax.numpy as jnp
+
+        from hpc_patterns_tpu.models.decode import greedy_generate
+
+        if jax.process_count() > 1:
+            log.print("note: --generate skipped (multi-process run)")
+        elif args.seq // 2 + args.generate > cfg.max_seq:
+            log.print(f"note: --generate {args.generate} skipped "
+                      f"(prompt {args.seq // 2} + N > max_seq {cfg.max_seq})")
+        else:
+            p_local = jax.device_get(params) if mesh is not None else params
+            prompt = jax.device_get(tokens)[:, : args.seq // 2]
+            toks = greedy_generate(p_local, jnp.asarray(prompt), cfg,
+                                   args.generate)
+            arr = jax.device_get(toks)
+            generate_ok = (
+                arr.shape == (prompt.shape[0], args.generate)
+                and int(arr.min()) >= 0 and int(arr.max()) < cfg.vocab
+            )
+            log.print(f"generate: {arr.shape[0]}x{args.generate} tokens, "
+                      f"sample {arr[0, :8].tolist()}")
+
+    ok = finite and learned and resume_ok and generate_ok
     # steady state excludes the compile step
     steady = t_steps[1:] or t_steps
     step_s = min(steady)

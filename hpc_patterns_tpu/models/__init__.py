@@ -27,4 +27,9 @@ from hpc_patterns_tpu.models.transformer import (  # noqa: F401
 )
 from hpc_patterns_tpu.models.train import make_train_step, make_optimizer  # noqa: F401
 from hpc_patterns_tpu.models.sharding import param_shardings, batch_sharding  # noqa: F401
-from hpc_patterns_tpu.models.decode import greedy_generate, init_cache, prefill  # noqa: F401
+from hpc_patterns_tpu.models.decode import (  # noqa: F401
+    generate,
+    greedy_generate,
+    init_cache,
+    prefill,
+)

@@ -166,34 +166,54 @@ def decode_step(params, cache, pos, tokens, cfg: TransformerConfig):
     return logits.astype(jnp.float32), {"k": ks, "v": vs}
 
 
-@partial(jax.jit, static_argnums=(2, 3))
-def _generate_jit(params, prompt, cfg, new_tokens):
+def _pick(logits, key, temperature, greedy: bool, top_k: int):
+    """Next-token choice. ``greedy`` (static) picks the branch; the
+    temperature itself stays traced so every sampling temperature
+    shares one compilation. ``top_k`` (static, 0 = off) truncates to
+    the k highest logits via the TPU top-k kernel (no full-vocab
+    sort)."""
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if top_k:
+        kth = lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    return jax.random.categorical(key, logits / temperature, axis=-1).astype(
+        jnp.int32
+    )
+
+
+@partial(jax.jit, static_argnums=(2, 3, 6, 7))
+def _generate_jit(params, prompt, cfg, new_tokens, key, temperature,
+                  greedy, top_k):
     B, T = prompt.shape
     max_len = T + new_tokens
     logits, cache = prefill(params, prompt, cfg, max_len)
-    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    key, sub = jax.random.split(key)
+    first = _pick(logits, sub, temperature, greedy, top_k)
 
     if new_tokens == 1:
         return first[:, None]
 
     def step(carry, _):
-        cache, pos, tok = carry
+        cache, pos, tok, key = carry
         logits, cache = decode_step(params, cache, pos, tok, cfg)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return (cache, pos + 1, nxt), tok
+        key, sub = jax.random.split(key)
+        nxt = _pick(logits, sub, temperature, greedy, top_k)
+        return (cache, pos + 1, nxt, key), tok
 
-    (_, _, last), toks = lax.scan(
-        step, (cache, jnp.int32(T), first), None, length=new_tokens - 1
+    (_, _, last, _), toks = lax.scan(
+        step, (cache, jnp.int32(T), first, key), None,
+        length=new_tokens - 1,
     )
     return jnp.concatenate([toks.T, last[:, None]], axis=1)
 
 
-def greedy_generate(params, prompt, cfg: TransformerConfig,
-                    new_tokens: int):
-    """Greedy continuation: (B, new_tokens) int32. One jit for prefill +
-    the whole scan'd decode loop. The oracle equivalence (identical to
-    re-running forward() on the growing sequence each step) is the
-    decode test's invariant."""
+def generate(params, prompt, cfg: TransformerConfig, new_tokens: int, *,
+             key=None, temperature: float = 0.0, top_k: int = 0):
+    """Continuation tokens (B, new_tokens) int32: greedy by default,
+    temperature/top-k sampling when ``temperature > 0`` (``key``
+    required then). One jit for prefill + the whole scan'd decode
+    loop."""
     if new_tokens < 1:
         raise ValueError(f"new_tokens must be >= 1, got {new_tokens}")
     if prompt.shape[1] + new_tokens > cfg.max_seq:
@@ -201,4 +221,20 @@ def greedy_generate(params, prompt, cfg: TransformerConfig,
             f"prompt {prompt.shape[1]} + new {new_tokens} exceeds "
             f"max_seq {cfg.max_seq}"
         )
-    return _generate_jit(params, prompt, cfg, new_tokens)
+    if temperature > 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if not 0 <= top_k <= cfg.vocab:
+        raise ValueError(f"top_k {top_k} outside [0, vocab]")
+    if key is None:
+        key = jax.random.PRNGKey(0)  # unused in greedy mode
+    return _generate_jit(params, prompt, cfg, new_tokens, key,
+                         jnp.float32(max(temperature, 1e-6)),
+                         temperature <= 0.0, int(top_k))
+
+
+def greedy_generate(params, prompt, cfg: TransformerConfig,
+                    new_tokens: int):
+    """Greedy continuation: (B, new_tokens) int32. The oracle
+    equivalence (identical to re-running forward() on the growing
+    sequence each step) is the decode test's invariant."""
+    return generate(params, prompt, cfg, new_tokens)

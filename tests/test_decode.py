@@ -111,3 +111,37 @@ class TestGenerate:
             greedy_generate(params, prompt, cfg, 0)
         with pytest.raises(ValueError, match="max_seq"):
             greedy_generate(params, prompt, cfg, cfg.max_seq)
+
+
+class TestSampling:
+    def test_top_k_1_is_greedy(self):
+        from hpc_patterns_tpu.models.decode import generate
+
+        cfg, params, prompt = _setup()
+        greedy = greedy_generate(params, prompt, cfg, 5)
+        sampled = generate(params, prompt, cfg, 5,
+                           key=jax.random.PRNGKey(3), temperature=1.0,
+                           top_k=1)
+        np.testing.assert_array_equal(np.asarray(greedy),
+                                      np.asarray(sampled))
+
+    def test_sampling_valid_and_key_dependent(self):
+        from hpc_patterns_tpu.models.decode import generate
+
+        cfg, params, prompt = _setup()
+        a = generate(params, prompt, cfg, 8, key=jax.random.PRNGKey(0),
+                     temperature=1.0)
+        b = generate(params, prompt, cfg, 8, key=jax.random.PRNGKey(1),
+                     temperature=1.0)
+        for t in (a, b):
+            arr = np.asarray(t)
+            assert arr.shape == (2, 8)
+            assert arr.min() >= 0 and arr.max() < cfg.vocab
+        assert not np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_sampling_needs_key(self):
+        from hpc_patterns_tpu.models.decode import generate
+
+        cfg, params, prompt = _setup()
+        with pytest.raises(ValueError, match="PRNG key"):
+            generate(params, prompt, cfg, 2, temperature=1.0)
