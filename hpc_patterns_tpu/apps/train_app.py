@@ -42,6 +42,9 @@ def build_parser():
     p.add_argument("--attention", default="full",
                    choices=list(ATTENTION_IMPLS))
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--pos-embed", default="learned",
+                   choices=["learned", "rope"],
+                   help="positional scheme: learned table or rotary (RoPE)")
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1)
     p.add_argument("--tp", type=int, default=1)
@@ -257,7 +260,7 @@ def run(args) -> int:
         vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, d_ff=4 * args.d_model, max_seq=args.seq,
         attention=args.attention, remat=args.remat, n_experts=args.n_experts,
-        n_kv_heads=args.n_kv_heads,
+        n_kv_heads=args.n_kv_heads, pos_embed=args.pos_embed,
     )
     if args.pp > 1:
         return _run_pp(args, log, cfg)

@@ -40,6 +40,7 @@ from jax import lax
 from hpc_patterns_tpu.models.transformer import (
     TransformerConfig,
     _rmsnorm,
+    apply_rope,
     project_qkv,
 )
 from hpc_patterns_tpu.parallel.ring_attention import full_attention
@@ -97,11 +98,20 @@ def prefill(params, prompt, cfg: TransformerConfig, max_len: int):
             f"max_seq {cfg.max_seq}"
         )
     dt = jnp.dtype(cfg.dtype)
-    x = params["embed"].astype(dt)[prompt] + params["pos_embed"].astype(dt)[:T]
+    x = params["embed"].astype(dt)[prompt]
+    if cfg.pos_embed == "learned":
+        x = x + params["pos_embed"].astype(dt)[:T]
 
     def body(h, lp):
         hn = _rmsnorm(h, lp["ln1_scale"])
         q, k, v = project_qkv(hn, lp, cfg)
+        if cfg.pos_embed == "rope":
+            # the cache stores POST-rope K: a key's rotation depends
+            # only on its own (fixed) position, so decode steps never
+            # re-rotate history
+            pos = jnp.arange(T, dtype=jnp.int32)
+            q = apply_rope(q, pos, cfg)
+            k = apply_rope(k, pos, cfg)
         o = full_attention(q, _expand_kv(k, cfg), _expand_kv(v, cfg),
                            causal=True)
         o = jnp.dot(o.reshape(B, T, cfg.d_model), lp["wo"].astype(dt))
@@ -124,10 +134,11 @@ def decode_step(params, cache, pos, tokens, cfg: TransformerConfig):
     dt = jnp.dtype(cfg.dtype)
     B = tokens.shape[0]
     scale = 1.0 / (cfg.head_dim ** 0.5)
-    pos_emb = lax.dynamic_slice_in_dim(
-        params["pos_embed"].astype(dt), pos, 1, axis=0
-    )
-    x = params["embed"].astype(dt)[tokens] + pos_emb  # (B, D)
+    x = params["embed"].astype(dt)[tokens]  # (B, D)
+    if cfg.pos_embed == "learned":
+        x = x + lax.dynamic_slice_in_dim(
+            params["pos_embed"].astype(dt), pos, 1, axis=0
+        )
 
     Hkv, g, Dh = cfg.kv_heads, cfg.n_heads // cfg.kv_heads, cfg.head_dim
 
@@ -135,6 +146,12 @@ def decode_step(params, cache, pos, tokens, cfg: TransformerConfig):
         lp, k_cache, v_cache = layer_in
         hn = _rmsnorm(h, lp["ln1_scale"])
         q, k_new, v_new = project_qkv(hn, lp, cfg)  # (B, H/Hkv, Dh)
+        if cfg.pos_embed == "rope":
+            # rotate at the CURRENT global position (scalar pos
+            # broadcasts over the batch); cached keys are already
+            # post-rope (see prefill)
+            q = apply_rope(q, pos, cfg)
+            k_new = apply_rope(k_new, pos, cfg)
         k_cache = lax.dynamic_update_slice(
             k_cache, k_new[:, None].astype(dt), (0, pos, 0, 0)
         )

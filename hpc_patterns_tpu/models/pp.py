@@ -52,8 +52,10 @@ from hpc_patterns_tpu.parallel.pipeline import pipeline_train_1f1b
 def _embed(outer, tokens, cfg):
     dt = jnp.dtype(cfg.dtype)
     T = tokens.shape[-1]
-    return (outer["embed"].astype(dt)[tokens]
-            + outer["pos_embed"].astype(dt)[:T])
+    x = outer["embed"].astype(dt)[tokens]
+    if cfg.pos_embed == "learned":
+        x = x + outer["pos_embed"].astype(dt)[:T]
+    return x
 
 
 def _stage_fn(layers_shard, h, cfg):
@@ -104,7 +106,9 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
     if B % (M * dp):
         raise ValueError(f"batch {B} must divide by microbatches*dp={M * dp}")
 
-    outer = {"embed": params["embed"], "pos_embed": params["pos_embed"]}
+    outer = {"embed": params["embed"]}
+    if cfg.pos_embed == "learned":
+        outer["pos_embed"] = params["pos_embed"]
     head = {"ln_f_scale": params["ln_f_scale"], "lm_head": params["lm_head"]}
 
     def local(outer, layers_shard, head, tokens_local):
@@ -163,11 +167,12 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
     loss = loss_r[0]
     grads = {
         "embed": outer_g["embed"],
-        "pos_embed": outer_g["pos_embed"],
         "layers": layer_g,
         "ln_f_scale": head_g["ln_f_scale"],
         "lm_head": head_g["lm_head"],
     }
+    if "pos_embed" in outer_g:
+        grads["pos_embed"] = outer_g["pos_embed"]
     return loss, grads
 
 

@@ -36,9 +36,10 @@ def param_specs(cfg: TransformerConfig) -> dict:
     else:
         layers["w1"] = P(None, None, tp)  # column-parallel
         layers["w2"] = P(None, tp, None)  # row-parallel
+    pos = {} if cfg.pos_embed == "rope" else {"pos_embed": P(None, None)}
     return {
         "embed": P(None, None),          # replicated: lookup stays local
-        "pos_embed": P(None, None),
+        **pos,
         "layers": layers,
         "ln_f_scale": P(None),
         "lm_head": P(None, tp),          # vocab-sharded logits

@@ -60,6 +60,12 @@ class TransformerConfig:
     # attention-side activation memory matches MHA; n_heads must divide
     # by n_kv_heads
     n_kv_heads: int = 0
+    # positional scheme: "learned" absolute table, or "rope" rotary
+    # embeddings (relative; the long-context default — composes with
+    # ring/ulysses sequence sharding because rotation angles are a
+    # function of GLOBAL position only, applied before the shard_map)
+    pos_embed: str = "learned"
+    rope_theta: float = 10000.0
     remat: bool = False
     # mixture-of-experts: 0 = dense MLP; >0 = Switch-style top-1 MoE
     # with experts sharded over the ep axis (parallel/moe.py)
@@ -88,6 +94,12 @@ class TransformerConfig:
         return self.d_model // self.n_heads
 
     def __post_init__(self):
+        if self.pos_embed not in ("learned", "rope"):
+            raise ValueError(
+                f"pos_embed {self.pos_embed!r} not in ('learned', 'rope')"
+            )
+        if self.pos_embed == "rope" and self.head_dim % 2:
+            raise ValueError("rope needs an even head_dim")
         if self.attention not in ATTENTION_IMPLS:
             raise ValueError(
                 f"attention {self.attention!r} not in {ATTENTION_IMPLS}"
@@ -119,6 +131,10 @@ def init_params(key, cfg: TransformerConfig):
                       D ** -0.5),
         "wo": initn((L, D, D), (2 * D * L) ** -0.5),
     }
+    pos = (
+        {} if cfg.pos_embed == "rope"
+        else {"pos_embed": initn((cfg.max_seq, D), 0.02)}
+    )
     if cfg.n_experts:
         E = cfg.n_experts
         layers["router"] = initn((L, D, E), D ** -0.5)
@@ -129,7 +145,7 @@ def init_params(key, cfg: TransformerConfig):
         layers["w2"] = initn((L, F, D), (2 * F * L) ** -0.5)
     return {
         "embed": initn((V, D), 0.02),
-        "pos_embed": initn((cfg.max_seq, D), 0.02),
+        **pos,
         "layers": layers,
         "ln_f_scale": jnp.ones((D,), jnp.float32),
         "lm_head": initn((D, V), D ** -0.5),
@@ -139,6 +155,29 @@ def init_params(key, cfg: TransformerConfig):
 def _rmsnorm(x, scale):
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return (x * lax.rsqrt(var + 1e-6).astype(x.dtype)) * scale.astype(x.dtype)
+
+
+def apply_rope(x, positions, cfg: TransformerConfig):
+    """Rotary position embedding: rotate each (even, odd-half) feature
+    pair of ``x`` (..., T, heads, head_dim) by angle pos·theta^(-2i/d).
+    ``positions``: (..., T) int32 GLOBAL positions — scores then depend
+    only on relative distance, which is what lets the same weights serve
+    any context layout (ring/ulysses shards, KV-cache decode steps).
+    Rotation is computed in f32 and cast back (bf16 angle resolution is
+    not enough at long range)."""
+    Dh = x.shape[-1]
+    half = Dh // 2
+    inv_freq = cfg.rope_theta ** (
+        -jnp.arange(0, half, dtype=jnp.float32) / half
+    )
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # (..., T, half)
+    cos = jnp.cos(angles)[..., None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)
 
 
 def project_qkv(h, lp, cfg: TransformerConfig):
@@ -271,6 +310,13 @@ def _layer(x, lp, cfg: TransformerConfig, mesh, act_spec):
 
     h = _rmsnorm(x, lp["ln1_scale"])
     q, k, v = project_qkv(h, lp, cfg)
+    if cfg.pos_embed == "rope":
+        # global positions: _layer always sees the full sequence (the
+        # sp shard_map lives inside _attention), so iota(T) is correct
+        # under every sharding
+        pos = lax.broadcasted_iota(jnp.int32, (T,), 0)
+        q = apply_rope(q, pos, cfg)
+        k = apply_rope(k, pos, cfg)
     if cfg.kv_heads != H:
         # GQA: each KV head serves n_heads/kv_heads query heads; the
         # expand keeps every attention impl (flash/ring/ulysses) unaware.
@@ -309,7 +355,9 @@ def forward(params, tokens, cfg: TransformerConfig, mesh=None, *,
         )
     else:
         act_spec = None
-    x = params["embed"].astype(dt)[tokens] + params["pos_embed"].astype(dt)[:T]
+    x = params["embed"].astype(dt)[tokens]
+    if cfg.pos_embed == "learned":
+        x = x + params["pos_embed"].astype(dt)[:T]
     if mesh is not None:
         x = lax.with_sharding_constraint(x, act_spec)
 

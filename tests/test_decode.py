@@ -84,6 +84,8 @@ class TestGenerate:
         # contention, which a capacity-limited decode would fail
         {"n_experts": 2, "capacity_factor": 2.0},
         {"dtype": "bfloat16"},
+        {"pos_embed": "rope"},              # post-rope keys in the cache
+        {"pos_embed": "rope", "n_kv_heads": 2},
     ])
     @pytest.mark.parametrize("seed", [0, 7])
     def test_matches_oracle(self, over, seed):

@@ -125,6 +125,57 @@ class TestTrainStep:
         assert tokens.sharding.spec == jax.sharding.PartitionSpec("dp", "sp")
 
 
+class TestRoPE:
+    def test_no_pos_table_and_causal(self):
+        cfg = TransformerConfig(**{**TINY, "pos_embed": "rope"})
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        assert "pos_embed" not in params
+        tokens = _tokens(jax.random.PRNGKey(1))
+        a = forward(params, tokens, cfg)
+        tokens_b = tokens.at[:, 10].set((tokens[:, 10] + 1) % cfg.vocab)
+        b = forward(params, tokens_b, cfg)
+        np.testing.assert_allclose(np.asarray(a[:, :10]),
+                                   np.asarray(b[:, :10]), atol=1e-5)
+
+    def test_relative_shift_invariance(self):
+        # rope scores depend on relative distance only: running the same
+        # content through apply_rope at positions p and p+s must give
+        # identical q.k dot products
+        from hpc_patterns_tpu.models.transformer import apply_rope
+
+        cfg = TransformerConfig(**{**TINY, "pos_embed": "rope"})
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 4, 2, 32))
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 4, 2, 32))
+        pos = jnp.arange(4, dtype=jnp.int32)[None]
+
+        def scores(shift):
+            qr = apply_rope(q, pos + shift, cfg)
+            kr = apply_rope(k, pos + shift, cfg)
+            return jnp.einsum("bthd,bshd->bhts", qr, kr)
+
+        np.testing.assert_allclose(np.asarray(scores(0)),
+                                   np.asarray(scores(37)), atol=1e-4)
+
+    @pytest.mark.parametrize("attention", ["ring", "ring_flash", "ulysses"])
+    def test_sharded_rope_matches_local(self, mesh_dp_sp_tp, attention):
+        # the critical offset property: rope applied on GLOBAL positions
+        # must make the sp-sharded model equal the unsharded oracle
+        rope = {**TINY, "pos_embed": "rope"}
+        cfg_local = TransformerConfig(**rope)
+        cfg_mesh = TransformerConfig(**{**rope, "attention": attention})
+        params = init_params(jax.random.PRNGKey(0), cfg_local)
+        tokens = _tokens(jax.random.PRNGKey(1), b=4, t=16)
+        want = loss_fn(params, tokens, cfg_local)
+
+        from hpc_patterns_tpu.models.sharding import shard_params
+
+        p_sharded = shard_params(params, mesh_dp_sp_tp, cfg_mesh)
+        got = jax.jit(
+            lambda p, tk: loss_fn(p, tk, cfg_mesh, mesh_dp_sp_tp)
+        )(p_sharded, tokens)
+        np.testing.assert_allclose(float(got), float(want), rtol=2e-5)
+
+
 class TestGQA:
     def test_kv_heads_equal_heads_is_mha(self):
         base = dict(vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,

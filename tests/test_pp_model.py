@@ -61,6 +61,26 @@ class TestPPModel:
             losses.append(float(loss))
         assert losses[-1] < losses[0], f"no learning: {losses}"
 
+    def test_rope_pp_matches_oracle(self):
+        # rope params have no pos_embed entry; the pp grads dict must
+        # mirror that and still match the end-to-end oracle
+        cfg = TransformerConfig(**{**CFG, "pos_embed": "rope"})
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0, 32,
+                                    "int32")
+        want_loss, want_g = jax.value_and_grad(
+            lambda p: loss_fn(p, tokens, cfg)
+        )(params)
+        mesh = topology.make_mesh({"pp": 2}, jax.devices()[:2])
+        loss, grads = pplib.pp_loss_and_grads(
+            params, tokens, cfg, mesh, microbatches=2
+        )
+        assert "pos_embed" not in grads
+        np.testing.assert_allclose(float(loss), float(want_loss), rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(grads), jax.tree.leaves(want_g)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5)
+
     def test_layers_must_divide(self, setup):
         cfg, params, tokens, _, _ = setup
         mesh = topology.make_mesh({"pp": 4}, jax.devices()[:4])
