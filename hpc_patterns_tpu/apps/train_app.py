@@ -61,6 +61,19 @@ def build_parser():
     p.add_argument("--prefetch", type=int, default=0, metavar="DEPTH",
                    help="stream fresh synthetic batches through the async "
                         "prefetch loader (0 = one static batch)")
+    p.add_argument("--data", default=None, metavar="TOKENS.bin",
+                   help="raw binary token file (uint16/uint32/int32, "
+                        "--data-dtype) streamed via np.memmap instead of "
+                        "synthetic batches; implies --prefetch 2 unless set")
+    p.add_argument("--data-dtype", default="uint16",
+                   choices=["uint16", "uint32", "int32"])
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--schedule", default="constant",
+                   choices=["constant", "cosine"])
+    p.add_argument("--warmup-steps", type=int, default=0)
+    p.add_argument("--accum", type=int, default=1,
+                   help="gradient-accumulation micro-steps per update "
+                        "(batch must divide by it)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--resume-check", action="store_true",
                    help="save+restore mid-run and verify identical losses")
@@ -69,6 +82,23 @@ def build_parser():
                         "prompt with the trained params (KV-cache decode, "
                         "models/decode.py) and validate them")
     return p
+
+
+def _make_cli_optimizer(args, log):
+    """Build the optimizer from --lr/--schedule/--warmup-steps (shared by
+    the sharded and --pp paths). Returns None after logging the app's
+    ERROR/FAILURE protocol on invalid schedule parameters."""
+    from hpc_patterns_tpu.models.train import make_optimizer
+
+    try:
+        return make_optimizer(
+            args.lr, schedule=args.schedule,
+            warmup_steps=args.warmup_steps, total_steps=args.steps,
+        )
+    except ValueError as e:
+        log.print(f"ERROR: {e}")
+        log.print("FAILURE")
+        return None
 
 
 def _train_loop(args, log, cfg, mesh, params, opt_state, step_fn, *,
@@ -80,20 +110,32 @@ def _train_loop(args, log, cfg, mesh, params, opt_state, step_fn, *,
     tokens = make_batch(jax.random.PRNGKey(1), cfg, args.batch, args.seq,
                         mesh)
 
-    if args.prefetch:
+    prefetch = args.prefetch or (2 if args.data else 0)
+    if prefetch:
         from hpc_patterns_tpu.models.sharding import batch_sharding
-        from hpc_patterns_tpu.utils.data import PrefetchLoader, synthetic_tokens
+        from hpc_patterns_tpu.utils.data import (
+            PrefetchLoader,
+            memmap_tokens,
+            synthetic_tokens,
+        )
 
         if mesh is not None:
             sharding = batch_sharding(mesh, cfg)
             place = lambda b: jax.device_put(b, sharding)
         else:
             place = jax.device_put
-        batch_iter = iter(PrefetchLoader(
-            synthetic_tokens(jax.random.PRNGKey(1), batch=args.batch,
-                             seq=args.seq, vocab=cfg.vocab, steps=args.steps),
-            depth=args.prefetch, place=place,
-        ))
+        if args.data:
+            source = memmap_tokens(
+                args.data, batch=args.batch, seq=args.seq,
+                dtype=args.data_dtype, steps=args.steps, vocab=cfg.vocab,
+            )
+        else:
+            source = synthetic_tokens(
+                jax.random.PRNGKey(1), batch=args.batch, seq=args.seq,
+                vocab=cfg.vocab, steps=args.steps,
+            )
+        batch_iter = iter(PrefetchLoader(source, depth=prefetch,
+                                         place=place))
     else:
         batch_iter = None
 
@@ -113,7 +155,7 @@ def _train_loop(args, log, cfg, mesh, params, opt_state, step_fn, *,
     # a 1-step run has nothing to compare, and with --prefetch each step
     # sees a fresh i.i.d. batch (loss noise can exceed a few steps of
     # progress) — finiteness is the check in those modes
-    learned = args.steps < 2 or bool(args.prefetch) or losses[-1] < losses[0]
+    learned = args.steps < 2 or bool(prefetch) or losses[-1] < losses[0]
 
     resume_ok = True
     if args.resume_check:
@@ -221,10 +263,14 @@ def _run_pp(args, log, cfg) -> int:
     axes = ({"dp": args.dp, "pp": args.pp} if args.dp > 1
             else {"pp": args.pp})
     mesh = topology.make_mesh(axes, devices[:args.dp * args.pp])
-    params, opt_state = pplib.init_pp_train_state(jax.random.PRNGKey(0), cfg)
+    optimizer = _make_cli_optimizer(args, log)
+    if optimizer is None:
+        return 1
+    params, opt_state = pplib.init_pp_train_state(jax.random.PRNGKey(0), cfg,
+                                                  optimizer=optimizer)
     step_fn = pplib.make_pp_train_step(
         cfg, mesh, microbatches=args.microbatches,
-        axis_dp="dp" if args.dp > 1 else None,
+        axis_dp="dp" if args.dp > 1 else None, optimizer=optimizer,
     )
     return _train_loop(
         args, log, cfg, mesh, params, opt_state, step_fn, name="train_pp",
@@ -245,6 +291,16 @@ def run(args) -> int:
         return 1
     if args.steps < 1:
         log.print(f"ERROR: --steps must be >= 1, got {args.steps}")
+        log.print("FAILURE")
+        return 1
+    if args.accum > 1 and args.pp > 1:
+        log.print("ERROR: --accum composes with the sharded-train path; "
+                  "--pp already micro-batches via --microbatches")
+        log.print("FAILURE")
+        return 1
+    if args.accum > 1 and args.batch % args.accum:
+        log.print(f"ERROR: --batch {args.batch} must divide by "
+                  f"--accum {args.accum}")
         log.print("FAILURE")
         return 1
     if args.ep > 1 and not args.n_experts:
@@ -280,8 +336,13 @@ def run(args) -> int:
             axes["ep"] = args.ep
         mesh = topology.make_mesh(axes, devices[:n_mesh])
 
-    params, opt_state = init_train_state(jax.random.PRNGKey(0), cfg, mesh)
-    step_fn = make_train_step(cfg, mesh)
+    optimizer = _make_cli_optimizer(args, log)
+    if optimizer is None:
+        return 1
+    params, opt_state = init_train_state(jax.random.PRNGKey(0), cfg, mesh,
+                                         optimizer=optimizer)
+    step_fn = make_train_step(cfg, mesh, optimizer=optimizer,
+                              accum_steps=args.accum)
     return _train_loop(
         args, log, cfg, mesh, params, opt_state, step_fn, name="train",
         result_extra={},

@@ -22,22 +22,53 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import optax
+from jax import lax
 
 from hpc_patterns_tpu.models import sharding as shardlib
 from hpc_patterns_tpu.models.transformer import TransformerConfig, init_params, loss_fn
 
 
 def make_optimizer(learning_rate: float = 3e-4, weight_decay: float = 0.01,
-                   grad_clip: float = 1.0):
+                   grad_clip: float = 1.0, *, warmup_steps: int = 0,
+                   total_steps: int = 0, schedule: str = "constant"):
+    """adamw + global-norm clip, with the standard LR schedules:
+    ``constant`` (default), or ``cosine`` — linear warmup over
+    ``warmup_steps`` then cosine decay to 10% of peak at
+    ``total_steps`` (required for cosine)."""
+    if schedule == "constant":
+        lr = (
+            optax.linear_schedule(0.0, learning_rate, warmup_steps)
+            if warmup_steps else learning_rate
+        )
+    elif schedule == "cosine":
+        if total_steps <= warmup_steps:
+            raise ValueError(
+                f"cosine needs total_steps > warmup_steps, got "
+                f"{total_steps} <= {warmup_steps}"
+            )
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=learning_rate,
+            warmup_steps=warmup_steps, decay_steps=total_steps,
+            end_value=0.1 * learning_rate,
+        )
+    else:
+        raise ValueError(f"schedule {schedule!r} not in (constant, cosine)")
     return optax.chain(
         optax.clip_by_global_norm(grad_clip),
-        optax.adamw(learning_rate, weight_decay=weight_decay),
+        optax.adamw(lr, weight_decay=weight_decay),
     )
 
 
-def make_train_step(cfg: TransformerConfig, mesh=None, optimizer=None):
+def make_train_step(cfg: TransformerConfig, mesh=None, optimizer=None,
+                    accum_steps: int = 1):
     """Returns jitted ``step(params, opt_state, tokens) -> (loss, params,
     opt_state)`` with param/opt-state donation (in-place HBM update).
+
+    ``accum_steps > 1`` splits the batch into that many micro-batches
+    and accumulates gradients over a ``lax.scan`` before the single
+    optimizer update — same numbers as the big batch (mean of
+    micro-means over equal splits), at 1/accum_steps the activation
+    memory: the train-side memory lever alongside remat.
 
     Pass ``params``/``opt_state`` created by :func:`init_train_state`
     (sharded when ``mesh`` is given); the same code path is the
@@ -45,11 +76,38 @@ def make_train_step(cfg: TransformerConfig, mesh=None, optimizer=None):
     distributed result must match the local one).
     """
     optimizer = optimizer or make_optimizer()
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    grad_fn = jax.value_and_grad(partial(loss_fn, cfg=cfg, mesh=mesh))
 
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(partial(loss_fn, cfg=cfg, mesh=mesh))(
-            params, tokens
-        )
+        if accum_steps == 1:
+            loss, grads = grad_fn(params, tokens)
+        else:
+            B = tokens.shape[0]
+            if B % accum_steps:
+                raise ValueError(
+                    f"batch {B} must divide by accum_steps {accum_steps}"
+                )
+            micro = tokens.reshape(accum_steps, B // accum_steps, -1)
+
+            def accum(carry, mb):
+                loss_sum, g_sum = carry
+                loss, g = grad_fn(params, mb)
+                return (
+                    loss_sum + loss,
+                    jax.tree.map(jnp.add, g_sum, g),
+                ), None
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            )
+            (loss, grads), _ = lax.scan(
+                accum, (jnp.zeros((), jnp.float32), zeros), micro
+            )
+            scale = 1.0 / accum_steps
+            loss = loss * scale
+            grads = jax.tree.map(lambda g: g * scale, grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return loss, params, opt_state

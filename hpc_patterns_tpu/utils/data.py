@@ -102,3 +102,62 @@ def synthetic_tokens(key, *, batch: int, seq: int, vocab: int, steps: int):
     rng = np.random.default_rng(int(jax.random.randint(key, (), 0, 2**31 - 1)))
     for _ in range(steps):
         yield rng.integers(0, vocab, size=(batch, seq), dtype="int32")
+
+
+TOKEN_FILE_DTYPES = ("uint16", "uint32", "int32")
+
+
+def write_token_file(path, tokens, dtype: str = "uint16") -> None:
+    """Write a flat token stream as a raw binary file (the standard
+    pre-tokenized corpus format: one dtype, no header). uint16 covers
+    vocabs to 65535 at half the footprint of int32."""
+    import numpy as np
+
+    if dtype not in TOKEN_FILE_DTYPES:
+        raise ValueError(f"dtype {dtype!r} not in {TOKEN_FILE_DTYPES}")
+    arr = np.asarray(tokens).reshape(-1)
+    info = np.iinfo(dtype)
+    if arr.min() < info.min or arr.max() > info.max:
+        raise ValueError(f"token values outside {dtype} range")
+    arr.astype(dtype).tofile(path)
+
+
+def memmap_tokens(path, *, batch: int, seq: int, dtype: str = "uint16",
+                  steps: int | None = None, seed: int = 0,
+                  sequential: bool = False, vocab: int | None = None):
+    """Batches of (batch, seq) int32 windows from a raw binary token
+    file, via ``np.memmap`` — the file is paged in on demand, never
+    loaded whole (the host-RAM analog of the flash kernels'
+    HBM-bounded streaming). Random windows by default (i.i.d. training
+    batches); ``sequential`` walks the file in order (eval).
+    ``steps=None`` iterates forever. ``vocab`` validates every yielded
+    id against the model's range (an out-of-range id would otherwise be
+    silently clamped by XLA's gather and train on garbage). Feed through
+    :class:`PrefetchLoader` to hide the page-in + H2D copy behind the
+    step."""
+    import numpy as np
+
+    if dtype not in TOKEN_FILE_DTYPES:
+        raise ValueError(f"dtype {dtype!r} not in {TOKEN_FILE_DTYPES}")
+    data = np.memmap(path, dtype=dtype, mode="r")
+    n = data.shape[0]
+    if n < seq:
+        raise ValueError(f"token file has {n} tokens < seq = {seq}")
+    n_starts = n - seq + 1  # start n-seq (the last full window) included
+    rng = np.random.default_rng(seed)
+    pos = 0
+    i = 0
+    while steps is None or i < steps:
+        if sequential:
+            starts = (pos + np.arange(batch) * seq) % n_starts
+            pos = (pos + batch * seq) % n_starts
+        else:
+            starts = rng.integers(0, n_starts, size=batch)
+        out = np.stack([data[s:s + seq] for s in starts])
+        if vocab is not None and out.max() >= vocab:
+            raise ValueError(
+                f"token id {int(out.max())} >= vocab {vocab} in {path} "
+                "(wrong --vocab or wrong --data-dtype?)"
+            )
+        yield out.astype("int32")
+        i += 1
