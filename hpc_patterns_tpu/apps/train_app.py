@@ -174,6 +174,14 @@ def _train_loop(args, log, cfg, mesh, params, opt_state, step_fn, *,
         resume_ok = float(loss_a) == float(loss_b) and r_step == args.steps
         log.print(f"resume-check: saved {ckpt_path}, losses "
                   f"{float(loss_a):.6f} vs {float(loss_b):.6f}")
+    elif args.checkpoint_dir:
+        # --checkpoint-dir alone means "save the trained state" (the
+        # README's train -> eval lifecycle), not only the resume test
+        from hpc_patterns_tpu.utils.checkpoint import save_checkpoint
+
+        ckpt_path = save_checkpoint(args.checkpoint_dir, params, opt_state,
+                                    step=args.steps)
+        log.print(f"saved {ckpt_path}")
 
     generate_ok = True
     if args.generate and name != "train":

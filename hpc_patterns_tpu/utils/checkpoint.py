@@ -42,6 +42,29 @@ def latest_step(path) -> int | None:
     return steps[-1] if steps else None
 
 
+def restore_params(path, step: int | None = None):
+    """Restore only the params subtree (plus the step), using the
+    checkpoint's own metadata for structure — no optimizer template
+    needed. The saved opt_state's pytree structure depends on the
+    training schedule (constant vs warmup/cosine produce different
+    optax states), which an evaluator shouldn't have to reconstruct."""
+    path = Path(path).resolve()
+    if step is None:
+        step = latest_step(path)
+        if step is None:
+            raise FileNotFoundError(f"no step_* checkpoints under {path}")
+    ckpt = _checkpointer()
+    tree = ckpt.metadata(path / f"step_{step}").item_metadata.tree
+    abstract = jax.tree.map(
+        lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype)
+        if getattr(m, "shape", None) is not None
+        else m,
+        tree,
+    )
+    state = ckpt.restore(path / f"step_{step}", abstract)
+    return state["params"], int(state["step"])
+
+
 def restore_checkpoint(path, params_like, opt_state_like, step: int | None = None):
     """Restore (params, opt_state, step). ``*_like`` provide structure,
     dtypes AND shardings — pass the live (or abstract) state created the

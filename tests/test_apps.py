@@ -73,3 +73,87 @@ def test_make_communicator_world_guards():
 
     with pytest.raises(TopologyError):
         common.make_communicator("cpu", 99)
+
+
+class TestEvalApp:
+    def test_synthetic_eval_bounds(self, capsys):
+        from hpc_patterns_tpu.apps import eval_app
+
+        code = eval_app.main(
+            ["--batches", "2", "--batch", "2", "--seq", "16",
+             "--d-model", "32", "--n-layers", "1", "--vocab", "64"]
+        )
+        out = capsys.readouterr().out
+        assert code == 0
+        assert "perplexity" in out and "SUCCESS" in out
+
+    def test_token_file_eval(self, capsys, tmp_path):
+        import numpy as np
+
+        from hpc_patterns_tpu.apps import eval_app
+        from hpc_patterns_tpu.utils.data import write_token_file
+
+        path = tmp_path / "toks.bin"
+        write_token_file(path, np.arange(2000) % 64, "uint16")
+        code = eval_app.main(
+            ["--data", str(path), "--batches", "2", "--batch", "2",
+             "--seq", "16", "--d-model", "32", "--n-layers", "1",
+             "--vocab", "64"]
+        )
+        out = capsys.readouterr().out
+        assert code == 0, out
+        assert "SUCCESS" in out
+
+    def test_train_then_eval_roundtrip(self, capsys, tmp_path):
+        # the README lifecycle: train --checkpoint-dir (no resume-check)
+        # with a cosine schedule, then eval restores WITHOUT an
+        # optimizer template (scheduled opt states have a different
+        # pytree structure than the default constant-LR one)
+        from hpc_patterns_tpu.apps import eval_app, train_app
+
+        ck = tmp_path / "ck"
+        shape = ["--batch", "2", "--seq", "16", "--d-model", "32",
+                 "--n-layers", "1", "--vocab", "64"]
+        code = train_app.main(
+            ["--steps", "3", "--schedule", "cosine", "--warmup-steps", "1",
+             "--checkpoint-dir", str(ck), *shape]
+        )
+        assert code == 0, capsys.readouterr().out
+        code = eval_app.main(
+            ["--checkpoint-dir", str(ck), "--batches", "2", *shape]
+        )
+        out = capsys.readouterr().out
+        assert code == 0, out
+        assert "restored step 3" in out and "SUCCESS" in out
+
+    def test_eval_checkpoint_config_mismatch_fails_cleanly(self, capsys,
+                                                           tmp_path):
+        from hpc_patterns_tpu.apps import eval_app, train_app
+
+        ck = tmp_path / "ck"
+        code = train_app.main(
+            ["--steps", "1", "--checkpoint-dir", str(ck), "--batch", "2",
+             "--seq", "16", "--d-model", "32", "--n-layers", "1",
+             "--vocab", "64"]
+        )
+        assert code == 0
+        code = eval_app.main(
+            ["--checkpoint-dir", str(ck), "--batches", "1", "--batch", "2",
+             "--seq", "16", "--d-model", "64", "--n-layers", "1",
+             "--vocab", "64"]
+        )
+        out = capsys.readouterr().out
+        assert code == 1
+        assert "ERROR" in out and "FAILURE" in out
+
+    def test_eval_missing_checkpoint_fails_cleanly(self, capsys, tmp_path):
+        from hpc_patterns_tpu.apps import eval_app
+
+        code = eval_app.main(
+            ["--checkpoint-dir", str(tmp_path / "nope"), "--batches", "1",
+             "--batch", "2", "--seq", "16", "--d-model", "32",
+             "--n-layers", "1", "--vocab", "64"]
+        )
+        out = capsys.readouterr().out
+        assert code == 1
+        assert "ERROR" in out and "FAILURE" in out
