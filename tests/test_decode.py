@@ -115,6 +115,22 @@ class TestGenerate:
             greedy_generate(params, prompt, cfg, cfg.max_seq)
 
 
+class TestShardedServing:
+    def test_tp_sharded_params_decode_exactly(self, mesh_dp_sp_tp):
+        # serving-side tensor parallelism is pure GSPMD: Megatron-sharded
+        # params flow through the decode einsums with XLA inserting the
+        # tp collectives; tokens must be bit-identical to local decode
+        from hpc_patterns_tpu.models.sharding import shard_params
+
+        cfg, params, prompt = _setup()
+        want = np.asarray(greedy_generate(params, prompt, cfg, 6))
+        p_sh = shard_params(params, mesh_dp_sp_tp, cfg)
+        got = np.asarray(jax.device_get(
+            greedy_generate(p_sh, prompt, cfg, 6)
+        ))
+        np.testing.assert_array_equal(got, want)
+
+
 class TestSampling:
     def test_top_k_1_is_greedy(self):
         from hpc_patterns_tpu.models.decode import generate
