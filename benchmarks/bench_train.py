@@ -1,9 +1,11 @@
 """End-to-end training throughput (tokens/s) on the real chip.
 
 One jitted function runs N optimizer steps via lax.scan (params/opt
-state as carry — in-place in HBM, no host round-trips), timed with the
-tunnel-proof amortized protocol (harness.timing.amortized_seconds), so
-the number is pure device time per step.
+state as carry — in-place in HBM), timed with the tunnel-proof
+amortized protocol (harness.timing.amortized_seconds), so the number is
+pure device time per step. With ``--offload=1`` the optimizer moments
+live in pinned host RAM and the measured step time INCLUDES their
+per-step PCIe round-trip (that is the cost being measured).
 
 Usage: python benchmarks/bench_train.py [--seq=N] [--layers=N] [--attn=flash]
 """
@@ -49,22 +51,44 @@ def main():
     seq = cfg.max_seq
     optimizer = make_optimizer()
 
+    offload = bool(arg("offload", 0, int))
+    if offload and not on_tpu:
+        print("note: --offload=1 needs a TPU backend; running baseline")
+        offload = False
     params, opt_state = init_train_state(jax.random.PRNGKey(0), cfg,
                                          optimizer=optimizer)
+    if offload:
+        from hpc_patterns_tpu.models.train import offload_opt_state
+
+        opt_state = offload_opt_state(opt_state)
     tokens = make_batch(jax.random.PRNGKey(1), cfg, batch, seq)
+
+    if offload:
+        from hpc_patterns_tpu.models.train import offload_shardings
+
+        host_sh, hbm_sh = offload_shardings(opt_state)
+    else:
+        host_sh = hbm_sh = None
 
     # no donation: the timed call runs repeatedly from the same state
     # (donation would invalidate it); inside the scan the carry updates
     # in place anyway, so per-step HBM behavior matches real training
-    @partial(jax.jit, static_argnums=(2,))
+    @partial(
+        jax.jit, static_argnums=(2,),
+        in_shardings=((None, host_sh), None) if offload else None,
+    )
     def run_t(carry, tokens, n):
         def one_step(carry, _):
             params, opt_state = carry
+            if hbm_sh is not None:
+                opt_state = jax.device_put(opt_state, hbm_sh)
             loss, grads = jax.value_and_grad(partial(loss_fn, cfg=cfg))(
                 params, tokens
             )
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
+            if host_sh is not None:
+                opt_state = jax.device_put(opt_state, host_sh)
             return (params, opt_state), loss
 
         _, losses = lax.scan(one_step, carry, None, length=n)
@@ -83,7 +107,7 @@ def main():
     flops_tok = 6 * n_params + 12 * cfg.n_layers * seq * cfg.d_model * 0.5
     print(f"config: d={cfg.d_model} L={cfg.n_layers} H={cfg.n_heads} "
           f"ff={cfg.d_ff} T={seq} B={batch} attn={cfg.attention} "
-          f"remat={cfg.remat} params={n_params/1e6:.1f}M")
+          f"remat={cfg.remat} offload={offload} params={n_params/1e6:.1f}M")
     print(f"step: {t_step*1e3:.2f} ms  throughput: "
           f"{tok_per_step/t_step:,.0f} tok/s  "
           f"model flops util: {flops_tok*tok_per_step/t_step/1e12:.1f} TF/s")

@@ -59,8 +59,33 @@ def make_optimizer(learning_rate: float = 3e-4, weight_decay: float = 0.01,
     )
 
 
+def memory_kind_shardings(tree, kind: str):
+    """Shardings of ``tree``'s (concrete) leaves retargeted to a JAX
+    memory kind — the L2 allocator axis (SURVEY.md §2, ``-H/-D/-S``)
+    applied to training state."""
+    return jax.tree.map(lambda x: x.sharding.with_memory_kind(kind), tree)
+
+
+def offload_opt_state(opt_state, kind: str = "pinned_host"):
+    """Move the optimizer state to host memory. Adam moments are 2x the
+    (f32) parameter footprint and are touched once per step — parking
+    them in host RAM frees that HBM for batch/model/sequence headroom,
+    at the cost of streaming them over PCIe each step. Pair with
+    ``make_train_step(..., offload_opt_example=...)``."""
+    return jax.device_put(opt_state, memory_kind_shardings(opt_state, kind))
+
+
+def offload_shardings(opt_state_host):
+    """(host_shardings, hbm_shardings) for a host-resident opt state —
+    THE pull/push targets of the offloaded update, shared by
+    make_train_step and the training benchmark so the streaming
+    strategy cannot drift between what ships and what is measured."""
+    host_sh = jax.tree.map(lambda x: x.sharding, opt_state_host)
+    return host_sh, memory_kind_shardings(opt_state_host, "device")
+
+
 def make_train_step(cfg: TransformerConfig, mesh=None, optimizer=None,
-                    accum_steps: int = 1):
+                    accum_steps: int = 1, offload_opt_example=None):
     """Returns jitted ``step(params, opt_state, tokens) -> (loss, params,
     opt_state)`` with param/opt-state donation (in-place HBM update).
 
@@ -69,6 +94,11 @@ def make_train_step(cfg: TransformerConfig, mesh=None, optimizer=None,
     optimizer update — same numbers as the big batch (mean of
     micro-means over equal splits), at 1/accum_steps the activation
     memory: the train-side memory lever alongside remat.
+
+    ``offload_opt_example``: a host-resident optimizer state (from
+    :func:`offload_opt_state`) whose shardings tell the step where the
+    state lives — the update then pulls it to HBM, applies, and pushes
+    it back, all inside the one jit (XLA schedules the transfers).
 
     Pass ``params``/``opt_state`` created by :func:`init_train_state`
     (sharded when ``mesh`` is given); the same code path is the
@@ -79,8 +109,14 @@ def make_train_step(cfg: TransformerConfig, mesh=None, optimizer=None,
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     grad_fn = jax.value_and_grad(partial(loss_fn, cfg=cfg, mesh=mesh))
+    if offload_opt_example is not None:
+        host_sh, hbm_sh = offload_shardings(offload_opt_example)
+    else:
+        host_sh = hbm_sh = None
 
     def step(params, opt_state, tokens):
+        if hbm_sh is not None:
+            opt_state = jax.device_put(opt_state, hbm_sh)
         if accum_steps == 1:
             loss, grads = grad_fn(params, tokens)
         else:
@@ -110,8 +146,18 @@ def make_train_step(cfg: TransformerConfig, mesh=None, optimizer=None,
             grads = jax.tree.map(lambda g: g * scale, grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
+        if host_sh is not None:
+            opt_state = jax.device_put(opt_state, host_sh)
         return loss, params, opt_state
 
+    if host_sh is not None:
+        # declare the host residency of the opt-state input/output so
+        # donation pairs host buffers with host buffers
+        return jax.jit(
+            step, donate_argnums=(0, 1),
+            in_shardings=(None, host_sh, None),
+            out_shardings=(None, None, host_sh),
+        )
     return jax.jit(step, donate_argnums=(0, 1))
 
 

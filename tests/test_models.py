@@ -118,6 +118,26 @@ class TestTrainStep:
         lm = np.asarray(jax.device_get(p_m["layers"]["wqkv"]))
         np.testing.assert_allclose(lm, la, atol=1e-5)
 
+    def test_offload_opt_state_residency(self):
+        # placement is backend-agnostic (the compute annotation is
+        # TPU-only — full-step equivalence is covered by on-chip runs):
+        # every opt leaf must land in pinned_host and keep its structure
+        from hpc_patterns_tpu.models.train import (
+            memory_kind_shardings,
+            offload_opt_state,
+        )
+
+        cfg = TransformerConfig(**TINY)
+        _, opt = init_train_state(jax.random.PRNGKey(0), cfg)
+        hosted = offload_opt_state(opt)
+        kinds = {x.sharding.memory_kind for x in jax.tree.leaves(hosted)}
+        assert kinds == {"pinned_host"}
+        assert jax.tree.structure(hosted) == jax.tree.structure(opt)
+        back = memory_kind_shardings(hosted, "device")
+        assert all(
+            s.memory_kind == "device" for s in jax.tree.leaves(back)
+        )
+
     def test_batch_helper_sharded(self, mesh_dp_sp_tp):
         cfg = TransformerConfig(**TINY)
         tokens = make_batch(jax.random.PRNGKey(2), cfg, 4, 16, mesh_dp_sp_tp)
