@@ -66,12 +66,15 @@ def main():
             ITERS = int(a.split("=")[1])
     Ts = [int(a) for a in sys.argv[1:] if not a.startswith("-")] or [4096, 8192]
     B, H, D = 1, 8, 128
+    Hkv = H
+    for a in sys.argv[1:]:
+        if a.startswith("--kv="):
+            Hkv = int(a.split("=")[1])
     for T in Ts:
         kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
-        shape = (B, T, H, D)
-        q = jax.random.normal(kq, shape, jnp.bfloat16)
-        k = jax.random.normal(kk, shape, jnp.bfloat16)
-        v = jax.random.normal(kv, shape, jnp.bfloat16)
+        q = jax.random.normal(kq, (B, T, H, D), jnp.bfloat16)
+        k = jax.random.normal(kk, (B, T, Hkv, D), jnp.bfloat16)
+        v = jax.random.normal(kv, (B, T, Hkv, D), jnp.bfloat16)
 
         # same workload both sides: causal (full_attention defaults to
         # causal=False — leaving it off would time half the work for
@@ -86,7 +89,7 @@ def main():
         print(f"T={T:6d} flash fwd {tf*1e3:8.3f} ms "
               f"({flops_fwd/tf/1e12:5.1f} TF/s)  fwd+bwd {tfg*1e3:8.3f} ms "
               f"({3.5*flops_fwd/tfg/1e12:5.1f} TF/s)")
-        if T <= 8192 and "--flash-only" not in sys.argv:
+        if T <= 8192 and "--flash-only" not in sys.argv and Hkv == H:
             td = per_pass(fwd_looper, dense, q, k, v)
             tdg = per_pass(bwd_looper, dense, q, k, v)
             print(f"         dense fwd {td*1e3:8.3f} ms "

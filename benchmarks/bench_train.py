@@ -46,6 +46,7 @@ def main():
         dtype="bfloat16",
         attention=arg("attn", "flash" if on_tpu else "full", str),
         remat=bool(arg("remat", 0, int)),
+        n_kv_heads=arg("kv", 0, int),
     )
     batch = arg("batch", 8 if on_tpu else 2, int)
     seq = cfg.max_seq

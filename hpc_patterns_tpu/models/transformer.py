@@ -318,12 +318,20 @@ def _layer(x, lp, cfg: TransformerConfig, mesh, act_spec):
         q = apply_rope(q, pos, cfg)
         k = apply_rope(k, pos, cfg)
     if cfg.kv_heads != H:
-        # GQA: each KV head serves n_heads/kv_heads query heads; the
-        # expand keeps every attention impl (flash/ring/ulysses) unaware.
-        # (The decode path instead groups q and attends against the
-        # unexpanded cache — models/decode.py.)
-        k = jnp.repeat(k, H // cfg.kv_heads, axis=2)
-        v = jnp.repeat(v, H // cfg.kv_heads, axis=2)
+        # GQA: the flash kernel streams the NARROW K/V through its index
+        # maps (no expanded copy in HBM — the group-factor bandwidth
+        # saving); ring/ulysses still get the jnp.repeat expand (their
+        # shard_maps assume equal head counts), as does flash when tp
+        # doesn't divide the kv heads (shards must keep whole groups).
+        # The decode path does its own grouped-cache attention
+        # (models/decode.py).
+        narrow = cfg.attention == "flash" and (
+            mesh is None
+            or cfg.kv_heads % max(mesh_axis_size(mesh, cfg.axis_tp), 1) == 0
+        )
+        if not narrow:
+            k = jnp.repeat(k, H // cfg.kv_heads, axis=2)
+            v = jnp.repeat(v, H // cfg.kv_heads, axis=2)
     o = _attention(q, k, v, cfg, mesh)
     o = jnp.dot(o.reshape(B, T, D), lp["wo"].astype(dt))  # row-parallel
     x = c(x + o, act_spec)

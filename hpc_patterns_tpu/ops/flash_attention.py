@@ -69,32 +69,54 @@ def _causal_mask(s, q_start, k_start):
     return jnp.where(k_pos <= q_pos, s, _NEG_INF)
 
 
-def _kv_index_map(block_q, block_k, causal):
+def _kv_row(H, Hkv):
+    """bh (0..B·H) → row of the kv-heads-narrow (B·Hkv, T, D) array: the
+    GQA group map, head h reads kv head h // (H/Hkv). Identity-shaped
+    when Hkv == H (the div/mod folds away)."""
+    if Hkv == H:
+        return lambda bh: bh
+    group = H // Hkv
+    return lambda bh: (bh // H) * Hkv + (bh % H) // group
+
+
+def _kv_index_map(block_q, block_k, causal, H, Hkv):
     """kv-block index map for grid (bh, qi, ki): causal clamps ki to the
     last block visible from this query block, so every fully-future grid
-    step revisits the previous block and Pallas skips its HBM fetch."""
+    step revisits the previous block and Pallas skips its HBM fetch.
+    The row map sends each q head to its (possibly shared) kv head — GQA
+    streams the NARROW cache, no expanded copy in HBM."""
+    row = _kv_row(H, Hkv)
     if not causal:
-        return lambda bh, qi, ki, offs: (bh, ki, 0)
+        return lambda bh, qi, ki, offs: (row(bh), ki, 0)
 
     def idx(bh, qi, ki, offs):
         q_end_g = offs[0] + (qi + 1) * block_q - 1
         last = jnp.maximum((q_end_g - offs[1]) // block_k, 0)
-        return bh, jnp.minimum(ki, last), 0
+        return row(bh), jnp.minimum(ki, last), 0
 
     return idx
 
 
-def _q_index_map(block_q, block_k, causal, n_q):
-    """q-block index map for grid (bh, ki, qi): causal clamps qi UP to
-    the first block that can see this K block (earlier steps revisit it,
-    skipping the fetch)."""
-    if not causal:
-        return lambda bh, ki, qi, offs: (bh, qi, 0)
+def _q_index_map(block_q, block_k, causal, n_q, H, Hkv):
+    """q-side index map for the dK/dV grid (bkv, ki, j) where
+    j = g_idx·n_q + qi enumerates every (query head of the group, query
+    block) pair: row = the g_idx-th q head served by kv row bkv; causal
+    clamps qi UP to the first block that can see this K block (earlier
+    steps revisit it, skipping the fetch)."""
+    group = H // Hkv
 
-    def idx(bh, ki, qi, offs):
+    def row(bkv, j):
+        if group == 1:
+            return bkv
+        return (bkv // Hkv) * H + (bkv % Hkv) * group + j // n_q
+
+    if not causal:
+        return lambda bkv, ki, j, offs: (row(bkv, j), j % n_q, 0)
+
+    def idx(bkv, ki, j, offs):
         k_start_g = offs[1] + ki * block_k
         first = jnp.clip((k_start_g - offs[0]) // block_q, 0, n_q - 1)
-        return bh, jnp.maximum(qi, first), 0
+        return row(bkv, j), jnp.maximum(j % n_q, first), 0
 
     return idx
 
@@ -211,18 +233,21 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _dkv_kernel(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
                 dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *, scale: float,
-                causal: bool):
-    # grid (B·H, n_kv, n_q), dK/dV carried in scratch across the q axis.
+                causal: bool, n_q: int):
+    # grid (B·Hkv, n_kv, group·n_q): axis 2 walks every (q head of this
+    # kv head's group, q block) pair — j = g_idx·n_q + qi — with dK/dV
+    # carried in scratch across the WHOLE axis, so GQA's cross-head
+    # gradient sum happens in the same accumulator as the q-block walk.
     # dV = Pᵀ·dO; dK = scale · dSᵀ·Q. Causal: query blocks strictly
     # before this K block see none of it — skipped via pl.when.
     block_k, d = k_ref.shape
     block_q = q_ref.shape[0]
-    qi = pl.program_id(2)
-    n_q = pl.num_programs(2)
+    j = pl.program_id(2)
+    qi = lax.rem(j, n_q)
     q_start_g = offs_ref[0] + qi * block_q
     k_start_g = offs_ref[1] + pl.program_id(1) * block_k
 
-    @pl.when(qi == 0)
+    @pl.when(j == 0)
     def _():
         dk_acc_ref[:] = jnp.zeros((block_k, d), jnp.float32)
         dv_acc_ref[:] = jnp.zeros((block_k, d), jnp.float32)
@@ -253,7 +278,7 @@ def _dkv_kernel(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
             ds.astype(q.dtype).T, q, preferred_element_type=jnp.float32
         )
 
-    @pl.when(qi == n_q - 1)
+    @pl.when(j == pl.num_programs(2) - 1)
     def _():
         dk_ref[:] = (dk_acc_ref[:] * scale).astype(dk_ref.dtype)
         dv_ref[:] = dv_acc_ref[:].astype(dv_ref.dtype)
@@ -293,6 +318,18 @@ def _resolve(Tq, Tk, D, scale, block_q, block_k, interpret, *,
 def _to_kernel_layout(x):
     B, T, H, D = x.shape
     return jnp.einsum("bthd->bhtd", x).reshape(B * H, T, D)
+
+
+def _expand_rows(xr, B, Hkv, group):
+    """Expand kernel-layout (B·Hkv, T, D) rows to (B·H, T, D) by group
+    repetition — ONLY for the dense interpret-mode mirrors; the kernels
+    themselves read the narrow array through their index maps."""
+    if group == 1:
+        return xr
+    _, T, D = xr.shape
+    return jnp.repeat(
+        xr.reshape(B, Hkv, T, D), group, axis=1
+    ).reshape(B * Hkv * group, T, D)
 
 
 def _align_vma(*arrays):
@@ -367,6 +404,13 @@ def _forward_impl(q, k, v, offs, *, causal, scale, block_q, block_k,
         raise ValueError(f"want (batch, seq, heads, head_dim), got {q.shape}")
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
+    Hkv = k.shape[2]
+    if H % max(Hkv, 1) or v.shape[2] != Hkv:
+        raise ValueError(
+            f"kv heads {Hkv}/{v.shape[2]} must match and divide "
+            f"n_heads {H} (GQA streams the narrow K/V)"
+        )
+    group = H // Hkv
     scale, block_q, block_k, interpret = _resolve(
         Tq, Tk, D, scale, block_q, block_k, interpret
     )
@@ -379,7 +423,7 @@ def _forward_impl(q, k, v, offs, *, causal, scale, block_q, block_k,
     # index maps see the prefetched offsets: for causal, clamp the kv
     # block index to the last visible block — consecutive clamped steps
     # revisit the same block, so Pallas elides the HBM fetch entirely
-    kv_idx = _kv_index_map(block_q, block_k, causal)
+    kv_idx = _kv_index_map(block_q, block_k, causal, H, Hkv)
     blk_q = pl.BlockSpec((None, block_q, D),
                          lambda bh, qi, ki, offs: (bh, qi, 0),
                          memory_space=pltpu.VMEM)
@@ -387,7 +431,9 @@ def _forward_impl(q, k, v, offs, *, causal, scale, block_q, block_k,
                          memory_space=pltpu.VMEM)
     (offs, qr, kr, vr), vma = _align_vma(offs, qr, kr, vr)
     if interpret and vma:
-        outr, lse = _dense_forward(qr, kr, vr, offs, causal=causal,
+        kr_e = _expand_rows(kr, B, Hkv, group)
+        vr_e = _expand_rows(vr, B, Hkv, group)
+        outr, lse = _dense_forward(qr, kr_e, vr_e, offs, causal=causal,
                                    scale=scale, need_lse=need_lse,
                                    out_dtype=q.dtype)
         out = outr.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
@@ -429,9 +475,13 @@ def _forward_impl(q, k, v, offs, *, causal, scale, block_q, block_k,
 def _backward_impl(qr, kr, vr, outr, lse, offs, g, g_lse, *, causal, scale,
                    block_q, block_k, interpret):
     """Shared backward. ``g``: (B, Tq, H, D) out-cotangent; ``g_lse``:
-    (B, Tq, H) lse-cotangent or None. Returns (dq, dk, dv) user-layout."""
+    (B, Tq, H) lse-cotangent or None. Returns (dq, dk, dv) user-layout
+    (dk/dv with the narrow kv head count — the group sum happens in the
+    dkv kernel's accumulator)."""
     B, Tq, H, D = g.shape
     Tk = kr.shape[1]
+    Hkv = kr.shape[0] // B
+    group = H // Hkv
     scale, block_q, block_k, interpret = _resolve(
         Tq, Tk, D, scale, block_q, block_k, interpret, validate=False
     )
@@ -450,22 +500,29 @@ def _backward_impl(qr, kr, vr, outr, lse, offs, g, g_lse, *, causal, scale,
         offs, qr, kr, vr, dor, lse, delta
     )
     if interpret and vma:
-        dq, dk, dv = _dense_backward(qr, kr, vr, dor, lse, delta, offs,
+        kr_e = _expand_rows(kr, B, Hkv, group)
+        vr_e = _expand_rows(vr, B, Hkv, group)
+        dq, dk, dv = _dense_backward(qr, kr_e, vr_e, dor, lse, delta, offs,
                                      causal=causal, scale=scale)
-        back = lambda x, t: x.reshape(B, H, t, D).transpose(0, 2, 1, 3)
-        return back(dq, Tq), back(dk, Tk), back(dv, Tk)
+        if group > 1:  # fold the per-q-head contributions into kv heads
+            dk = dk.reshape(B, Hkv, group, Tk, D).sum(2).reshape(-1, Tk, D)
+            dv = dv.reshape(B, Hkv, group, Tk, D).sum(2).reshape(-1, Tk, D)
+        back = lambda x, h, t: x.reshape(B, h, t, D).transpose(0, 2, 1, 3)
+        return back(dq, H, Tq), back(dk, Hkv, Tk), back(dv, Hkv, Tk)
+    n_q = Tq // block_q
     row = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
-    kv_idx = _kv_index_map(block_q, block_k, causal)
-    q_idx = _q_index_map(block_q, block_k, causal, Tq // block_q)
+    kv_idx = _kv_index_map(block_q, block_k, causal, H, Hkv)
+    q_idx = _q_index_map(block_q, block_k, causal, n_q, H, Hkv)
     # grid (B·H, n_q, n_kv): q-indexed blocks follow axis 1, kv axis 2
     q_on1 = row((None, block_q, D), lambda bh, qi, ki, offs: (bh, qi, 0))
     k_on2 = row((None, block_k, D), kv_idx)
     vec_on1 = row((None, block_q, 1), lambda bh, qi, ki, offs: (bh, qi, 0))
-    # grid (B·H, n_kv, n_q): kv-indexed blocks follow axis 1, q axis 2
-    k_on1 = row((None, block_k, D), lambda bh, ki, qi, offs: (bh, ki, 0))
+    # grid (B·Hkv, n_kv, group·n_q): kv-indexed blocks follow axis 1,
+    # the (q head of the group, q block) walk axis 2
+    k_on1 = row((None, block_k, D), lambda bkv, ki, j, offs: (bkv, ki, 0))
     q_on2 = row((None, block_q, D), q_idx)
     vec_on2 = row((None, block_q, 1),
-                  lambda bh, ki, qi, offs: q_idx(bh, ki, qi, offs))
+                  lambda bkv, ki, j, offs: q_idx(bkv, ki, j, offs))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal),
@@ -481,10 +538,10 @@ def _backward_impl(qr, kr, vr, outr, lse, offs, g, g_lse, *, causal, scale,
     )(offs, qr, kr, vr, dor, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal),
+        functools.partial(_dkv_kernel, scale=scale, causal=causal, n_q=n_q),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(B * H, Tk // block_k, Tq // block_q),
+            grid=(B * Hkv, Tk // block_k, group * n_q),
             in_specs=[q_on2, q_on2, vec_on2, vec_on2, k_on1, k_on1],
             out_specs=(k_on1, k_on1),
             scratch_shapes=[
@@ -493,14 +550,14 @@ def _backward_impl(qr, kr, vr, outr, lse, offs, g, g_lse, *, causal, scale,
             ],
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((B * H, Tk, D), kr.dtype, vma=vma),
-            jax.ShapeDtypeStruct((B * H, Tk, D), vr.dtype, vma=vma),
+            jax.ShapeDtypeStruct((B * Hkv, Tk, D), kr.dtype, vma=vma),
+            jax.ShapeDtypeStruct((B * Hkv, Tk, D), vr.dtype, vma=vma),
         ),
         interpret=interpret,
     )(offs, qr, dor, lse, delta, kr, vr)
 
-    back = lambda x, t: x.reshape(B, H, t, D).transpose(0, 2, 1, 3)
-    return back(dq, Tq), back(dk, Tk), back(dv, Tk)
+    back = lambda x, h, t: x.reshape(B, h, t, D).transpose(0, 2, 1, 3)
+    return back(dq, H, Tq), back(dk, Hkv, Tk), back(dv, Hkv, Tk)
 
 
 def _zero_offs():

@@ -105,6 +105,41 @@ class TestFlashAttention:
         assert np.all(np.asarray(lse_b) < -1e29)
 
     @pytest.mark.parametrize("causal", [True, False])
+    def test_gqa_narrow_kv_matches_expanded(self, causal):
+        # K/V with fewer heads stream through the kernel index maps;
+        # result and grads must equal the expanded-K/V oracle, with
+        # dk/dv returned narrow (the group sum in the kernel
+        # accumulator)
+        H, Hkv = 4, 2
+        q, _, _ = _qkv(jax.random.PRNGKey(7), B=1, T=64, H=H, D=16)
+        _, k, v = _qkv(jax.random.PRNGKey(8), B=1, T=64, H=Hkv, D=16)
+        expand = lambda x: jnp.repeat(x, H // Hkv, axis=2)
+        got = flash_attention(q, k, v, causal=causal, block_q=32,
+                              block_k=32)
+        want = full_attention(q, expand(k), expand(v), causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5)
+        g1 = jax.grad(
+            lambda q, k, v: flash_attention(q, k, v, causal=causal,
+                                            block_q=32, block_k=32).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        g2 = jax.grad(
+            lambda q, k, v: full_attention(q, expand(k), expand(v),
+                                           causal=causal).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        assert g1[1].shape == (1, 64, Hkv, 16)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5)
+
+    def test_mismatched_kv_heads_rejected(self):
+        q, k, v = _qkv(jax.random.PRNGKey(9), B=1, T=32, H=4, D=16)
+        with pytest.raises(ValueError, match="kv heads"):
+            flash_attention(q, k[:, :, :3], v[:, :, :3])
+
+    @pytest.mark.parametrize("causal", [True, False])
     def test_grad_matches_oracle(self, causal):
         q, k, v = _qkv(jax.random.PRNGKey(3), B=1, T=64, H=2, D=16)
 
