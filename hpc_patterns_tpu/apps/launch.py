@@ -62,19 +62,7 @@ def _free_port() -> int:
 
 def _child_env(base: dict, coord: str, nprocs: int, pid: int,
                cpu_devices: int) -> dict:
-    env = dict(base)
-    # children must be CPU SPMD workers, not grab the real chip: drop
-    # the TPU-plugin trigger and force the host platform
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    # override (not inherit) any existing device-count flag — e.g. the
-    # test conftest's 8 — so -np x devices-per-proc is what it says
-    flags = [
-        f for f in env.get("XLA_FLAGS", "").split()
-        if "xla_force_host_platform_device_count" not in f
-    ]
-    flags.append(f"--xla_force_host_platform_device_count={cpu_devices}")
-    env["XLA_FLAGS"] = " ".join(flags)
+    env = topology.cpu_worker_env(base, cpu_devices)
     env[topology.ENV_COORDINATOR] = coord
     env[topology.ENV_NUM_PROCESSES] = str(nprocs)
     env[topology.ENV_PROCESS_ID] = str(pid)

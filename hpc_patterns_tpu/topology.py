@@ -168,6 +168,29 @@ def init_distributed(
         return False
 
 
+def cpu_worker_env(base: Mapping[str, str], n_devices: int) -> dict:
+    """Environment for a child process that must run as a CPU SPMD worker
+    with ``n_devices`` virtual devices instead of attaching real TPU
+    hardware. The single source of truth for the CPU-forcing recipe,
+    shared by apps/launch.py (the mpirun -np analog) and the
+    self-bootstrapping multi-chip dry run (__graft_entry__).
+    """
+    env = dict(base)
+    # drop the TPU-plugin trigger so the child cannot grab the real chip
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    # override (not inherit) any existing device-count flag — e.g. the
+    # test conftest's 8 — so n_devices is what it says
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
 # env rendezvous protocol set by apps/launch.py (the local mpirun -np
 # analog); one process per "host", CPU devices standing in for chips
 ENV_COORDINATOR = "HPCPAT_COORDINATOR"
