@@ -77,11 +77,7 @@ def _child_env(base: dict, coord: str, nprocs: int, pid: int,
     return env
 
 
-def _pump(prefix: str, stream, sink):
-    for line in iter(stream.readline, ""):
-        sink.write(f"{prefix}{line}")
-        sink.flush()
-    stream.close()
+_pump = topology.pump_lines
 
 
 def run(args) -> int:

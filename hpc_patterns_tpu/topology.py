@@ -191,6 +191,17 @@ def cpu_worker_env(base: Mapping[str, str], n_devices: int) -> dict:
     return env
 
 
+def pump_lines(prefix: str, stream, sink) -> None:
+    """Echo ``stream`` to ``sink`` line by line (with ``prefix``) until
+    EOF, flushing each line — the output pump for child SPMD workers,
+    shared by apps/launch.py and the self-bootstrapping dry run so
+    progress is visible while a child compiles."""
+    for line in iter(stream.readline, ""):
+        sink.write(f"{prefix}{line}")
+        sink.flush()
+    stream.close()
+
+
 # env rendezvous protocol set by apps/launch.py (the local mpirun -np
 # analog); one process per "host", CPU devices standing in for chips
 ENV_COORDINATOR = "HPCPAT_COORDINATOR"

@@ -53,15 +53,25 @@ def restore_params(path, step: int | None = None):
         step = latest_step(path)
         if step is None:
             raise FileNotFoundError(f"no step_* checkpoints under {path}")
-    ckpt = _checkpointer()
-    tree = ckpt.metadata(path / f"step_{step}").item_metadata.tree
+    tree = _checkpointer().metadata(path / f"step_{step}").item_metadata.tree
+    # request only the params and step subtrees (partial restore): the
+    # opt_state (~2x param bytes of Adam moments) is never read off disk
+    wanted = {"params": tree["params"], "step": tree["step"]}
     abstract = jax.tree.map(
         lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype)
         if getattr(m, "shape", None) is not None
         else m,
-        tree,
+        wanted,
     )
-    state = ckpt.restore(path / f"step_{step}", abstract)
+    with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckpt:
+        state = ckpt.restore(
+            path / f"step_{step}",
+            args=ocp.args.PyTreeRestore(
+                item=abstract,
+                restore_args=ocp.checkpoint_utils.construct_restore_args(abstract),
+                partial_restore=True,
+            ),
+        )
     return state["params"], int(state["step"])
 
 

@@ -116,6 +116,8 @@ def write_token_file(path, tokens, dtype: str = "uint16") -> None:
     if dtype not in TOKEN_FILE_DTYPES:
         raise ValueError(f"dtype {dtype!r} not in {TOKEN_FILE_DTYPES}")
     arr = np.asarray(tokens).reshape(-1)
+    if arr.size == 0:
+        raise ValueError("empty token stream (nothing to write)")
     info = np.iinfo(dtype)
     if arr.min() < info.min or arr.max() > info.max:
         raise ValueError(f"token values outside {dtype} range")
@@ -149,8 +151,13 @@ def memmap_tokens(path, *, batch: int, seq: int, dtype: str = "uint16",
     i = 0
     while steps is None or i < steps:
         if sequential:
-            starts = (pos + np.arange(batch) * seq) % n_starts
-            pos = (pos + batch * seq) % n_starts
+            # wrap at a whole-window stride, not n_starts: wrapping mid-
+            # window would misalign every later window and double-count
+            # tokens near the file start during long evals (the tail
+            # remainder < seq tokens is dropped instead)
+            wrap = (n // seq) * seq
+            starts = (pos + np.arange(batch) * seq) % wrap
+            pos = (pos + batch * seq) % wrap
         else:
             starts = rng.integers(0, n_starts, size=batch)
         out = np.stack([data[s:s + seq] for s in starts])

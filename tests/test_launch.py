@@ -64,6 +64,17 @@ class TestLaunch:
         out = capsys.readouterr().out
         assert code == 0, out
 
+    def test_train_sp_ring_attention_across_processes(self, capsys):
+        # ring attention with the sp axis spanning both OS processes:
+        # the per-step K/V ppermute crosses the process boundary
+        code = _launch(["hpc_patterns_tpu.apps.train_app", "--sp", "4",
+                        "--attention", "ring_flash", "--steps", "2",
+                        "--batch", "2", "--seq", "32",
+                        "--d-model", "32", "--n-layers", "1",
+                        "--vocab", "128"])
+        out = capsys.readouterr().out
+        assert code == 0, out
+
     def test_failure_propagates(self, capsys):
         # a child that exits nonzero must fail the launch (ctest contract)
         code = launch.main([
