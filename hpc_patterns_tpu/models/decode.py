@@ -76,14 +76,6 @@ def _mlp(x, lp, cfg: TransformerConfig):
     return x + jnp.dot(h, lp["w2"].astype(dt))
 
 
-def _expand_kv(k, cfg: TransformerConfig):
-    """GQA: expand kv heads to serve their query-head groups (the same
-    jnp.repeat layout as transformer._layer)."""
-    if cfg.kv_heads == cfg.n_heads:
-        return k
-    return jnp.repeat(k, cfg.n_heads // cfg.kv_heads, axis=-2)
-
-
 def prefill(params, prompt, cfg: TransformerConfig, max_len: int):
     """Run the prompt in one batched pass (MXU-shaped, exactly
     transformer.forward's math) while capturing each layer's K/V into a
@@ -112,8 +104,9 @@ def prefill(params, prompt, cfg: TransformerConfig, max_len: int):
             pos = jnp.arange(T, dtype=jnp.int32)
             q = apply_rope(q, pos, cfg)
             k = apply_rope(k, pos, cfg)
-        o = full_attention(q, _expand_kv(k, cfg), _expand_kv(v, cfg),
-                           causal=True)
+        # full_attention consumes the narrow GQA K/V directly (grouped-
+        # query scores; no expanded HBM copy)
+        o = full_attention(q, k, v, causal=True)
         o = jnp.dot(o.reshape(B, T, cfg.d_model), lp["wo"].astype(dt))
         h = _mlp(h + o.astype(dt), lp, cfg)
         # pad the captured K/V out to the static cache length

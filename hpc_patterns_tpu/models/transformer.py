@@ -55,10 +55,10 @@ class TransformerConfig:
     attention: str = "full"  # full | flash | ring[_flash] | ulysses[_flash]
     # grouped-query attention: 0 = MHA (kv heads == n_heads); smaller
     # values share each KV head across n_heads/n_kv_heads query heads,
-    # shrinking the qkv projection (weights + FLOPs) and any KV cache.
-    # NOTE: attention itself currently expands K/V back to n_heads, so
-    # attention-side activation memory matches MHA; n_heads must divide
-    # by n_kv_heads
+    # shrinking the qkv projection (weights + FLOPs), the KV cache, AND
+    # attention-side K/V activations — every impl consumes the narrow
+    # K/V (grouped-query scores, no expansion; the ring circulates
+    # group-factor less K/V). n_heads must divide by n_kv_heads
     n_kv_heads: int = 0
     # positional scheme: "learned" absolute table, or "rope" rotary
     # embeddings (relative; the long-context default — composes with
@@ -318,17 +318,16 @@ def _layer(x, lp, cfg: TransformerConfig, mesh, act_spec):
         q = apply_rope(q, pos, cfg)
         k = apply_rope(k, pos, cfg)
     if cfg.kv_heads != H:
-        # GQA: the flash kernel streams the NARROW K/V through its index
-        # maps (no expanded copy in HBM — the group-factor bandwidth
-        # saving); ring/ulysses still get the jnp.repeat expand (their
-        # shard_maps assume equal head counts), as does flash when tp
-        # doesn't divide the kv heads (shards must keep whole groups).
-        # The decode path does its own grouped-cache attention
-        # (models/decode.py).
-        narrow = cfg.attention == "flash" and (
-            mesh is None
-            or cfg.kv_heads % max(mesh_axis_size(mesh, cfg.axis_tp), 1) == 0
-        )
+        # GQA: every attention impl consumes the NARROW K/V (no expanded
+        # copy in HBM — the group-factor memory/bandwidth saving; the
+        # ring additionally circulates group-factor less K/V per step).
+        # The only layout constraint here: with heads tensor-sharded, tp
+        # must divide kv_heads so shards keep whole kv heads — else fall
+        # back to jnp.repeat expansion. (ulysses has its own internal
+        # per-rank fallback when its axis can't scatter the kv heads;
+        # decode does its own grouped-cache attention, models/decode.py.)
+        tp = max(mesh_axis_size(mesh, cfg.axis_tp), 1) if mesh is not None else 1
+        narrow = cfg.kv_heads % tp == 0
         if not narrow:
             k = jnp.repeat(k, H // cfg.kv_heads, axis=2)
             v = jnp.repeat(v, H // cfg.kv_heads, axis=2)

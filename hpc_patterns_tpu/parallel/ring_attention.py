@@ -31,15 +31,48 @@ from hpc_patterns_tpu.comm import ring
 _NEG_INF = -1e30  # finite mask value: avoids inf-inf=nan in the rescale
 
 
+def _check_gqa(q, k, v) -> int:
+    """Validate head counts; return the GQA group factor H // Hkv (1 =
+    MHA). q head h attends kv head h // group — the same map as the
+    flash kernel's GQA row maps (ops/flash_attention.py)."""
+    H, Hkv = q.shape[2], k.shape[2]
+    if H % max(Hkv, 1) or v.shape[2] != Hkv:
+        raise ValueError(
+            f"kv heads {Hkv}/{v.shape[2]} must match and divide "
+            f"n_heads {H} (GQA attends the narrow K/V)"
+        )
+    return H // Hkv
+
+
+def _grouped_scores(q, k, scale):
+    """(B, H, T, S) f32 scores against possibly-narrow K: q head h
+    scores kv head h // group, with no expanded K copy."""
+    B, T, H, D = q.shape
+    Hkv = k.shape[2]
+    qg = q.reshape(B, T, Hkv, H // Hkv, D)
+    s = jnp.einsum(
+        "btkgd,bskd->bkgts", qg.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    return s.reshape(B, H, T, k.shape[1])
+
+
+def _grouped_pv(p, v):
+    """(B, H, T, D) f32 = P @ V with possibly-narrow V (no expansion)."""
+    B, H, T, S = p.shape
+    Hkv = v.shape[2]
+    pg = p.reshape(B, Hkv, H // Hkv, T, S)
+    out = jnp.einsum("bkgts,bskd->bkgtd", pg, v.astype(jnp.float32))
+    return out.reshape(B, H, T, v.shape[3])
+
+
 def _block_step(q, k, v, acc, m, l, *, scale, q_offset, k_offset, causal):
     """Fold one visiting K/V block into the running accumulator.
 
-    q: (B, T, H, D); k/v: (B, S, H, D); acc: (B, H, T, D) f32;
+    q: (B, T, H, D); k/v: (B, S, Hkv, D) with Hkv | H (GQA — the narrow
+    block is what travels the ring); acc: (B, H, T, D) f32;
     m, l: (B, H, T) f32 running max / normalizer.
     """
-    s = jnp.einsum(
-        "bthd,bshd->bhts", q.astype(jnp.float32), k.astype(jnp.float32)
-    ) * scale
+    s = _grouped_scores(q, k, scale)
     if causal:
         t_idx = q_offset + lax.broadcasted_iota(jnp.int32, s.shape, 2)
         s_idx = k_offset + lax.broadcasted_iota(jnp.int32, s.shape, 3)
@@ -49,9 +82,7 @@ def _block_step(q, k, v, acc, m, l, *, scale, q_offset, k_offset, causal):
     p = jnp.exp(s - m_new[..., None])
     rescale = jnp.exp(m - m_new)
     l_new = l * rescale + p.sum(axis=-1)
-    acc_new = acc * rescale[..., None] + jnp.einsum(
-        "bhts,bshd->bhtd", p, v.astype(jnp.float32)
-    )
+    acc_new = acc * rescale[..., None] + _grouped_pv(p, v)
     return acc_new, m_new, l_new
 
 
@@ -71,9 +102,12 @@ def ring_attention(
     inside ``shard_map``).
 
     ``q``, ``k``, ``v``: (batch, seq_local, heads, head_dim) — the local
-    sequence block; global sequence = blocks in rank order. Returns the
-    local block of the softmax attention output, same shape/dtype as
-    ``q``, numerically equal to attending the gathered sequence.
+    sequence block; global sequence = blocks in rank order. K/V may be
+    GQA-narrow (kv_heads dividing q's heads): the narrow block is what
+    circulates, cutting per-step ring traffic by the group factor.
+    Returns the local block of the softmax attention output, same
+    shape/dtype as ``q``, numerically equal to attending the gathered
+    sequence.
 
     ``impl``: per-step local compute. ``"dense"`` materializes the
     (T_local, S) score block (any shape); ``"flash"`` runs the Pallas
@@ -86,6 +120,7 @@ def ring_attention(
         raise ValueError(f"want (batch, seq, heads, head_dim), got {q.shape}")
     if impl not in ("dense", "flash"):
         raise ValueError(f"impl {impl!r} not in ('dense', 'flash')")
+    _check_gqa(q, k, v)
     size = ring.axis_size(axis)
     me = ring.axis_index(axis)
     B, T, H, D = q.shape
@@ -156,17 +191,18 @@ def _ring_attention_flash(q, k, v, axis, *, size, me, q_offset, causal,
 
 def full_attention(q, k, v, *, causal: bool = False, scale: float | None = None):
     """Single-device oracle: plain softmax attention over the full
-    sequence, used by tests to validate the ring result (§4.2 style)."""
+    sequence, used by tests to validate the ring result (§4.2 style).
+    K/V may be GQA-narrow (kv_heads dividing q's heads) — grouped-query
+    scores, never an expanded K/V copy."""
+    _check_gqa(q, k, v)
     B, T, H, D = q.shape
     if scale is None:
         scale = 1.0 / (D ** 0.5)
-    s = jnp.einsum(
-        "bthd,bshd->bhts", q.astype(jnp.float32), k.astype(jnp.float32)
-    ) * scale
+    s = _grouped_scores(q, k, scale)
     if causal:
         t_idx = lax.broadcasted_iota(jnp.int32, s.shape, 2)
         s_idx = lax.broadcasted_iota(jnp.int32, s.shape, 3)
         s = jnp.where(s_idx <= t_idx, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhts,bshd->bthd", p, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    out = _grouped_pv(p, v)
+    return jnp.einsum("bhtd->bthd", out).astype(q.dtype)

@@ -34,8 +34,13 @@ def ulysses_attention(
     (rank-local; run inside ``shard_map``).
 
     ``q``/``k``/``v``: (batch, seq_local, heads, head_dim) with ``heads``
-    divisible by the axis size. Returns the local sequence block of the
-    full attention output, same shape as ``q``.
+    divisible by the axis size. K/V may be GQA-narrow (kv_heads dividing
+    q's heads): when kv_heads also divides the axis size, the NARROW K/V
+    ride the all-to-alls (group-factor less exchange traffic) and the
+    local attention runs grouped-query; otherwise K/V are expanded to
+    full heads first (head scattering needs per-rank whole heads).
+    Returns the local sequence block of the full attention output, same
+    shape as ``q``.
 
     ``impl``: the rank-local full-sequence attention — ``"dense"``
     (oracle math, any shape) or ``"flash"`` (ops.flash_attention: after
@@ -48,9 +53,19 @@ def ulysses_attention(
     if impl not in ("dense", "flash"):
         raise ValueError(f"impl {impl!r} not in ('dense', 'flash')")
     size = ring.axis_size(axis)
-    H = q.shape[2]
+    H, Hkv = q.shape[2], k.shape[2]
     if H % size:
         raise ValueError(f"heads {H} not divisible by axis size {size}")
+    if H % max(Hkv, 1) or v.shape[2] != Hkv:
+        raise ValueError(
+            f"kv heads {Hkv}/{v.shape[2]} must match and divide heads {H}"
+        )
+    if Hkv != H and Hkv % size:
+        # can't scatter partial kv heads: fall back to expanded K/V
+        import jax.numpy as jnp
+
+        k = jnp.repeat(k, H // Hkv, axis=2)
+        v = jnp.repeat(v, H // Hkv, axis=2)
 
     # (B, T/P, H, D) -> (B, T, H/P, D): gather sequence, scatter heads
     def seq_to_heads(x):

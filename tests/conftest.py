@@ -27,9 +27,35 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# persistent XLA compile cache: shard_map compiles dominate suite wall
+# time; warm reruns skip them entirely (first/cold run is unchanged)
+_cache_dir = Path(__file__).resolve().parent.parent / ".cache" / "jax"
+jax.config.update("jax_compilation_cache_dir", str(_cache_dir))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import pytest  # noqa: E402
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--slow", action="store_true", default=False,
+        help="include the slow tier (multi-process launches, big-model "
+             "pipeline/MoE oracles); default tier targets < 5 min",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    # two-tier suite: `pytest -q` = fast tier (< 5 min on the 8-device
+    # CPU mesh); `pytest -q --slow` (or `-m slow`) adds the rest. CI
+    # runs both: `pytest -q && pytest -q -m slow`.
+    if config.getoption("--slow") or "slow" in (config.getoption("-m") or ""):
+        return
+    skip = pytest.mark.skip(reason="slow tier (run with --slow or -m slow)")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
 
 
 @pytest.fixture(scope="session")

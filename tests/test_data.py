@@ -103,6 +103,7 @@ class TestTokenFiles:
 
 
 class TestAccumAndSchedules:
+    @pytest.mark.slow  # two multi-step compiled train loops
     def test_accum_matches_big_batch(self):
         from hpc_patterns_tpu.models import TransformerConfig
         from hpc_patterns_tpu.models.train import (
@@ -143,6 +144,7 @@ class TestAccumAndSchedules:
 
 
 class TestPipelineTraining:
+    @pytest.mark.slow  # two multi-step compiled training runs
     def test_pipeline_gradients_match_sequential(self, mesh8):
         """PP must work for training, not just inference: gradients
         through the ring handoffs equal the sequential model's."""

@@ -75,6 +75,9 @@ class TestDecodeStep:
 
 
 class TestGenerate:
+    # fast tier keeps the baseline, GQA, and rope+GQA variants; the
+    # rest (MoE routing, bf16 ties, plain rope — subsumed by rope+GQA)
+    # are slow-tier
     @pytest.mark.parametrize("over", [
         {},
         {"n_kv_heads": 2},                  # GQA: grouped cache attention
@@ -82,12 +85,16 @@ class TestGenerate:
         # drop-free too (capacity_factor = n_experts => capacity =
         # token count); batch 4 actually exercises same-step routing
         # contention, which a capacity-limited decode would fail
-        {"n_experts": 2, "capacity_factor": 2.0},
-        {"dtype": "bfloat16"},
-        {"pos_embed": "rope"},              # post-rope keys in the cache
+        pytest.param({"n_experts": 2, "capacity_factor": 2.0},
+                     marks=pytest.mark.slow),
+        pytest.param({"dtype": "bfloat16"}, marks=pytest.mark.slow),
+        # post-rope keys in the cache
+        pytest.param({"pos_embed": "rope"}, marks=pytest.mark.slow),
         {"pos_embed": "rope", "n_kv_heads": 2},
     ])
-    @pytest.mark.parametrize("seed", [0, 7])
+    @pytest.mark.parametrize(
+        "seed", [0, pytest.param(7, marks=pytest.mark.slow)]
+    )
     def test_matches_oracle(self, over, seed):
         cfg, params, prompt = _setup(batch=4, seed=seed, **over)
         got = greedy_generate(params, prompt, cfg, new_tokens=6)

@@ -88,6 +88,7 @@ class TestTrainApp:
         assert code == 0, out
         assert "SUCCESS" in out and "tok/s" in out
 
+    @pytest.mark.slow  # unrolled-1F1B compile dominates (~1 min)
     def test_pp_run(self, capsys):
         from hpc_patterns_tpu.apps import train_app
 

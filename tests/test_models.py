@@ -242,11 +242,20 @@ class TestGQA:
             atol=1e-4,
         )
 
-    def test_gqa_sharded_matches_local(self, mesh_dp_sp_tp):
+    # n_kv_heads=2 on the dp2/sp2/tp2 mesh: ring/ring_flash run the
+    # NARROW path (tp 2 | kv 2); ulysses falls back to expansion
+    # ((2/2) % sp 2 != 0). n_kv_heads=4 sends ulysses down the narrow
+    # head-scatter path too. Every combination must equal the local
+    # unsharded oracle.
+    @pytest.mark.parametrize("attention,n_kv", [
+        ("ring", 2), ("ring_flash", 2), ("ulysses", 2), ("ulysses", 4),
+        ("ulysses_flash", 4),
+    ])
+    def test_gqa_sharded_matches_local(self, mesh_dp_sp_tp, attention, n_kv):
         tiny = dict(vocab=64, d_model=32, n_heads=8, n_layers=1, d_ff=64,
-                    max_seq=16, dtype="float32", n_kv_heads=2)
+                    max_seq=16, dtype="float32", n_kv_heads=n_kv)
         cfg_local = TransformerConfig(**tiny)
-        cfg_mesh = TransformerConfig(**{**tiny, "attention": "ring"})
+        cfg_mesh = TransformerConfig(**{**tiny, "attention": attention})
         params = init_params(jax.random.PRNGKey(0), cfg_local)
         tokens = _tokens(jax.random.PRNGKey(1), b=4, t=16)
         want = loss_fn(params, tokens, cfg_local)
@@ -264,7 +273,8 @@ class TestGQA:
             TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=1,
                               d_ff=64, max_seq=16, n_kv_heads=3)
 
-    def test_gqa_train_learns(self):
+    @pytest.mark.slow  # multi-step train loop; learning also covered by
+    def test_gqa_train_learns(self):  # TestTrainStep + sharded oracles
         cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
                                 d_ff=64, max_seq=16, n_kv_heads=2)
         params, opt = init_train_state(jax.random.PRNGKey(0), cfg)

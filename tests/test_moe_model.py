@@ -1,7 +1,12 @@
-"""MoE-transformer integration tests."""
+"""MoE-transformer integration tests.
+
+Slow tier: multi-step MoE training compiles are the bulk; fast-tier MoE
+coverage lives in test_moe.py (unit oracles) and the dryrun MoE leg."""
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow
 
 import jax
 

@@ -42,7 +42,9 @@ class TestFlashAttention:
         want = full_attention(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
-    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize(
+        "causal", [True, pytest.param(False, marks=pytest.mark.slow)]
+    )
     def test_block_partials_merge_to_full(self, causal):
         # two half-sequence K/V blocks at their global offsets, merged by
         # logsumexp, must equal attention over the whole sequence

@@ -156,6 +156,98 @@ class TestUlysses:
             )
 
 
+class TestGQANarrowKV:
+    """GQA with NARROW K/V (kv_heads < heads) through every impl — each
+    must equal the expanded-K/V oracle exactly (no expansion happens
+    inside; the oracle builds it explicitly)."""
+
+    def _gqa_qkv(self, key, hkv, h=H, dtype=jnp.float32):
+        ks = jax.random.split(key, 3)
+        q = jax.random.normal(ks[0], (B, T, h, D), dtype)
+        k = jax.random.normal(ks[1], (B, T, hkv, D), dtype)
+        v = jax.random.normal(ks[2], (B, T, hkv, D), dtype)
+        return q, k, v
+
+    def _want(self, q, k, v, causal=True):
+        g = q.shape[2] // k.shape[2]
+        return full_attention(
+            q, jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2),
+            causal=causal,
+        )
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_full_attention_grouped(self, causal):
+        q, k, v = self._gqa_qkv(jax.random.PRNGKey(10), hkv=2)
+        got = full_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(self._want(q, k, v, causal)),
+            atol=2e-5,
+        )
+
+    @pytest.mark.parametrize("impl", ["dense", "flash"])
+    def test_ring_narrow_kv(self, mesh8, impl):
+        # the narrow K/V block is what circulates: group-factor less
+        # ppermute traffic per step
+        q, k, v = self._gqa_qkv(jax.random.PRNGKey(11), hkv=2)
+        got = _shmap_seq(
+            mesh8,
+            lambda q, k, v: parallel.ring_attention(
+                q, k, v, "x", causal=True, impl=impl
+            ),
+            q, k, v,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(self._want(q, k, v)), atol=2e-5
+        )
+
+    @pytest.mark.slow  # grad-through-GQA also covered in test_ops
+    def test_ring_narrow_kv_grad(self, mesh8):
+        q, k, v = self._gqa_qkv(jax.random.PRNGKey(12), hkv=2)
+        spec = P(None, "x", None, None)
+        ringed = jax.shard_map(
+            lambda q, k, v: parallel.ring_attention(
+                q, k, v, "x", causal=True, impl="flash"
+            ),
+            mesh=mesh8, in_specs=(spec,) * 3, out_specs=spec,
+        )
+        g_got = jax.jit(jax.grad(
+            lambda q, k, v: ringed(q, k, v).sum(), argnums=(0, 1, 2)
+        ))(q, k, v)
+        g_want = jax.grad(
+            lambda q, k, v: self._want(q, k, v).sum(), argnums=(0, 1, 2)
+        )(q, k, v)
+        for a, b in zip(g_got, g_want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+    @pytest.mark.parametrize("impl", ["dense", "flash"])
+    def test_ulysses_narrow_kv_scatter(self, mesh8, impl):
+        # kv_heads divides the axis: the narrow K/V ride the all-to-alls
+        q, k, v = self._gqa_qkv(jax.random.PRNGKey(13), hkv=8, h=16)
+        got = _shmap_seq(
+            mesh8,
+            lambda q, k, v: parallel.ulysses_attention(
+                q, k, v, "x", causal=True, impl=impl
+            ),
+            q, k, v,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(self._want(q, k, v)), atol=2e-5
+        )
+
+    @pytest.mark.slow  # expansion fallback = pre-GQA path, stable
+    def test_ulysses_narrow_kv_fallback(self, mesh8):
+        # kv_heads does NOT divide the axis: expansion fallback, same math
+        q, k, v = self._gqa_qkv(jax.random.PRNGKey(14), hkv=2)
+        got = _shmap_seq(
+            mesh8,
+            lambda q, k, v: parallel.ulysses_attention(q, k, v, "x", causal=True),
+            q, k, v,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(self._want(q, k, v)), atol=2e-5
+        )
+
+
 class TestTensorParallel:
     def test_tp_mlp_matches_dense(self, mesh8):
         key = jax.random.PRNGKey(3)

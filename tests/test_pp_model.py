@@ -11,6 +11,11 @@ from hpc_patterns_tpu import topology
 from hpc_patterns_tpu.models import TransformerConfig, init_params, loss_fn
 from hpc_patterns_tpu.models import pp as pplib
 
+# slow tier: each oracle traces + compiles a full unrolled-1F1B model
+# (minutes each on the CPU mesh). Fast-tier PP coverage lives in
+# test_parallel.py::TestPipeline1F1B and the per-round dryrun PP leg.
+pytestmark = pytest.mark.slow
+
 CFG = dict(vocab=32, d_model=16, n_heads=2, n_layers=4, d_ff=32,
            max_seq=8, dtype="float32")
 
