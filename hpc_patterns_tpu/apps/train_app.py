@@ -243,12 +243,14 @@ def _train_loop(args, log, cfg, mesh, params, opt_state, step_fn, *,
 
 def _run_pp(args, log, cfg) -> int:
     """--pp path: 1F1B pipeline training (models/pp.py), optionally
-    data-parallel; stage-local math only (no sp/tp/ep inside stages)."""
+    data-parallel and/or MoE (aux loss threaded through the schedule);
+    stage-local math only (no sp/tp/ep axes inside stages)."""
     from hpc_patterns_tpu.models import pp as pplib
 
-    if args.sp > 1 or args.tp > 1 or args.ep > 1 or args.n_experts:
-        log.print("ERROR: --pp composes with --dp only (stage-local "
-                  "math; no sp/tp/ep inside pipeline stages yet)")
+    if args.sp > 1 or args.tp > 1 or args.ep > 1:
+        log.print("ERROR: --pp composes with --dp and --n-experts only "
+                  "(stage-local math; no sp/tp/ep axes inside pipeline "
+                  "stages — MoE experts route densely per stage)")
         log.print("FAILURE")
         return 1
     if args.attention not in ("full", "flash"):

@@ -25,6 +25,13 @@ property: collectives on device-resident shards, no host staging.
 Composes with data parallelism: on a ("dp", "pp") mesh the batch is
 dp-sharded outside, the pipeline runs per dp-slice, and gradients are
 pmean'd over dp.
+
+Composes with MoE: stages return their load-balance aux loss alongside
+the activation and the 1F1B schedule threads it through
+(``stage_aux_weight``) — the aux gradient rides the normal backward,
+and the reported loss adds the psum'd aux term. Experts are
+stage-local (dense routing per pp rank, no ep axis inside the
+pipeline).
 """
 
 from __future__ import annotations
@@ -60,12 +67,20 @@ def _embed(outer, tokens, cfg):
 
 def _stage_fn(layers_shard, h, cfg):
     """One pipeline stage: scan this rank's L/P layers (shape-preserving,
-    single-device math — mesh=None inside the pp rank)."""
-    def body(x, lp):
-        x, _ = _layer(x, lp, cfg, mesh=None, act_spec=None)
-        return x, None
+    single-device math — mesh=None inside the pp rank). MoE configs
+    return ``(h, aux)`` — the stage-local load-balance loss sum, which
+    the 1F1B schedule threads through via ``stage_aux_weight`` (experts
+    are stage-local here: dense routing per rank, no ep axis inside the
+    pipeline)."""
+    def body(carry, lp):
+        x, aux = carry
+        x, a = _layer(x, lp, cfg, mesh=None, act_spec=None)
+        return (x, aux + a), None
 
-    h, _ = lax.scan(body, h, layers_shard)
+    (h, aux), _ = lax.scan(body, (h, jnp.zeros((), jnp.float32)),
+                           layers_shard)
+    if cfg.n_experts:
+        return h, aux
     return h
 
 
@@ -90,12 +105,6 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
     Loss and gradients are replicated on return (pipeline-internal
     validity masks are resolved by psum/pmean over the mesh axes).
     """
-    if cfg.n_experts:
-        raise NotImplementedError(
-            "pipeline-parallel MoE: the load-balance aux loss is not "
-            "threaded through the 1F1B schedule yet — use the dp/ep "
-            "train path (models/train.py) for MoE models"
-        )
     M = microbatches
     pp = mesh.shape[axis_pp]
     L = cfg.n_layers
@@ -124,6 +133,7 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
             axis_pp,
             loss_params=head,
             return_input_grads=True,
+            stage_aux_weight=cfg.moe_aux_weight if cfg.n_experts else None,
         )
 
         # embedding backward: cotangents of the pipeline inputs (nonzero
@@ -134,6 +144,13 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
         # replicate the rank-local pieces: loss and head grads live on
         # the last pp rank, embedding grads on rank 0, so psum = broadcast
         loss = lax.psum(loss, axis_pp)
+        if cfg.n_experts:
+            # total load-balance loss: stage-local sums live per rank;
+            # psum over pp = the sum over all layers, / M for the
+            # per-microbatch mean (matching transformer.loss_fn, whose
+            # aux is summed over layers on the whole batch)
+            aux_mean = lax.psum(extras["aux_sum"], axis_pp) / M
+            loss = loss + cfg.moe_aux_weight * aux_mean
         head_grads = jax.tree.map(lambda g: lax.psum(g, axis_pp),
                                   extras["loss_grads"])
         outer_grads = jax.tree.map(

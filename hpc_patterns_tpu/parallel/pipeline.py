@@ -27,12 +27,22 @@ data-dependent branching — SURVEY.md's XLA-semantics ground rule).
 
 from __future__ import annotations
 
+import functools
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from hpc_patterns_tpu.comm import ring
+
+
+def _varying(tree, axis: str):
+    """Mark fresh (axis-invariant) arrays as varying over the shard_map
+    axis, so they can carry through a lax.scan whose body mixes them
+    with genuinely per-rank values (ring hops, rank-masked updates) —
+    scan requires carry-in and carry-out VMA types to match."""
+    return jax.tree.map(lambda a: lax.pcast(a, (axis,), to="varying"), tree)
 
 
 def pipeline_forward(
@@ -56,32 +66,46 @@ def pipeline_forward(
     M = x_microbatches.shape[0]
     mb_shape = x_microbatches.shape[1:]
 
-    buf = jnp.zeros(mb_shape, x_microbatches.dtype)  # incoming activation
-    outs = jnp.zeros((M, *mb_shape), x_microbatches.dtype)
+    # shape contract checked once up front (the handoff buffer is reused
+    # every tick, so stages must be shape/dtype-preserving — project
+    # in/out inside stage_fn)
+    y_shape = jax.eval_shape(
+        stage_fn, stage_params,
+        jax.ShapeDtypeStruct(mb_shape, x_microbatches.dtype),
+    )
+    if y_shape.shape != mb_shape or y_shape.dtype != x_microbatches.dtype:
+        raise ValueError(
+            f"stage_fn must preserve microbatch shape/dtype: "
+            f"{mb_shape}/{x_microbatches.dtype} -> "
+            f"{y_shape.shape}/{y_shape.dtype}"
+        )
 
-    for tick in range(M + size - 1):
+    buf = jnp.zeros(mb_shape, x_microbatches.dtype)  # incoming activation
+
+    def tick_body(carry, tick):
+        buf, outs = carry
         # entry rank injects microbatch `tick` during the fill window
-        feed_idx = min(tick, M - 1)
-        cur = jnp.where(me == 0, x_microbatches[feed_idx], buf)
+        cur = jnp.where(me == 0, x_microbatches[jnp.clip(tick, 0, M - 1)],
+                        buf)
         # stage r is active for microbatch (tick - r) in [0, M)
         active = jnp.logical_and(tick - me >= 0, tick - me < M)
         y = stage_fn(stage_params, cur)
-        if y.shape != cur.shape or y.dtype != cur.dtype:
-            # the handoff buffer is reused every tick, so stages must be
-            # shape/dtype-preserving (project in/out inside stage_fn)
-            raise ValueError(
-                f"stage_fn must preserve microbatch shape/dtype: "
-                f"{cur.shape}/{cur.dtype} -> {y.shape}/{y.dtype}"
-            )
         y = jnp.where(active, y, jnp.zeros_like(y))
         # last stage banks its finished microbatch
-        out_idx = max(min(tick - (size - 1), M - 1), 0)
+        out_idx = jnp.clip(tick - (size - 1), 0, M - 1)
         bank = jnp.logical_and(active, me == size - 1)
         outs = outs.at[out_idx].set(jnp.where(bank, y, outs[out_idx]))
         # neighbor handoff (the SendRecvRing hop); last->0 wraps but rank 0
         # overwrites with its injection, so the wrap is harmless
         buf = ring.ring_shift(y, axis, 1)
+        return (buf, outs), None
 
+    outs = jnp.zeros((M, *mb_shape), x_microbatches.dtype)
+    # scan, not a Python loop: the stage traces ONCE however long the
+    # pipeline runs (compile cost independent of M and P)
+    (buf, outs), _ = lax.scan(
+        tick_body, _varying((buf, outs), axis), jnp.arange(M + size - 1)
+    )
     return outs
 
 
@@ -125,6 +149,7 @@ def pipeline_train_1f1b(
     *,
     loss_params=None,
     return_input_grads: bool = False,
+    stage_aux_weight: float | None = None,
 ):
     """One 1F1B pipeline training pass (rank-local; run inside
     ``shard_map``): forward every microbatch through the P stages,
@@ -148,9 +173,18 @@ def pipeline_train_1f1b(
     replicate). ``return_input_grads``: also return d(loss)/d(x_m) as an
     (M, ...) f32 array (nonzero on rank 0) — the hook for differentiating
     whatever produced the pipeline inputs (e.g. the embedding).
-    With either option the return becomes ``(mean_loss, grads, extras)``
-    with ``extras = {"loss_grads": ..., "input_grads": ...}`` (the
-    requested keys only); plain calls keep the 2-tuple.
+    ``stage_aux_weight`` (optional): when set, ``stage_fn`` returns
+    ``(y, aux)`` with ``aux`` a scalar per-microbatch auxiliary loss
+    (e.g. the MoE load-balance loss). The aux values are accumulated
+    over this rank's forwards into ``extras["aux_sum"]`` (unweighted;
+    psum over the axis and divide by M upstream), and each backward
+    seeds the aux output's cotangent with ``stage_aux_weight``, so the
+    returned parameter/input gradients include the weighted aux term —
+    the auxiliary loss rides the existing 1F1B backward, no extra pass.
+
+    With any option the return becomes ``(mean_loss, grads, extras)``
+    with ``extras = {"loss_grads": ..., "input_grads": ..., "aux_sum":
+    ...}`` (the requested keys only); plain calls keep the 2-tuple.
 
     Scheduling follows :func:`schedule_1f1b`; the input stash and the
     activation/cotangent mailboxes are ring-indexed with ``min(P, M)``
@@ -172,6 +206,15 @@ def pipeline_train_1f1b(
     in_grads = (jnp.zeros((M, *mb_shape), f32)
                 if return_input_grads else None)
     loss_sum = jnp.zeros((), f32)
+    has_aux = stage_aux_weight is not None
+    aux_sum = jnp.zeros((), f32) if has_aux else None
+
+    def eval_stage(params, x):
+        """Uniform (y, aux) stage evaluation (aux = 0 when unused)."""
+        if has_aux:
+            y, aux = stage_fn(params, x)
+            return y, aux.astype(f32)
+        return stage_fn(params, x), jnp.zeros((), f32)
 
     def fwd_microbatch_at(t):
         """(m, valid) for this rank's forward at tick t (traced me)."""
@@ -204,14 +247,14 @@ def pipeline_train_1f1b(
             jnp.where(ok, payload.astype(mail.dtype), cur)
         )
 
-    n_ticks = 2 * M + 2 * P - 3 + 1
-    for t in range(n_ticks):
-        # static tick phases: before tick P no rank can run a backward
-        # (first is t_b(P-1, 0) = P), after tick 2M+P-3 no rank forwards
-        # (last is t_f(P-1, M-1)) — skip the corresponding unit entirely
-        # instead of emitting fully-masked compute
-        has_fwd = t <= 2 * M + P - 3
-        has_bwd = t >= P
+    def tick_body(carry, t, *, has_fwd, has_bwd):
+        # one 1F1B tick. ``has_fwd``/``has_bwd`` are STATIC phase flags
+        # (fixed per scan segment below): before tick P no rank can run
+        # a backward (first is t_b(P-1, 0) = P), after tick 2M+P-3 no
+        # rank forwards (last is t_f(P-1, M-1)) — the corresponding unit
+        # is skipped entirely instead of emitting fully-masked compute.
+        (in_stash, fwd_mail, bwd_mail, grads, loss_grads, in_grads,
+         loss_sum, aux_sum) = carry
         is_last = me == P - 1
 
         if has_fwd:
@@ -227,14 +270,14 @@ def pipeline_train_1f1b(
 
         if not has_bwd:
             # fwd-only tick: plain stage evaluation, no pullback, no loss
-            y = stage_fn(stage_params, x_f)
+            y, aux = eval_stage(stage_params, x_f)
         else:
             # ONE stage evaluation serves both units: per stage, forward
             # and backward never share a tick (schedule invariant), so
             # select the input and run a single vjp — y is the forward's
             # output on f_ok ticks, the recomputed activation on b_ok
             x_sel = jnp.where(b_ok, x_b, x_f) if has_fwd else x_b
-            y, pullback = jax.vjp(stage_fn, stage_params, x_sel)
+            (y, aux), pullback = jax.vjp(eval_stage, stage_params, x_sel)
 
             tgt = targets[jnp.clip(m_b, 0, M - 1)]
             if loss_params is None:
@@ -250,7 +293,15 @@ def pipeline_train_1f1b(
                     lambda g, d: g + lp_mask * d.astype(f32), loss_grads, dlp
                 )
             dy = jnp.where(is_last, dloss, bwd_mail[m_b % S]).astype(y.dtype)
-            dparams, dx = pullback(dy)
+            # aux cotangent: the weighted auxiliary loss enters this
+            # microbatch's backward here. Without aux the cotangent must
+            # stay a plain (axis-invariant) zero to match eval_stage's
+            # constant-zero aux output VMA type
+            daux = (
+                jnp.where(b_ok, jnp.float32(stage_aux_weight), 0.0)
+                if has_aux else jnp.zeros((), f32)
+            )
+            dparams, dx = pullback((dy, daux))
             b_mask = b_ok.astype(f32)
             grads = jax.tree.map(
                 lambda g, d: g + b_mask * d.astype(f32), grads, dparams
@@ -264,6 +315,11 @@ def pipeline_train_1f1b(
             loss_sum = loss_sum + jnp.where(
                 jnp.logical_and(b_ok, is_last), loss_m, 0.0
             )
+        if has_aux and has_fwd:
+            # aux belongs to the FORWARD microbatch (f_ok and b_ok never
+            # coincide on one stage, so a backward tick's recomputed aux
+            # is not double-counted)
+            aux_sum = aux_sum + jnp.where(f_ok, aux, 0.0)
 
         # ---- neighbor handoffs (masked payloads; only phases that can
         # carry data hop): the activation hops forward, the cotangent
@@ -289,6 +345,34 @@ def pipeline_train_1f1b(
                 bwd_mail, mb_recv[0],
                 jnp.logical_and(mb_recv[1] == 1, me != P - 1), dx_recv,
             )
+        return (in_stash, fwd_mail, bwd_mail, grads, loss_grads, in_grads,
+                loss_sum, aux_sum), None
+
+    # three lax.scan segments with static phase flags — the stage traces
+    # a constant number of times (one plain eval + two vjps) however
+    # large M and P are, vs one trace per tick under a Python loop:
+    #   [0, P)            fwd only (fill; no backward can exist yet)
+    #   [P, 2M+P-2)       mixed 1F1B steady state (empty when M == 1)
+    #   [2M+P-2, n_ticks) bwd only (drain; no forward remains)
+    n_ticks = 2 * M + 2 * P - 3 + 1
+    carry = _varying(
+        (in_stash, fwd_mail, bwd_mail, grads, loss_grads, in_grads,
+         loss_sum, aux_sum),
+        axis,
+    )
+    segments = (
+        (0, P, True, False),
+        (P, max(2 * M + P - 2, P), True, True),
+        (max(2 * M + P - 2, P), n_ticks, False, True),
+    )
+    for t0, t1, hf, hb in segments:
+        if t1 > t0:
+            carry, _ = lax.scan(
+                functools.partial(tick_body, has_fwd=hf, has_bwd=hb),
+                carry, jnp.arange(t0, t1),
+            )
+    (in_stash, fwd_mail, bwd_mail, grads, loss_grads, in_grads,
+     loss_sum, aux_sum) = carry
 
     mean_loss = jnp.where(me == P - 1, loss_sum / M, 0.0)
     extras = {}
@@ -296,6 +380,8 @@ def pipeline_train_1f1b(
         extras["loss_grads"] = loss_grads
     if return_input_grads:
         extras["input_grads"] = in_grads
+    if has_aux:
+        extras["aux_sum"] = aux_sum
     if extras:
         return mean_loss, grads, extras
     return mean_loss, grads

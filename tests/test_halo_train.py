@@ -107,7 +107,7 @@ class TestTrainApp:
         code = train_app.main(["--pp", "2", "--tp", "2"])
         out = capsys.readouterr().out
         assert code == 1
-        assert "composes with --dp only" in out
+        assert "composes with --dp and --n-experts only" in out
 
     def test_mesh_run_with_resume(self, capsys, tmp_path):
         from hpc_patterns_tpu.apps import train_app

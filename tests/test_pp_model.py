@@ -86,6 +86,36 @@ class TestPPModel:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-5)
 
+    @pytest.mark.parametrize("dp", [None, "dp"])
+    def test_pp_moe_matches_oracle(self, dp):
+        # PP x MoE: the load-balance aux loss threads through the 1F1B
+        # schedule (stage_aux_weight). Oracle semantics are per-
+        # microbatch: routing fractions and capacity are computed per
+        # microbatch in the pipeline, so the reference loss is the mean
+        # of loss_fn over the same microbatch slices (aux is nonlinear
+        # in the batch, so the full-batch loss_fn would NOT match).
+        cfg = TransformerConfig(**{**CFG, "n_layers": 2, "n_experts": 2,
+                                   "capacity_factor": 2.0})
+        params = init_params(jax.random.PRNGKey(2), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(3), (8, 8), 0, 32,
+                                    "int32")
+        M, dsize = 2, (2 if dp else 1)
+
+        def oracle(p):
+            mbs = tokens.reshape(M * dsize, -1, tokens.shape[-1])
+            return sum(loss_fn(p, mb, cfg) for mb in mbs) / (M * dsize)
+
+        want_loss, want_g = jax.value_and_grad(oracle)(params)
+        axes = {"dp": 2, "pp": 2} if dp else {"pp": 2}
+        mesh = topology.make_mesh(axes, jax.devices()[:2 * dsize])
+        loss, grads = pplib.pp_loss_and_grads(
+            params, tokens, cfg, mesh, microbatches=M, axis_dp=dp
+        )
+        np.testing.assert_allclose(float(loss), float(want_loss), rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(grads), jax.tree.leaves(want_g)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5)
+
     def test_layers_must_divide(self, setup):
         cfg, params, tokens, _, _ = setup
         mesh = topology.make_mesh({"pp": 4}, jax.devices()[:4])
