@@ -36,6 +36,7 @@ from hpc_patterns_tpu.harness import RunLog, Verdict, correctness_verdict, measu
 from hpc_patterns_tpu.harness.cli import (
     add_memory_kind_args,
     add_msg_size_args,
+    add_sweep_args,
     base_parser,
 )
 from hpc_patterns_tpu.harness.timing import max_across_processes
@@ -63,6 +64,16 @@ def build_parser():
         default=-1,
         help="ranks (mesh size); -1 = all devices (mpirun -np analog)",
     )
+    p.add_argument(
+        "--sweep",
+        action="store_true",
+        help="sweep message sizes --min-p..-p for each algorithm "
+             "(ring, ring_chunked, collective unless --algorithm/-a "
+             "narrows it), emitting one validated JSONL result per "
+             "point — the GB/s-vs-size curve of the BASELINE metric "
+             "(reference protocol: allreduce-mpi-sycl.cpp:99,125-128)",
+    )
+    add_sweep_args(p)
     return p
 
 
@@ -75,9 +86,43 @@ def resolve_algorithm(args) -> str:
 def run(args) -> int:
     log = RunLog(args.log, truncate=not args.log_append)
     comm = common.make_communicator(args.backend, args.world, even=True)
+    if args.sweep:
+        return run_sweep(args, log, comm)
+    return _run_point(args, log, comm, resolve_algorithm(args),
+                      args.log2_elements)
+
+
+def run_sweep(args, log, comm) -> int:
+    """Message-size sweep per algorithm: every point is a full validated
+    run (analytic oracle + "Passed r" lines), and every point emits a
+    JSONL result record — together the busbw-vs-size curve. On world=1
+    the ring degenerates to a copy and the bandwidths are NOT a
+    collective measurement; the records carry the world size so readers
+    can tell."""
+    if args.min_p > args.log2_elements:
+        log.print(f"ERROR: --min-p {args.min_p} > -p {args.log2_elements}")
+        log.print("FAILURE")
+        return 1
+    if args.algorithm or args.allreduce:
+        algorithms = [resolve_algorithm(args)]
+    else:
+        algorithms = ["ring", "ring_chunked", "collective"]
+    n_ok = n_total = 0
+    for algorithm in algorithms:
+        for p in range(args.min_p, args.log2_elements + 1):
+            n_total += 1
+            n_ok += _run_point(args, log, comm, algorithm, p) == 0
+    ok = n_ok == n_total
+    log.print(f"sweep: {n_ok}/{n_total} points passed "
+              f"(world={comm.size}, p={args.min_p}..{args.log2_elements}, "
+              f"algorithms={','.join(algorithms)})")
+    log.print("SUCCESS" if ok else "FAILURE")
+    return 0 if ok else 1
+
+
+def _run_point(args, log, comm, algorithm: str, log2_elements: int) -> int:
     world = comm.size
-    algorithm = resolve_algorithm(args)
-    n = 1 << args.log2_elements
+    n = 1 << log2_elements
     traits = get_traits(args.dtype)
     if algorithm == "ring_chunked" and n % world:
         # chunked ring needs size | n; pad up like any real collective would
@@ -140,7 +185,7 @@ def run(args) -> int:
         memory_kind=memory_kind or "device",
     )
     log.print(
-        f"{algorithm} world={world} n=2^{args.log2_elements} {traits.dtype.name}: "
+        f"{algorithm} world={world} n=2^{log2_elements} {traits.dtype.name}: "
         f"{elapsed * 1e3:.3f} ms, busbw {busbw:.2f} GB/s"
     )
     log.print(verdict.summary_line())

@@ -18,6 +18,15 @@ Here the runtime pair is {native C++ allocator, numpy} ↔ {JAX} ↔
    default (TPU) device and back, value-validated — the boundary that
    is a DMA by physics (the reference's analog stops at one GPU's
    context; crossing memory spaces is the concurrency suite's M2D).
+4. device-side in-place (interop/device.py): jit donation and a Pallas
+   ``input_output_aliases`` kernel writing the output INTO the input's
+   device buffer — pointer identity where the backend exposes raw
+   pointers, else the compiled executable's aliasing contract — the
+   device-context leg the reference proves with OMP/SYCL kernels in
+   one Level-Zero context (interop_omp_ze_sycl.cpp:81-101).
+5. ``--native-driver``: the C++ XLA driver (native/interop_driver.cpp)
+   — native main() allocating buffers, XLA reading them zero-copy and
+   writing donated outputs in place, every assert on the C side.
 
 Prints per-direction "Passed <n>" lines and a SUCCESS/FAILURE verdict.
 """
@@ -41,7 +50,44 @@ def build_parser():
     p.add_argument("-n", "--elements", type=int, default=1 << 16)
     p.add_argument("--alignment", type=int, default=128,
                    help="native allocation alignment (reference ALIGNMENT=128)")
+    p.add_argument("--native-driver", action="store_true",
+                   help="also run the C++ XLA driver leg (builds "
+                        "native/interop-driver; asserts on the C side)")
     return p
+
+
+def _native_driver_leg(log, n: int) -> bool:
+    """Build and run native/interop-driver: C++ owning main(), the
+    allocator, and the asserts while XLA executes on its buffers."""
+    import os
+    import subprocess
+    from pathlib import Path
+
+    native_dir = Path(native.__file__).resolve().parents[2] / "native"
+    try:
+        r = subprocess.run(["make", "-C", str(native_dir), "interop-driver"],
+                           capture_output=True, text=True, timeout=120)
+        if r.returncode != 0:
+            log.print(f"native driver build failed: {r.stderr[:200]}")
+            return False
+        pythonpath = ":".join(p for p in sys.path if p)
+        env = dict(os.environ)
+        r = subprocess.run(
+            [str(native_dir / "interop-driver"), "--elements", str(n),
+             "--pythonpath", pythonpath],
+            capture_output=True, text=True, timeout=300, env=env,
+        )
+    except (OSError, subprocess.SubprocessError) as e:
+        # a missing toolchain or a hung build is a FAILED leg, not an
+        # app crash — the other legs' results must still be reported
+        log.print(f"native driver leg error: {type(e).__name__}: {e}")
+        return False
+    for line in r.stdout.splitlines():
+        log.print(f"  [driver] {line}")
+    if r.returncode != 0:
+        log.print(f"native driver failed rc={r.returncode}: "
+                  f"{r.stderr[-300:]}")
+    return r.returncode == 0
 
 
 def run(args) -> int:
@@ -96,6 +142,24 @@ def run(args) -> int:
         (f"native->{dev.platform} roundtrip",
          bool(np.isclose(tripled[-1], expect_last, rtol=1e-6)))
     )
+
+    # 4. device-side in-place: donation + Pallas input_output_aliases
+    from hpc_patterns_tpu.interop import device as device_proofs
+
+    def kind(ev):
+        return "pointer" if ev["pointer_ok"] is not None else "compiled contract"
+
+    ok_don, ev_don = device_proofs.donation_alias_proof(n)
+    checks.append((f"device donation in-place ({kind(ev_don)})", ok_don))
+    ok_pal, ev_pal = device_proofs.pallas_alias_proof()
+    checks.append(
+        (f"pallas input_output_alias ({kind(ev_pal)}"
+         f"{', interpret' if ev_pal['interpret'] else ''})", ok_pal)
+    )
+
+    # 5. the C++ XLA driver (opt-in: builds a binary, embeds CPython)
+    if args.native_driver:
+        checks.append(("native C++ XLA driver", _native_driver_leg(log, n)))
 
     all_ok = all(ok for _, ok in checks)
     for i, (name, ok) in enumerate(checks):

@@ -22,14 +22,18 @@ import numpy as np
 from hpc_patterns_tpu.apps import common
 from hpc_patterns_tpu.dtypes import get_traits
 from hpc_patterns_tpu.harness import RunLog, Verdict, measure
-from hpc_patterns_tpu.harness.cli import add_msg_size_args, base_parser
+from hpc_patterns_tpu.harness.cli import (
+    add_msg_size_args,
+    add_sweep_args,
+    base_parser,
+)
 from hpc_patterns_tpu.harness.timing import blocking, max_across_processes
 
 
 def build_parser():
     p = base_parser(__doc__.splitlines()[0])
     add_msg_size_args(p)
-    p.add_argument("--min-p", type=int, default=3, help="sweep start: 2**min_p elements")
+    add_sweep_args(p)
     p.add_argument("--world", type=int, default=-1, help="ranks; -1 = all devices")
     return p
 

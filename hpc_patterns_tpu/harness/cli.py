@@ -60,6 +60,24 @@ def add_msg_size_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dtype", default="float32", help="element dtype (dtypes.REGISTRY key)")
 
 
+def _nonneg_int(s: str) -> int:
+    v = int(s)
+    if v < 0:
+        raise argparse.ArgumentTypeError(f"must be >= 0, got {v}")
+    return v
+
+
+def add_sweep_args(p: argparse.ArgumentParser, default_min_p: int = 3) -> None:
+    """The size-sweep start flag shared by the sweeping apps (pingpong,
+    allreduce --sweep): sizes run 2**min_p .. 2**p."""
+    p.add_argument(
+        "--min-p",
+        type=_nonneg_int,
+        default=default_min_p,
+        help=f"sweep start: 2**min_p elements (default {default_min_p})",
+    )
+
+
 def add_memory_kind_args(p: argparse.ArgumentParser) -> None:
     g = p.add_mutually_exclusive_group()
     g.add_argument(

@@ -328,3 +328,58 @@ def single_device_mesh(axes: Sequence[str] = ("dp",)) -> Mesh:
     d = get_devices()[0]
     shape = (1,) * len(axes)
     return Mesh(np.asarray([d]).reshape(shape), tuple(axes))
+
+
+def hybrid_device_layout(
+    dcn_axes: Mapping[str, int],
+    ici_axes: Mapping[str, int],
+    devices: Sequence[jax.Device] | None = None,
+) -> tuple[np.ndarray, tuple[str, ...]]:
+    """Order devices for a multi-slice mesh: DCN axes vary across
+    slices, ICI axes within one. Returns ``(device_array, axis_names)``
+    with DCN axes leading (slowest-varying), so any sharding over an
+    ICI axis touches devices of a single slice and its collectives
+    ride ICI, while DCN axes (typically ``dp``) pay the slow link only
+    for their own collectives — the SURVEY §2.3 ICI/DCN mapping.
+
+    ``-1`` sizes auto-fill as in :func:`make_mesh`; the DCN product
+    must equal the slice count, the ICI product the per-slice device
+    count (slices must be equal-sized).
+    """
+    if devices is None:
+        devices = get_devices()
+    groups = group_by_slice(devices)
+    slice_ids = sorted(groups)
+    per_slice = {s: len(groups[s]) for s in slice_ids}
+    if len(set(per_slice.values())) != 1:
+        raise TopologyError(
+            f"slices are unequal ({per_slice}); a hybrid mesh needs "
+            "equal-sized slices"
+        )
+    n_slices = len(slice_ids)
+    n_per = per_slice[slice_ids[0]]
+    dcn_sizes = _factor_axes(n_slices, dcn_axes)
+    ici_sizes = _factor_axes(n_per, ici_axes)
+    overlap = set(dcn_sizes) & set(ici_sizes)
+    if overlap:
+        raise TopologyError(f"axes {sorted(overlap)} appear in both "
+                            "dcn_axes and ici_axes")
+    # slice-major order: row s = slice s's devices (each row is one ICI
+    # domain), then fold rows into the DCN shape and columns into ICI
+    arr = np.array(
+        [groups[s] for s in slice_ids], dtype=object
+    ).reshape(*dcn_sizes.values(), *ici_sizes.values())
+    return arr, (*dcn_sizes.keys(), *ici_sizes.keys())
+
+
+def make_hybrid_mesh(
+    dcn_axes: Mapping[str, int],
+    ici_axes: Mapping[str, int],
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Multi-slice :class:`Mesh`: DCN axes across slices, ICI axes
+    within (see :func:`hybrid_device_layout`). On a single slice this
+    degenerates to ``make_mesh`` with the DCN axes sized 1."""
+    arr, names = hybrid_device_layout(dcn_axes, ici_axes, devices)
+    axis_types = (jax.sharding.AxisType.Auto,) * len(names)
+    return Mesh(arr, names, axis_types=axis_types)

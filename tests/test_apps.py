@@ -48,6 +48,35 @@ def test_allreduce_app_host_memory_kind_falls_back(capsys):
     assert "SUCCESS" in out
 
 
+def test_allreduce_app_size_sweep(tmp_path, capsys):
+    # the BASELINE metric protocol: busbw-vs-size curve per algorithm,
+    # every point validated against the analytic oracle
+    log = tmp_path / "sweep.jsonl"
+    rc = allreduce_app.main(["--sweep", "--min-p", "3", "-p", "5",
+                             "--repetitions", "2", "--warmup", "1",
+                             "--log", str(log)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "sweep: 9/9 points passed" in out
+    records = [json.loads(l) for l in log.read_text().splitlines()
+               if '"result"' in l]
+    assert len(records) == 9  # 3 algorithms x p in {3,4,5}
+    algs = {r["name"] for r in records}
+    assert algs == {"allreduce[ring]", "allreduce[ring_chunked]",
+                    "allreduce[collective]"}
+    assert all(r["success"] and r["world"] == 8 for r in records)
+    sizes = sorted(r["elements"] for r in records
+                   if r["name"] == "allreduce[collective]")
+    assert sizes == [8, 16, 32]
+
+
+def test_allreduce_sweep_bad_range_fails(capsys):
+    rc = allreduce_app.main(["--sweep", "--min-p", "9", "-p", "5"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "FAILURE" in out
+
+
 def test_pingpong_app_sweep(capsys):
     rc = pingpong_app.main(["--min-p", "3", "-p", "6", "--repetitions", "2"])
     out = capsys.readouterr().out

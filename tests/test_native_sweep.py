@@ -85,3 +85,25 @@ class TestNativeSweep:
     def test_missing_log_is_usage_error(self, tmp_path):
         assert _run("--log", str(tmp_path / "nope.jsonl")).returncode == 2
         assert _run().returncode == 2
+
+    @pytest.mark.slow  # boots a python app process on the CPU mesh
+    def test_drives_allreduce_size_sweep(self, tmp_path):
+        # the registered CI line for the BASELINE busbw-vs-size metric:
+        # the native driver runs the sweep and judges its JSONL records
+        import os
+        import sys
+
+        log = tmp_path / "ar.jsonl"
+        cmd = (
+            f"{sys.executable} -m hpc_patterns_tpu.apps.allreduce_app "
+            f"--sweep --min-p 3 -p 4 --repetitions 2 --warmup 1 "
+            f"--log {log} --log-append"
+        )
+        env = dict(os.environ)
+        repo = str(Path(__file__).resolve().parent.parent)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        r = subprocess.run([str(DRIVER), "--log", str(log), "--run", cmd],
+                           capture_output=True, text=True, timeout=600,
+                           env=env)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "SUCCESS count: 6" in r.stdout  # 3 algorithms x p in {3,4}

@@ -76,3 +76,72 @@ def test_group_by_host():
     groups = topology.group_by_host()
     assert sum(len(v) for v in groups.values()) == 8
     assert set(groups) == {jax.devices()[0].process_index}
+
+
+class _FakeDev:
+    """Synthetic device carrying a slice_index (CPU devices are all
+    slice 0, so multi-slice layouts are tested with these)."""
+
+    def __init__(self, i, slice_index):
+        self.id = i
+        self.slice_index = slice_index
+
+    def __repr__(self):
+        return f"d{self.id}@s{self.slice_index}"
+
+
+class TestHybridMesh:
+    def test_layout_dcn_across_slices(self):
+        # 2 slices x 4 devices: dp must span slices, tp/sp stay inside
+        devs = [_FakeDev(i, i // 4) for i in range(8)]
+        arr, names = topology.hybrid_device_layout(
+            {"dp": -1}, {"sp": 2, "tp": 2}, devs
+        )
+        assert names == ("dp", "sp", "tp")
+        assert arr.shape == (2, 2, 2)
+        # every (sp, tp) plane = one slice; dp index = slice index
+        for d in range(2):
+            slices = {dev.slice_index for dev in arr[d].ravel()}
+            assert slices == {d}
+
+    def test_layout_guards(self):
+        devs = [_FakeDev(i, i // 4) for i in range(8)]
+        with pytest.raises(topology.TopologyError, match="both"):
+            topology.hybrid_device_layout({"dp": 2}, {"dp": 4}, devs)
+        with pytest.raises(topology.TopologyError):
+            # dcn product != slice count
+            topology.hybrid_device_layout({"dp": 4}, {"tp": 4}, devs)
+        uneven = [_FakeDev(i, 0 if i < 5 else 1) for i in range(8)]
+        with pytest.raises(topology.TopologyError, match="unequal"):
+            topology.hybrid_device_layout({"dp": 2}, {"tp": -1}, uneven)
+
+    def test_mesh_runs_collectives_per_domain(self, monkeypatch):
+        # real Mesh over the CPU devices with two SYNTHETIC slices:
+        # psum over the ici axis must stay inside one fake slice
+        # (device rows 0-3 / 4-7), psum over dcn crosses them
+        import numpy as np
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        ds = topology.get_devices()
+        fake_groups = {0: ds[:4], 1: ds[4:]}
+        monkeypatch.setattr(topology, "group_by_slice",
+                            lambda devices=None: fake_groups)
+        mesh = topology.make_hybrid_mesh({"dp": -1}, {"tp": -1}, ds)
+        assert mesh.shape == {"dp": 2, "tp": 4}
+        # row d of the mesh = fake slice d
+        for d in range(2):
+            assert list(mesh.devices[d]) == list(fake_groups[d])
+
+        x = jnp.arange(8.0)
+        got = jax.jit(jax.shard_map(
+            lambda v: jax.lax.psum(v, "tp"),
+            mesh=mesh, in_specs=P(("dp", "tp")), out_specs=P(("dp", "tp")),
+        ))(x)
+        # tp-psum folds within each slice: rows 0-3 sum to 6, 4-7 to 22
+        want = np.repeat([6.0, 22.0], 4)
+        np.testing.assert_allclose(np.asarray(got), want)
+
+    def test_single_slice_degenerates(self):
+        mesh = topology.make_hybrid_mesh({"dp": -1}, {"tp": 8})
+        assert mesh.shape == {"dp": 1, "tp": 8}
