@@ -108,10 +108,13 @@ def run_sweep(args, log, comm) -> int:
     else:
         algorithms = ["ring", "ring_chunked", "collective"]
     n_ok = n_total = 0
+    kind_cache: dict = {}  # memory-kind probe result, shared across points
     for algorithm in algorithms:
         for p in range(args.min_p, args.log2_elements + 1):
             n_total += 1
-            n_ok += _run_point(args, log, comm, algorithm, p) == 0
+            code = _run_point(args, log, comm, algorithm, p,
+                              kind_cache=kind_cache)
+            n_ok += code == 0
     ok = n_ok == n_total
     log.print(f"sweep: {n_ok}/{n_total} points passed "
               f"(world={comm.size}, p={args.min_p}..{args.log2_elements}, "
@@ -120,7 +123,8 @@ def run_sweep(args, log, comm) -> int:
     return 0 if ok else 1
 
 
-def _run_point(args, log, comm, algorithm: str, log2_elements: int) -> int:
+def _run_point(args, log, comm, algorithm: str, log2_elements: int,
+               kind_cache: dict | None = None) -> int:
     world = comm.size
     n = 1 << log2_elements
     traits = get_traits(args.dtype)
@@ -129,6 +133,10 @@ def _run_point(args, log, comm, algorithm: str, log2_elements: int) -> int:
         n += world - n % world
 
     memory_kind = None if args.memory_kind == "device" else args.memory_kind
+    if kind_cache is not None and memory_kind is not None:
+        # sweep mode: the probe outcome is invariant across points, so
+        # resolve once instead of re-probing (and re-logging) 75 times
+        memory_kind = kind_cache.get("kind", memory_kind)
     x = comm.rank_filled(n, traits.dtype)
     step = comm.jit_allreduce(x, algorithm)
     if memory_kind is not None:
@@ -147,6 +155,8 @@ def _run_point(args, log, comm, algorithm: str, log2_elements: int) -> int:
                 f"({type(e).__name__}); using device"
             )
             memory_kind = None
+    if kind_cache is not None:
+        kind_cache["kind"] = memory_kind
 
     result = measure(
         blocking(step, x), repetitions=args.repetitions, warmup=args.warmup

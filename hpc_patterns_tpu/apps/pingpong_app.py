@@ -40,6 +40,11 @@ def build_parser():
 
 def run(args) -> int:
     log = RunLog(args.log, truncate=not args.log_append)
+    if args.min_p > args.log2_elements:
+        # an empty sweep must not be a vacuous SUCCESS
+        log.print(f"ERROR: --min-p {args.min_p} > -p {args.log2_elements}")
+        log.print("FAILURE")
+        return 1
     comm = common.make_communicator(args.backend, args.world, even=True)
     if comm.size < 2:
         log.print("SKIP: ping-pong needs >= 2 devices (even ranks, "

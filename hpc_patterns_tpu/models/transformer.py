@@ -18,8 +18,11 @@ Architecture choices driven by the hardware (SURVEY.md preamble +
 - activation sharding is annotated with ``with_sharding_constraint``;
   parameter shardings live in models/sharding.py (Megatron column/row
   rules, ≙ parallel/tensor.py helpers);
-- optional ``jax.checkpoint`` remat on the layer body trades FLOPs for
-  HBM (the bandwidth-vs-memory lever).
+- optional remat trades FLOPs for HBM (the bandwidth-vs-memory lever),
+  with a policy axis (``remat_policy``): the default "split" leaves the
+  attention kernel outside any remat region so its custom_vjp
+  residuals persist and the flash forward runs exactly once per step
+  (measured on chip — benchmarks/RESULTS.md "MFU push").
 
 Params are a plain pytree of f32 arrays (master weights); ``forward``
 casts to ``cfg.dtype`` (bf16 by default) at use.
@@ -33,6 +36,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from hpc_patterns_tpu.models.sharding_util import mesh_axis_size, resolve_spec
@@ -60,6 +64,35 @@ class TransformerConfig:
     # K/V (grouped-query scores, no expansion; the ring circulates
     # group-factor less K/V). n_heads must divide by n_kv_heads
     n_kv_heads: int = 0
+    # remat=True recomputes layer activations in backward; remat_policy
+    # picks what is SAVED anyway (the FLOPs/HBM trade):
+    #   "nothing" — recompute everything (max memory saving);
+    #   "attn"    — save each attention output (the flash kernel's
+    #               backward only needs its out/lse residuals, so
+    #               re-running the kernel forward in the backward pass
+    #               is pure waste — this skips exactly that);
+    #   "dots"    — save all matmul outputs with no batch dims
+    #               (jax.checkpoint_policies.dots_with_no_batch_dims)
+    #   "dots_attn" — both of the above (note: a remat policy CANNOT
+    #               stop the flash forward kernel re-running in the
+    #               backward — custom_vjp residuals (out, lse) are
+    #               internal to the kernel call, and saving the named
+    #               attention output doesn't save them)
+    #   "split"   — checkpoint the qkv-projection block and the
+    #               mlp/residual block SEPARATELY and leave attention
+    #               outside any remat region, so the flash kernel's own
+    #               vjp residuals persist and its forward runs exactly
+    #               once (the kernel was profiled at ~25% of step time;
+    #               the replay is the removable quarter of it). Costs
+    #               q/k/v/out (+lse) per layer in HBM; the big per-layer
+    #               interiors (d_ff gelu, qkv matmul) still recompute.
+    remat_policy: str = "split"
+    # scan_layers=True drives the stacked layer weights with one traced
+    # lax.scan body (fast compiles, the long-model default);
+    # False unrolls the layer loop — each layer's weight slice becomes
+    # static, XLA drops the per-iteration dynamic-slice copies of the
+    # weight stack and fuses better (measured on chip; see RESULTS.md)
+    scan_layers: bool = True
     # positional scheme: "learned" absolute table, or "rope" rotary
     # embeddings (relative; the long-context default — composes with
     # ring/ulysses sequence sharding because rotation angles are a
@@ -103,6 +136,12 @@ class TransformerConfig:
         if self.attention not in ATTENTION_IMPLS:
             raise ValueError(
                 f"attention {self.attention!r} not in {ATTENTION_IMPLS}"
+            )
+        if self.remat_policy not in ("nothing", "attn", "dots", "dots_attn",
+                                     "split"):
+            raise ValueError(
+                f"remat_policy {self.remat_policy!r} not in "
+                "('nothing', 'attn', 'dots', 'dots_attn', 'split')"
             )
         if self.n_kv_heads < 0 or self.n_kv_heads > self.n_heads or (
             self.n_kv_heads and self.n_heads % self.n_kv_heads
@@ -297,21 +336,16 @@ def _moe_block(h, lp, cfg: TransformerConfig, mesh):
     return y, aux
 
 
-def _layer(x, lp, cfg: TransformerConfig, mesh, act_spec):
-    """One pre-norm block: attn + mlp/moe, Megatron-sharded (wqkv/w1
-    column, wo/w2 row — models/sharding.py), activations re-constrained
-    after each collective-inducing matmul. Returns (x, moe_aux)."""
+def _qkv_block(x, lp, cfg: TransformerConfig, mesh):
+    """Pre-attention: norm + fused qkv projection + rope + the GQA
+    narrow-vs-expand decision. Split out so remat_policy="split" can
+    checkpoint it independently of the attention kernel."""
     B, T, D = x.shape
-    H, Dh = cfg.n_heads, cfg.head_dim
-    dt = x.dtype
-
-    def c(y, spec):
-        return lax.with_sharding_constraint(y, spec) if mesh is not None else y
-
+    H = cfg.n_heads
     h = _rmsnorm(x, lp["ln1_scale"])
     q, k, v = project_qkv(h, lp, cfg)
     if cfg.pos_embed == "rope":
-        # global positions: _layer always sees the full sequence (the
+        # global positions: the layer always sees the full sequence (the
         # sp shard_map lives inside _attention), so iota(T) is correct
         # under every sharding
         pos = lax.broadcasted_iota(jnp.int32, (T,), 0)
@@ -331,7 +365,18 @@ def _layer(x, lp, cfg: TransformerConfig, mesh, act_spec):
         if not narrow:
             k = jnp.repeat(k, H // cfg.kv_heads, axis=2)
             v = jnp.repeat(v, H // cfg.kv_heads, axis=2)
-    o = _attention(q, k, v, cfg, mesh)
+    return q, k, v
+
+
+def _post_block(x, o, lp, cfg: TransformerConfig, mesh, act_spec):
+    """Post-attention: output projection, residual, norm, mlp/moe.
+    Returns (x, moe_aux)."""
+    B, T, D = x.shape
+    dt = x.dtype
+
+    def c(y, spec):
+        return lax.with_sharding_constraint(y, spec) if mesh is not None else y
+
     o = jnp.dot(o.reshape(B, T, D), lp["wo"].astype(dt))  # row-parallel
     x = c(x + o, act_spec)
 
@@ -344,6 +389,33 @@ def _layer(x, lp, cfg: TransformerConfig, mesh, act_spec):
         h = jnp.dot(h, lp["w2"].astype(dt))  # row-parallel (psum by XLA)
         aux = jnp.zeros((), jnp.float32)
     return c(x + h, act_spec), aux
+
+
+def _layer(x, lp, cfg: TransformerConfig, mesh, act_spec,
+           split_remat: bool = False):
+    """One pre-norm block: attn + mlp/moe, Megatron-sharded (wqkv/w1
+    column, wo/w2 row — models/sharding.py), activations re-constrained
+    after each collective-inducing matmul. Returns (x, moe_aux).
+
+    ``split_remat``: checkpoint the qkv and post blocks separately,
+    attention OUTSIDE any remat region — the flash kernel's custom_vjp
+    residuals (out, lse) then persist to the backward and its forward
+    runs exactly once (no policy can achieve this from outside the
+    kernel call; see TransformerConfig.remat_policy)."""
+    pre = partial(_qkv_block, cfg=cfg, mesh=mesh)
+    post = partial(_post_block, cfg=cfg, mesh=mesh, act_spec=act_spec)
+    if split_remat:
+        # dots policy inside each block: elementwise interiors (rope,
+        # norms, gelu) recompute, matmul outputs don't — recomputing
+        # the qkv/mlp matmuls costs more than the HBM they free
+        dots = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        pre = jax.checkpoint(pre, policy=dots)
+        post = jax.checkpoint(post, policy=dots)
+    q, k, v = pre(x, lp)
+    o = _attention(q, k, v, cfg, mesh)
+    # named so remat_policy="attn" can pin it under whole-layer remat
+    o = checkpoint_name(o, "attn_out")
+    return post(x, o, lp)
 
 
 def forward(params, tokens, cfg: TransformerConfig, mesh=None, *,
@@ -370,13 +442,36 @@ def forward(params, tokens, cfg: TransformerConfig, mesh=None, *,
 
     layer = partial(_layer, cfg=cfg, mesh=mesh, act_spec=act_spec)
     if cfg.remat:
-        layer = jax.checkpoint(layer)
+        if cfg.remat_policy == "split":
+            # remat lives INSIDE the layer (qkv + post blocks), with
+            # attention between them left un-rematted
+            layer = partial(layer, split_remat=True)
+        else:
+            cp = jax.checkpoint_policies
+            policy = {
+                "nothing": None,
+                "attn": cp.save_only_these_names("attn_out"),
+                "dots": cp.dots_with_no_batch_dims_saveable,
+                "dots_attn": cp.save_from_both_policies(
+                    cp.dots_with_no_batch_dims_saveable,
+                    cp.save_only_these_names("attn_out"),
+                ),
+            }[cfg.remat_policy]
+            layer = jax.checkpoint(layer, policy=policy)
 
-    def scan_body(h, lp):
-        h, aux = layer(h, lp)
-        return h, aux
+    if cfg.scan_layers:
+        def scan_body(h, lp):
+            h, aux = layer(h, lp)
+            return h, aux
 
-    x, auxes = lax.scan(scan_body, x, params["layers"])
+        x, auxes = lax.scan(scan_body, x, params["layers"])
+    else:
+        aux_list = []
+        for i in range(cfg.n_layers):
+            lp = jax.tree.map(lambda a: a[i], params["layers"])
+            x, aux_i = layer(x, lp)
+            aux_list.append(aux_i)
+        auxes = jnp.stack(aux_list)
     x = _rmsnorm(x, params["ln_f_scale"])
     logits = jnp.dot(x, params["lm_head"].astype(dt))
     logits = logits.astype(jnp.float32)

@@ -301,9 +301,22 @@ def _resolve(Tq, Tk, D, scale, block_q, block_k, interpret, *,
     """Resolve the shared per-call parameters (scale default, block
     fitting, interpret default). ``validate=False`` for the backward,
     whose shapes the forward already validated — the resolution logic
-    must stay common so fwd and bwd never disagree on block sizes."""
+    must stay common so fwd and bwd never disagree on block sizes.
+
+    ``block_q``/``block_k`` of None pick the defaults (512, 1024).
+    These were swept on chip at training shapes (benchmarks/RESULTS.md):
+    a standalone kernel microbench prefers (512, 512) at T=2048 by 26%,
+    but IN SITU — inside the full train step, competing with the
+    surrounding matmuls for VMEM and scheduling — (512, 1024) wins at
+    every measured shape. Trust the end-to-end number, not the
+    microbench.
+    """
     if scale is None:
         scale = 1.0 / (D ** 0.5)
+    if block_q is None:
+        block_q = 512
+    if block_k is None:
+        block_k = 1024
     block_q = _fit_block(block_q, Tq)
     block_k = _fit_block(block_k, Tk)
     if validate and (Tq % block_q or Tk % block_k):
@@ -602,8 +615,8 @@ def flash_attention(
     *,
     causal: bool = True,
     scale: float | None = None,
-    block_q: int = 512,
-    block_k: int = 1024,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ):
     """Softmax attention over (batch, seq, heads, head_dim) inputs.
@@ -675,8 +688,8 @@ def flash_attention_block(
     *,
     causal: bool = True,
     scale: float | None = None,
-    block_q: int = 512,
-    block_k: int = 1024,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ):
     """One *partial* attention: local queries ``q`` (global position

@@ -95,8 +95,8 @@ def ring_attention(
     causal: bool = False,
     scale: float | None = None,
     impl: str = "dense",
-    block_q: int = 512,
-    block_k: int = 1024,
+    block_q: int | None = None,
+    block_k: int | None = None,
 ):
     """Attention over a sequence sharded on mesh ``axis`` (rank-local; run
     inside ``shard_map``).

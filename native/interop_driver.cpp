@@ -90,7 +90,10 @@ int main(int argc, char** argv) {
   if (!pythonpath.empty()) setenv("PYTHONPATH", pythonpath.c_str(), 1);
 
   // ---- native allocation (leg 1), before any Python exists
-  float* buf = static_cast<float*>(aligned_alloc(128, n * sizeof(float)));
+  // round the byte size up to a multiple of the alignment: C11 permits
+  // aligned_alloc to fail otherwise (e.g. -n 1000 -> 4000 bytes)
+  size_t bytes = ((n * sizeof(float) + 127) / 128) * 128;
+  float* buf = static_cast<float*>(aligned_alloc(128, bytes));
   double mail[16] = {0};
   g_mail = mail;
   if (!buf) {

@@ -56,14 +56,40 @@ class TestForward:
         with pytest.raises(ValueError, match="attention"):
             TransformerConfig(attention="telepathy")
 
-    def test_remat_matches_no_remat(self):
+    # every remat policy must be a pure FLOPs/HBM trade: loss AND grads
+    # identical to the no-remat computation
+    @pytest.mark.parametrize(
+        "policy", ["nothing", "attn", "dots", "dots_attn", "split"]
+    )
+    def test_remat_matches_no_remat(self, policy):
         cfg = TransformerConfig(**TINY)
-        cfg_r = TransformerConfig(**{**TINY, "remat": True})
+        cfg_r = TransformerConfig(**{**TINY, "remat": True,
+                                     "remat_policy": policy})
         params = init_params(jax.random.PRNGKey(0), cfg)
         tokens = _tokens(jax.random.PRNGKey(1))
-        a = forward(params, tokens, cfg)
-        b = forward(params, tokens, cfg_r)
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+        a, ga = jax.value_and_grad(lambda p: loss_fn(p, tokens, cfg))(params)
+        b, gb = jax.value_and_grad(lambda p: loss_fn(p, tokens, cfg_r))(params)
+        np.testing.assert_allclose(float(a), float(b), atol=1e-6)
+        for x, y in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       atol=1e-5)
+
+    def test_bad_remat_policy(self):
+        with pytest.raises(ValueError, match="remat_policy"):
+            TransformerConfig(remat_policy="yolo")
+
+    def test_unrolled_layers_match_scan(self):
+        cfg = TransformerConfig(**TINY)
+        cfg_u = TransformerConfig(**{**TINY, "scan_layers": False})
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = _tokens(jax.random.PRNGKey(1))
+        # atol 1e-5: unrolling changes XLA's fusion order, which moves
+        # f32 logits by ~2e-6
+        np.testing.assert_allclose(
+            np.asarray(forward(params, tokens, cfg)),
+            np.asarray(forward(params, tokens, cfg_u)),
+            atol=1e-5,
+        )
 
 
 class TestShardedOracle:
