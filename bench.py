@@ -76,8 +76,23 @@ def main() -> int:
             trips, max_trips=MAX_TRIPS,
         )
 
-        t_serial = per_pass_seconds(x, "serial", trips, cal)
-        t_overlap = per_pass_seconds(x, "overlap", trips, cal)
+        # three (serial, overlap) pairs measured back to back, MEDIAN
+        # ratio wins: chip/tunnel conditions drift run to run, so the
+        # two legs of a ratio must be temporally adjacent or the
+        # speedup wobbles by several percent — and the median (unlike a
+        # max-of-ratios) cannot be inflated by a lucky noise draw
+        pairs = [
+            p for p in (
+                (per_pass_seconds(x, "serial", trips, cal),
+                 per_pass_seconds(x, "overlap", trips, cal))
+                for _ in range(5)
+            ) if min(p) > 0
+        ]
+        if pairs:
+            pairs.sort(key=lambda p: p[0] / p[1])
+            t_serial, t_overlap = pairs[len(pairs) // 2]
+        else:
+            t_serial = t_overlap = 0.0
 
     # any clamped-to-zero component means the run measured nothing usable
     degenerate = min(t_overlap, t_serial, t_dma, t_comp) <= 0

@@ -46,6 +46,10 @@ def build_parser():
                    choices=["learned", "rope"],
                    help="positional scheme: learned table or rotary (RoPE)")
     p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--fsdp", type=int, default=1,
+                   help="fully-sharded data parallelism (ZeRO-3): params/"
+                        "grads/optimizer state shard over this many "
+                        "ranks, batch shards over dp*fsdp")
     p.add_argument("--sp", type=int, default=1)
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--pp", type=int, default=1,
@@ -337,10 +341,17 @@ def run(args) -> int:
         n_layers=args.n_layers, d_ff=4 * args.d_model, max_seq=args.seq,
         attention=args.attention, remat=args.remat, n_experts=args.n_experts,
         n_kv_heads=args.n_kv_heads, pos_embed=args.pos_embed,
+        fsdp=args.fsdp > 1,
     )
     if args.pp > 1:
+        if args.fsdp > 1:
+            log.print("ERROR: --fsdp is not supported with --pp (stage "
+                      "params live inside the pipeline shard_map); use "
+                      "--fsdp with the dp/sp/tp/ep train path")
+            log.print("FAILURE")
+            return 1
         return _run_pp(args, log, cfg)
-    n_mesh = args.dp * args.sp * args.tp * args.ep
+    n_mesh = args.dp * args.sp * args.tp * args.ep * args.fsdp
     if args.attention == "flash" and args.sp > 1:
         log.print("ERROR: attention='flash' needs the sequence unsharded "
                   "(--sp 1); use ring_flash for a sharded sequence")
@@ -352,6 +363,11 @@ def run(args) -> int:
     if use_mesh:
         devices = topology.get_devices(args.backend)
         axes = {"dp": args.dp, "sp": args.sp, "tp": args.tp}
+        if args.fsdp > 1:
+            # fsdp between dp and sp: param all-gathers ride links as
+            # close as possible without stealing tp/sp's fastest ones
+            axes = {"dp": args.dp, "fsdp": args.fsdp, "sp": args.sp,
+                    "tp": args.tp}
         if args.ep > 1:
             axes["ep"] = args.ep
         mesh = topology.make_mesh(axes, devices[:n_mesh])

@@ -20,29 +20,37 @@ from hpc_patterns_tpu.models.transformer import TransformerConfig
 
 def param_specs(cfg: TransformerConfig) -> dict:
     """PartitionSpec pytree matching init_params' structure. Layer
-    weights carry a leading (unsharded) n_layers scan axis."""
+    weights carry a leading (unsharded) n_layers scan axis.
+
+    With ``cfg.fsdp``, each large weight additionally shards one of its
+    feature dims over ``axis_fsdp`` (ZeRO-3: params, grads, and optax
+    moments all live sharded; XLA all-gathers a layer's weights just
+    before use and reduce-scatters its grads — entirely from these
+    annotations). The fsdp dim is always one tp leaves unsharded, so
+    tp x fsdp compose."""
     tp = cfg.axis_tp
+    fs = cfg.axis_fsdp if cfg.fsdp else None
     layers = {
         "ln1_scale": P(None, None),
         "ln2_scale": P(None, None),
-        "wqkv": P(None, None, tp),       # column-parallel (heads split)
-        "wo": P(None, tp, None),         # row-parallel
+        "wqkv": P(None, fs, tp),         # column-parallel (heads split)
+        "wo": P(None, tp, fs),           # row-parallel
     }
     if cfg.n_experts:
         ep = cfg.axis_ep
         layers["router"] = P(None, None, None)  # replicated routing table
-        layers["w1"] = P(None, ep, None, None)  # experts over ep
-        layers["w2"] = P(None, ep, None, None)
+        layers["w1"] = P(None, ep, fs, None)    # experts over ep
+        layers["w2"] = P(None, ep, None, fs)
     else:
-        layers["w1"] = P(None, None, tp)  # column-parallel
-        layers["w2"] = P(None, tp, None)  # row-parallel
-    pos = {} if cfg.pos_embed == "rope" else {"pos_embed": P(None, None)}
+        layers["w1"] = P(None, fs, tp)   # column-parallel
+        layers["w2"] = P(None, tp, fs)   # row-parallel
+    pos = {} if cfg.pos_embed == "rope" else {"pos_embed": P(None, fs)}
     return {
-        "embed": P(None, None),          # replicated: lookup stays local
+        "embed": P(None, fs),            # lookup local; features sharded
         **pos,
         "layers": layers,
         "ln_f_scale": P(None),
-        "lm_head": P(None, tp),          # vocab-sharded logits
+        "lm_head": P(fs, tp),            # vocab-sharded logits
     }
 
 
@@ -61,7 +69,7 @@ def batch_sharding(mesh: Mesh, cfg: TransformerConfig) -> NamedSharding:
     data map, ≙ the reference's rank→device policies (devices.hpp:22-59)
     lifted to arrays."""
     return NamedSharding(
-        mesh, resolve_spec(P(cfg.axis_dp, cfg.axis_sp), mesh, cfg.mesh_axes)
+        mesh, resolve_spec(P(cfg.batch_axes, cfg.axis_sp), mesh, cfg.mesh_axes)
     )
 
 

@@ -31,6 +31,7 @@ casts to ``cfg.dtype`` (bf16 by default) at use.
 from __future__ import annotations
 
 import dataclasses
+import math
 from functools import partial
 
 import jax
@@ -105,6 +106,14 @@ class TransformerConfig:
     n_experts: int = 0
     capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # fully-sharded data parallelism (ZeRO-3 style): params, grads, and
+    # optimizer state shard over axis_fsdp; XLA inserts the per-layer
+    # all-gather (fwd/bwd) and gradient reduce-scatter from the
+    # annotations alone — GSPMD is the FSDP engine, no wrapper class.
+    # The batch shards over (dp, fsdp) together. Set axis_fsdp = "dp"
+    # to fully shard over the data ranks with a single axis.
+    fsdp: bool = False
+    axis_fsdp: str = "fsdp"
     # mesh axis names (data / sequence(context) / tensor / expert)
     axis_dp: str = "dp"
     axis_sp: str = "sp"
@@ -114,7 +123,17 @@ class TransformerConfig:
     @property
     def mesh_axes(self) -> frozenset:
         """Declared axis names — the set resolve_spec may prune."""
-        return frozenset((self.axis_dp, self.axis_sp, self.axis_tp, self.axis_ep))
+        return frozenset((self.axis_dp, self.axis_sp, self.axis_tp,
+                          self.axis_ep, self.axis_fsdp))
+
+    @property
+    def batch_axes(self) -> tuple:
+        """Mesh axes the batch dimension shards over: (dp, fsdp) under
+        FSDP (the fsdp ranks are data ranks too), else (dp,). Always a
+        tuple — PartitionSpec treats a singleton tuple as the axis."""
+        if self.fsdp and self.axis_fsdp != self.axis_dp:
+            return (self.axis_dp, self.axis_fsdp)
+        return (self.axis_dp,)
 
     @property
     def kv_heads(self) -> int:
@@ -254,7 +273,7 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
             )
         # sequence unsharded: the kernel runs per-(dp, tp) shard on the
         # full local sequence
-        spec = resolve_spec(P(cfg.axis_dp, None, cfg.axis_tp, None), mesh,
+        spec = resolve_spec(P(cfg.batch_axes, None, cfg.axis_tp, None), mesh,
                             cfg.mesh_axes)
         return jax.shard_map(
             partial(flash_attention, causal=True), mesh=mesh,
@@ -262,7 +281,7 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
         )(q, k, v)
     if cfg.attention == "full" or mesh is None:
         return full_attention(q, k, v, causal=True)
-    spec = resolve_spec(P(cfg.axis_dp, cfg.axis_sp, cfg.axis_tp, None), mesh,
+    spec = resolve_spec(P(cfg.batch_axes, cfg.axis_sp, cfg.axis_tp, None), mesh,
                         cfg.mesh_axes)
     base, _, variant = cfg.attention.partition("_")
     local_impl = variant or "dense"
@@ -286,16 +305,16 @@ def _moe_block(h, lp, cfg: TransformerConfig, mesh):
         )
         return y.reshape(B, T, D), aux
 
-    dp, sp, ep = cfg.axis_dp, cfg.axis_sp, cfg.axis_ep
-    # tokens shard over BOTH dp and ep for the MoE block: ep must
+    sp, ep = cfg.axis_sp, cfg.axis_ep
+    bx = cfg.batch_axes
+    b_size = math.prod(mesh_axis_size(mesh, ax) for ax in bx)
+    # tokens shard over the batch axes AND ep for the MoE block: ep must
     # partition the routing/FFN work, not replicate it (the reshard in
     # and out is XLA's, riding ICI). When the batch doesn't divide
-    # dp*ep, fall back to dp-only token sharding (ep still partitions
-    # the experts; routing work is then replicated across ep).
-    batch_over_ep = B % (mesh_axis_size(mesh, dp) * mesh_axis_size(mesh, ep)) == 0
-    b_shards = mesh_axis_size(mesh, dp) * (
-        mesh_axis_size(mesh, ep) if batch_over_ep else 1
-    )
+    # batch*ep, fall back to batch-only token sharding (ep still
+    # partitions the experts; routing work is then replicated across ep).
+    batch_over_ep = B % (b_size * mesh_axis_size(mesh, ep)) == 0
+    b_shards = b_size * (mesh_axis_size(mesh, ep) if batch_over_ep else 1)
     n_local = (B // b_shards) * (T // mesh_axis_size(mesh, sp))
     cap = moe.default_capacity(n_local, cfg.n_experts, cfg.capacity_factor)
 
@@ -314,15 +333,15 @@ def _moe_block(h, lp, cfg: TransformerConfig, mesh):
             )
         # moe_ep means aux over ep (as a comm axis); with tokens also
         # sharded on ep, fold every data axis for the global scalar
-        for ax in (dp, sp):
+        for ax in (*bx, sp):
             if has(ax):
                 aux = lax.pmean(aux, ax)
         return y.reshape(b, t, d), aux
 
     tok_spec = (
-        resolve_spec(P((dp, ep), sp, None), mesh, cfg.mesh_axes)
+        resolve_spec(P((*bx, ep), sp, None), mesh, cfg.mesh_axes)
         if has(ep) and batch_over_ep
-        else resolve_spec(P(dp, sp, None), mesh, cfg.mesh_axes)
+        else resolve_spec(P(cfg.batch_axes, sp, None), mesh, cfg.mesh_axes)
     )
     y, aux = jax.shard_map(
         local,
@@ -429,7 +448,7 @@ def forward(params, tokens, cfg: TransformerConfig, mesh=None, *,
     B, T = tokens.shape
     if mesh is not None:
         act_spec = jax.sharding.NamedSharding(
-            mesh, resolve_spec(P(cfg.axis_dp, cfg.axis_sp, None), mesh,
+            mesh, resolve_spec(P(cfg.batch_axes, cfg.axis_sp, None), mesh,
                                cfg.mesh_axes)
         )
     else:

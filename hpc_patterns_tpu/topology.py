@@ -59,16 +59,81 @@ def get_devices(platform: str | None = None) -> list[jax.Device]:
     return devices
 
 
+@dataclasses.dataclass(frozen=True)
+class CoreInfo:
+    """Chip/core facts for one device — the TPU analog of the
+    reference's sub-device (NUMA-tile) introspection (devices.hpp:29-38).
+
+    ``num_cores`` > 1 with one device = a megacore chip (v4/v5p: two
+    cores fused behind one device — XLA schedules across them; no
+    finer software partition exists). Multiple devices sharing
+    ``coords`` = per-core devices of one chip (v2/v3)."""
+
+    device: jax.Device
+    kind: str
+    coords: tuple | None  # chip position in the slice, if exposed
+    core_on_chip: int | None
+    num_cores: int  # cores fused behind this device (1 = plain core)
+
+    @property
+    def megacore(self) -> bool:
+        return self.num_cores > 1
+
+    @classmethod
+    def of(cls, d: jax.Device) -> "CoreInfo":
+        coords = getattr(d, "coords", None)
+        return cls(
+            device=d,
+            kind=getattr(d, "device_kind", d.platform),
+            coords=tuple(coords) if coords is not None else None,
+            core_on_chip=getattr(d, "core_on_chip", None),
+            num_cores=int(getattr(d, "num_cores", 1) or 1),
+        )
+
+
+def core_topology(
+    devices: Sequence[jax.Device] | None = None,
+) -> list[CoreInfo]:
+    """Per-device chip/core introspection (see :class:`CoreInfo`)."""
+    if devices is None:
+        devices = get_devices()
+    return [CoreInfo.of(d) for d in devices]
+
+
+def group_by_chip(
+    devices: Sequence[jax.Device] | None = None,
+) -> dict[tuple, list[jax.Device]]:
+    """Group devices by physical chip: devices sharing (process, coords)
+    are cores of one chip (v2/v3 style); one device per key means the
+    chip IS the finest unit (v5e) or a fused megacore (v4/v5p)."""
+    if devices is None:
+        devices = get_devices()
+    groups: dict[tuple, list[jax.Device]] = defaultdict(list)
+    for d in devices:
+        coords = getattr(d, "coords", None)
+        key = (
+            (d.process_index, tuple(coords))
+            if coords is not None
+            else (d.process_index, ("dev", d.id))
+        )
+        groups[key].append(d)
+    return dict(groups)
+
+
 def fission(devices: Sequence[jax.Device] | None = None) -> list[jax.Device]:
     """Expose the finest-grained compute units as devices.
 
     The reference's fission splits each GPU into NUMA tiles, falling back
     to whole GPUs when sub-devices are unsupported (devices.hpp:28-38).
-    On TPU, JAX already enumerates one device per core (a v4/v5p chip with
-    megacore shows one device; v2/v3/v5e show per-core devices), so the
-    sub-device set *is* ``jax.devices()``. This function exists to keep the
-    reference's API shape and its fallback semantics: it never fails, it
-    returns the finest partition available.
+    On TPU, JAX already enumerates the finest software-visible unit
+    (v2/v3: one device per core, grouped by chip via
+    :func:`group_by_chip`; v5e: one core per chip; v4/v5p: a megacore
+    chip is ONE device — XLA schedules across the fused cores and no
+    finer partition exists, which :func:`core_topology` reports as
+    ``megacore=True``/``num_cores=2``). So this returns the devices
+    as-is — the reference's whole-GPU fallback semantics — with the
+    sub-device structure available from the introspection helpers.
+    It never fails.
     """
     if devices is None:
         devices = get_devices()

@@ -21,6 +21,51 @@ def test_fission_never_fails():
     assert topology.fission([]) == []
 
 
+def test_core_topology_introspection():
+    infos = topology.core_topology()
+    assert len(infos) == 8
+    for info in infos:
+        assert info.num_cores >= 1
+        assert isinstance(info.kind, str)
+        # CPU devices are plain single cores, never megacore
+        assert not info.megacore
+
+    # synthetic megacore (v4/v5p-style: one device, two fused cores)
+    class _Mega:
+        platform = "tpu"
+        device_kind = "TPU v4"
+        coords = (0, 0, 0)
+        core_on_chip = 0
+        num_cores = 2
+        process_index = 0
+        id = 0
+
+    (mega,) = topology.core_topology([_Mega()])
+    assert mega.megacore and mega.num_cores == 2
+
+
+def test_group_by_chip():
+    # CPU devices expose no coords: every device is its own "chip"
+    groups = topology.group_by_chip()
+    assert len(groups) == 8
+    assert all(len(v) == 1 for v in groups.values())
+
+    # synthetic v2/v3-style chip: two per-core devices sharing coords
+    class _Core:
+        platform = "tpu"
+        process_index = 0
+
+        def __init__(self, i, core):
+            self.id = i
+            self.coords = (0, 0, 0)
+            self.core_on_chip = core
+
+    groups = topology.group_by_chip([_Core(0, 0), _Core(1, 1)])
+    assert len(groups) == 1
+    (devs,) = groups.values()
+    assert len(devs) == 2
+
+
 def test_assign_device_modulo_when_oversubscribed():
     # ranks > devices -> rank % n (devices.hpp:47)
     ds = topology.get_devices()
