@@ -42,6 +42,17 @@ def build_parser():
     p.add_argument("--attention", default="full",
                    choices=list(ATTENTION_IMPLS))
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--remat-policy", default="split",
+                   choices=["nothing", "attn", "dots", "dots_attn", "split"],
+                   help="what remat saves (see TransformerConfig; "
+                        "'split' = attention outside the remat region, "
+                        "the MFU default; 'nothing' = max memory saving "
+                        "for long context)")
+    p.add_argument("--loss-chunk", type=int, default=0, metavar="C",
+                   help="online-logsumexp cross-entropy over vocab "
+                        "chunks of C (must divide --vocab): the "
+                        "(B,T,V) f32 logits never materialize — the "
+                        "long-context memory wall remover (0 = dense)")
     p.add_argument("--pos-embed", default="learned",
                    choices=["learned", "rope"],
                    help="positional scheme: learned table or rotary (RoPE)")
@@ -322,6 +333,11 @@ def run(args) -> int:
                   "path only (the pp state lives inside the shard_map)")
         log.print("FAILURE")
         return 1
+    if args.remat_policy != "split" and not args.remat:
+        log.print("ERROR: --remat-policy has no effect without --remat "
+                  "(no checkpointing happens; all activations are saved)")
+        log.print("FAILURE")
+        return 1
     if args.accum > 1 and args.batch % args.accum:
         log.print(f"ERROR: --batch {args.batch} must divide by "
                   f"--accum {args.accum}")
@@ -341,13 +357,21 @@ def run(args) -> int:
         n_layers=args.n_layers, d_ff=4 * args.d_model, max_seq=args.seq,
         attention=args.attention, remat=args.remat, n_experts=args.n_experts,
         n_kv_heads=args.n_kv_heads, pos_embed=args.pos_embed,
-        fsdp=args.fsdp > 1,
+        fsdp=args.fsdp > 1, remat_policy=args.remat_policy,
+        loss_chunk=args.loss_chunk,
     )
     if args.pp > 1:
         if args.fsdp > 1:
             log.print("ERROR: --fsdp is not supported with --pp (stage "
                       "params live inside the pipeline shard_map); use "
                       "--fsdp with the dp/sp/tp/ep train path")
+            log.print("FAILURE")
+            return 1
+        if args.loss_chunk:
+            log.print("ERROR: --loss-chunk is not supported with --pp "
+                      "(the pipeline loss head materializes per-"
+                      "microbatch logits); use it on the dp/sp/tp/ep "
+                      "train path")
             log.print("FAILURE")
             return 1
         return _run_pp(args, log, cfg)

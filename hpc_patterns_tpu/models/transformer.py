@@ -114,6 +114,12 @@ class TransformerConfig:
     # to fully shard over the data ranks with a single axis.
     fsdp: bool = False
     axis_fsdp: str = "fsdp"
+    # chunked cross-entropy: 0 = dense (materialize (B, T, V) f32
+    # logits); > 0 = online-logsumexp over vocab chunks of this size —
+    # the logits never exist, removing the long-context memory wall
+    # (see chunked_masked_causal_nll). Must divide vocab. Training-loss
+    # path only (eval/decode read real logits).
+    loss_chunk: int = 0
     # mesh axis names (data / sequence(context) / tensor / expert)
     axis_dp: str = "dp"
     axis_sp: str = "sp"
@@ -155,6 +161,12 @@ class TransformerConfig:
         if self.attention not in ATTENTION_IMPLS:
             raise ValueError(
                 f"attention {self.attention!r} not in {ATTENTION_IMPLS}"
+            )
+        if self.loss_chunk < 0 or (self.loss_chunk and
+                                   self.vocab % self.loss_chunk):
+            raise ValueError(
+                f"loss_chunk {self.loss_chunk} must be 0 or divide "
+                f"vocab {self.vocab}"
             )
         if self.remat_policy not in ("nothing", "attn", "dots", "dots_attn",
                                      "split"):
@@ -444,6 +456,19 @@ def forward(params, tokens, cfg: TransformerConfig, mesh=None, *,
     attention; None = single-device (tests/oracle). With
     ``return_aux=True`` also returns the summed MoE load-balance loss
     (zeros for dense models)."""
+    x, aux = forward_hidden(params, tokens, cfg, mesh)
+    logits = jnp.dot(x, params["lm_head"].astype(x.dtype))
+    logits = logits.astype(jnp.float32)
+    if return_aux:
+        return logits, aux
+    return logits
+
+
+def forward_hidden(params, tokens, cfg: TransformerConfig, mesh=None):
+    """The trunk of :func:`forward` WITHOUT the LM head: final-norm
+    hidden states (B, T, d_model) in compute dtype, plus the summed MoE
+    aux. The chunked loss consumes this so the (B, T, vocab) logits are
+    never materialized."""
     dt = jnp.dtype(cfg.dtype)
     B, T = tokens.shape
     if mesh is not None:
@@ -462,8 +487,6 @@ def forward(params, tokens, cfg: TransformerConfig, mesh=None, *,
     layer = partial(_layer, cfg=cfg, mesh=mesh, act_spec=act_spec)
     if cfg.remat:
         if cfg.remat_policy == "split":
-            # remat lives INSIDE the layer (qkv + post blocks), with
-            # attention between them left un-rematted
             layer = partial(layer, split_remat=True)
         else:
             cp = jax.checkpoint_policies
@@ -479,11 +502,7 @@ def forward(params, tokens, cfg: TransformerConfig, mesh=None, *,
             layer = jax.checkpoint(layer, policy=policy)
 
     if cfg.scan_layers:
-        def scan_body(h, lp):
-            h, aux = layer(h, lp)
-            return h, aux
-
-        x, auxes = lax.scan(scan_body, x, params["layers"])
+        x, auxes = lax.scan(lambda h, lp: layer(h, lp), x, params["layers"])
     else:
         aux_list = []
         for i in range(cfg.n_layers):
@@ -491,12 +510,7 @@ def forward(params, tokens, cfg: TransformerConfig, mesh=None, *,
             x, aux_i = layer(x, lp)
             aux_list.append(aux_i)
         auxes = jnp.stack(aux_list)
-    x = _rmsnorm(x, params["ln_f_scale"])
-    logits = jnp.dot(x, params["lm_head"].astype(dt))
-    logits = logits.astype(jnp.float32)
-    if return_aux:
-        return logits, jnp.sum(auxes)
-    return logits
+    return _rmsnorm(x, params["ln_f_scale"]), jnp.sum(auxes)
 
 
 def masked_causal_nll(logits, tokens):
@@ -512,6 +526,62 @@ def masked_causal_nll(logits, tokens):
     return jnp.sum(nll * mask) / jnp.sum(mask)
 
 
+def chunked_masked_causal_nll(x, lm_head, tokens, *, chunk: int):
+    """:func:`masked_causal_nll` computed WITHOUT ever materializing the
+    (B, T, vocab) logits: a ``lax.scan`` over vocab chunks carries the
+    online logsumexp state (running max, rescaled sumexp) and picks out
+    each target's gold logit from the chunk that owns it — O(B·T·chunk)
+    live memory instead of O(B·T·V). The scan body is rematted (saves
+    only the small carry per chunk), so the backward recomputes each
+    chunk's logits and the full f32 logits never exist in either pass
+    — at long context this is THE memory wall: (B=1, T=65536, V=32768)
+    f32 logits alone are 8 GB.
+
+    ``x``: (B, T, d_model) final hidden states (forward_hidden);
+    ``lm_head``: (d_model, V) in compute dtype; ``chunk`` must divide V.
+    Numerically equal to the dense path (same f32 logit values, online
+    logsumexp association), oracle-tested.
+    """
+    B, T = tokens.shape
+    V = lm_head.shape[1]
+    if V % chunk:
+        raise ValueError(f"loss chunk {chunk} must divide vocab {V}")
+    n_chunks = V // chunk
+    targets = jnp.roll(tokens, -1, axis=1)
+    w = lm_head.reshape(lm_head.shape[0], n_chunks, chunk)
+
+    @jax.checkpoint
+    def body(carry, wc_and_idx):
+        m, s, gold = carry
+        wc, c = wc_and_idx
+        logits_c = jnp.dot(x, wc).astype(jnp.float32)  # (B, T, chunk)
+        m_c = logits_c.max(axis=-1)
+        m_new = jnp.maximum(m, m_c)
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits_c - m_new[..., None]), axis=-1
+        )
+        local = targets - c * chunk
+        in_chunk = (local >= 0) & (local < chunk)
+        picked = jnp.take_along_axis(
+            logits_c, jnp.clip(local, 0, chunk - 1)[..., None], axis=-1
+        )[..., 0]
+        gold = jnp.where(in_chunk, picked, gold)
+        return (m_new, s, gold), None
+
+    init = (
+        jnp.full((B, T), -jnp.inf, jnp.float32),
+        jnp.zeros((B, T), jnp.float32),
+        jnp.zeros((B, T), jnp.float32),
+    )
+    (m, s, gold), _ = lax.scan(
+        body, init,
+        (jnp.moveaxis(w, 1, 0), jnp.arange(n_chunks)),
+    )
+    nll = m + jnp.log(s) - gold
+    mask = (lax.broadcasted_iota(jnp.int32, (B, T), 1) < T - 1).astype(nll.dtype)
+    return jnp.sum(nll * mask) / jnp.sum(mask)
+
+
 def loss_fn(params, tokens, cfg: TransformerConfig, mesh=None):
     """Causal LM loss: predict token t+1 from prefix ≤ t (mean NLL).
 
@@ -519,8 +589,15 @@ def loss_fn(params, tokens, cfg: TransformerConfig, mesh=None):
     position is masked out of the loss — rather than slicing to seq-1 —
     so sequence shardings (seq % sp == 0) survive into the activations.
     """
-    logits, aux = forward(params, tokens, cfg, mesh, return_aux=True)
-    loss = masked_causal_nll(logits, tokens)
+    if cfg.loss_chunk:
+        x, aux = forward_hidden(params, tokens, cfg, mesh)
+        loss = chunked_masked_causal_nll(
+            x, params["lm_head"].astype(x.dtype), tokens,
+            chunk=cfg.loss_chunk,
+        )
+    else:
+        logits, aux = forward(params, tokens, cfg, mesh, return_aux=True)
+        loss = masked_causal_nll(logits, tokens)
     if cfg.n_experts:
         loss = loss + cfg.moe_aux_weight * aux
     return loss

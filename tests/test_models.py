@@ -78,6 +78,47 @@ class TestForward:
         with pytest.raises(ValueError, match="remat_policy"):
             TransformerConfig(remat_policy="yolo")
 
+    # the logits-free loss must be numerically identical to the dense
+    # path (same f32 logit values through an online logsumexp), grads
+    # included — it is a memory transform, not an approximation
+    @pytest.mark.parametrize("chunk", [8, 16, 64])
+    def test_chunked_loss_matches_dense(self, chunk):
+        cfg_d = TransformerConfig(**TINY)
+        cfg_c = TransformerConfig(**TINY, loss_chunk=chunk)
+        params = init_params(jax.random.PRNGKey(0), cfg_d)
+        tokens = _tokens(jax.random.PRNGKey(1))
+        want, gw = jax.value_and_grad(
+            lambda p: loss_fn(p, tokens, cfg_d)
+        )(params)
+        got, gc = jax.value_and_grad(
+            lambda p: loss_fn(p, tokens, cfg_c)
+        )(params)
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(gc), jax.tree.leaves(gw)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5)
+
+    def test_chunked_loss_sharded_matches_local(self, mesh_dp_sp_tp):
+        tiny = dict(TINY)
+        cfg_local = TransformerConfig(**tiny)
+        cfg_mesh = TransformerConfig(**{**tiny, "attention": "ring",
+                                        "loss_chunk": 16})
+        params = init_params(jax.random.PRNGKey(0), cfg_local)
+        tokens = _tokens(jax.random.PRNGKey(1), b=4)
+        want = loss_fn(params, tokens, cfg_local)
+
+        from hpc_patterns_tpu.models.sharding import shard_params
+
+        p_sharded = shard_params(params, mesh_dp_sp_tp, cfg_mesh)
+        got = jax.jit(
+            lambda p, tk: loss_fn(p, tk, cfg_mesh, mesh_dp_sp_tp)
+        )(p_sharded, tokens)
+        np.testing.assert_allclose(float(got), float(want), rtol=2e-5)
+
+    def test_bad_loss_chunk_rejected(self):
+        with pytest.raises(ValueError, match="loss_chunk"):
+            TransformerConfig(**TINY, loss_chunk=7)
+
     def test_unrolled_layers_match_scan(self):
         cfg = TransformerConfig(**TINY)
         cfg_u = TransformerConfig(**{**TINY, "scan_layers": False})
