@@ -57,6 +57,12 @@ def build_parser():
                    choices=["learned", "rope"],
                    help="positional scheme: learned table or rotary (RoPE)")
     p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--dcn-dp", action="store_true",
+                   help="multi-slice placement: lay the dp axis ACROSS "
+                        "TPU slices (DCN) and all other axes within one "
+                        "slice (ICI) via topology.make_hybrid_mesh; "
+                        "--dp must equal the slice count (-1 = auto, "
+                        "which on a single slice degenerates to dp=1)")
     p.add_argument("--fsdp", type=int, default=1,
                    help="fully-sharded data parallelism (ZeRO-3): params/"
                         "grads/optimizer state shard over this many "
@@ -162,6 +168,7 @@ def _train_loop(args, log, cfg, mesh, params, opt_state, step_fn, *,
     losses = []
     t_steps = []
     ckpt_path = None
+    diverged = False
     for i in range(args.steps):
         t0 = time.perf_counter()
         batch = next(batch_iter) if batch_iter is not None else tokens
@@ -170,6 +177,15 @@ def _train_loop(args, log, cfg, mesh, params, opt_state, step_fn, *,
         t_steps.append(time.perf_counter() - t0)
         losses.append(loss_val)
         log.emit(kind="step", step=i, loss=loss_val, dt_s=t_steps[-1])
+        if loss_val != loss_val or abs(loss_val) == float("inf"):
+            # failure detection: a diverged run must halt at the first
+            # bad step with a diagnostic, not burn the remaining budget
+            # training on garbage (the reference's fail-fast error()
+            # style, allreduce-mpi-sycl.cpp:79-86, applied to training)
+            log.print(f"ERROR: non-finite loss {loss_val} at step {i} — "
+                      f"halting early ({args.steps - 1 - i} steps skipped)")
+            diverged = True
+            break
 
     finite = all(l == l and abs(l) != float("inf") for l in losses)
     # a 1-step run has nothing to compare, and with --prefetch each step
@@ -177,8 +193,18 @@ def _train_loop(args, log, cfg, mesh, params, opt_state, step_fn, *,
     # progress) — finiteness is the check in those modes
     learned = args.steps < 2 or bool(prefetch) or losses[-1] < losses[0]
 
+    if diverged and (args.resume_check or args.checkpoint_dir
+                     or args.generate):
+        # never persist or decode from a NaN state: a garbage checkpoint
+        # stamped with a step count that never ran would poison later
+        # restores, and the verdict below is already FAILURE
+        log.print("note: checkpoint/resume/generate legs skipped "
+                  "(diverged state)")
+
     resume_ok = True
-    if args.resume_check:
+    if diverged:
+        pass
+    elif args.resume_check:
         from hpc_patterns_tpu.utils.checkpoint import (
             restore_checkpoint,
             save_checkpoint,
@@ -204,7 +230,9 @@ def _train_loop(args, log, cfg, mesh, params, opt_state, step_fn, *,
         log.print(f"saved {ckpt_path}")
 
     generate_ok = True
-    if args.generate and name != "train":
+    if diverged:
+        pass
+    elif args.generate and name != "train":
         log.print("note: --generate skipped (pp params are stage-local; "
                   "decode serves the unpipelined flagship)")
     elif args.generate:
@@ -374,27 +402,62 @@ def run(args) -> int:
                       "train path")
             log.print("FAILURE")
             return 1
+        if args.dcn_dp:
+            log.print("ERROR: --dcn-dp is not supported with --pp; use "
+                      "it on the dp/sp/tp/ep train path")
+            log.print("FAILURE")
+            return 1
         return _run_pp(args, log, cfg)
-    n_mesh = args.dp * args.sp * args.tp * args.ep * args.fsdp
     if args.attention == "flash" and args.sp > 1:
         log.print("ERROR: attention='flash' needs the sequence unsharded "
                   "(--sp 1); use ring_flash for a sharded sequence")
         log.print("FAILURE")
         return 1
-    # every impl except the two single-path ones needs a mesh to shard over
-    use_mesh = n_mesh > 1 or args.attention not in ("full", "flash")
     mesh = None
-    if use_mesh:
+    if args.dcn_dp:
+        # multi-slice placement: dp ACROSS slices (the gradient psum is
+        # the latency-tolerant, once-per-step collective), every other
+        # axis inside one slice so tp/sp/fsdp collectives ride ICI.
+        # Devices must be taken per slice, never as a flat prefix.
         devices = topology.get_devices(args.backend)
-        axes = {"dp": args.dp, "sp": args.sp, "tp": args.tp}
+        groups = topology.group_by_slice(devices)
+        n_slices = len(groups)
+        dp = n_slices if args.dp == -1 else args.dp
+        if dp != n_slices:
+            log.print(f"ERROR: --dcn-dp places dp across slices: --dp "
+                      f"{args.dp} != slice count {n_slices} (use -1 for "
+                      "auto)")
+            log.print("FAILURE")
+            return 1
+        ici = {"sp": args.sp, "tp": args.tp}
         if args.fsdp > 1:
-            # fsdp between dp and sp: param all-gathers ride links as
-            # close as possible without stealing tp/sp's fastest ones
-            axes = {"dp": args.dp, "fsdp": args.fsdp, "sp": args.sp,
-                    "tp": args.tp}
+            ici = {"fsdp": args.fsdp, **ici}
         if args.ep > 1:
-            axes["ep"] = args.ep
-        mesh = topology.make_mesh(axes, devices[:n_mesh])
+            ici["ep"] = args.ep
+        ici_size = args.sp * args.tp * args.ep * args.fsdp
+        picked = [d for s in sorted(groups)
+                  for d in groups[s][:ici_size]]
+        try:
+            mesh = topology.make_hybrid_mesh({"dp": dp}, ici, picked)
+        except topology.TopologyError as e:
+            log.print(f"ERROR: --dcn-dp: {e}")
+            log.print("FAILURE")
+            return 1
+    else:
+        n_mesh = args.dp * args.sp * args.tp * args.ep * args.fsdp
+        # every impl except the two single-path ones needs a mesh
+        use_mesh = n_mesh > 1 or args.attention not in ("full", "flash")
+        if use_mesh:
+            devices = topology.get_devices(args.backend)
+            axes = {"dp": args.dp, "sp": args.sp, "tp": args.tp}
+            if args.fsdp > 1:
+                # fsdp between dp and sp: param all-gathers ride links
+                # as close as possible without stealing tp/sp's fastest
+                axes = {"dp": args.dp, "fsdp": args.fsdp, "sp": args.sp,
+                        "tp": args.tp}
+            if args.ep > 1:
+                axes["ep"] = args.ep
+            mesh = topology.make_mesh(axes, devices[:n_mesh])
 
     optimizer = _make_cli_optimizer(args, log)
     if optimizer is None:

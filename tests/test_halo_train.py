@@ -101,6 +101,70 @@ class TestTrainApp:
         assert code == 0, out
         assert "1f1b" in out and "SUCCESS" in out
 
+    def test_diverged_run_halts_early_and_fails(self, capsys, tmp_path):
+        import os
+
+        from hpc_patterns_tpu.apps import train_app
+
+        code = train_app.main(
+            ["--steps", "6", "--batch", "4", "--seq", "16", "--d-model",
+             "32", "--n-layers", "1", "--n-heads", "4", "--vocab", "64",
+             "--lr", "1e30", "--checkpoint-dir", str(tmp_path)]
+        )
+        out = capsys.readouterr().out
+        assert code == 1
+        assert "non-finite loss" in out and "halting early" in out
+        assert "FAILURE" in out
+        # a diverged run must never persist its NaN state
+        assert not os.listdir(tmp_path)
+
+    @staticmethod
+    def _fake_slices(ds):
+        # argument-RESPECTING synthetic slices (a mock that ignores its
+        # devices argument would hide prefix-selection bugs)
+        def fake(devices=None):
+            devices = ds if devices is None else devices
+            out = {}
+            for d in devices:
+                out.setdefault(0 if d.id < 4 else 1, []).append(d)
+            return out
+        return fake
+
+    @pytest.mark.parametrize("dp,tp", [("2", "4"), ("-1", "2")])
+    def test_dcn_dp_mesh(self, capsys, monkeypatch, dp, tp):
+        # dp across synthetic slices, tp within one (make_hybrid_mesh);
+        # the -1/tp=2 case uses only part of each slice, so the device
+        # pick must be per-slice, never a flat prefix
+        from hpc_patterns_tpu import topology
+        from hpc_patterns_tpu.apps import train_app
+
+        monkeypatch.setattr(topology, "group_by_slice",
+                            self._fake_slices(topology.get_devices()))
+        code = train_app.main(
+            ["--steps", "2", "--batch", "4", "--seq", "16", "--d-model",
+             "32", "--n-layers", "1", "--n-heads", "4", "--vocab", "64",
+             "--dp", dp, "--tp", tp, "--dcn-dp"]
+        )
+        out = capsys.readouterr().out
+        assert code == 0, out
+        assert "SUCCESS" in out
+
+    def test_dcn_dp_guards(self, capsys):
+        from hpc_patterns_tpu.apps import train_app
+
+        # dp mismatched to the (single) slice count: clear error
+        code = train_app.main(
+            ["--steps", "1", "--batch", "2", "--seq", "16", "--d-model",
+             "32", "--n-layers", "1", "--n-heads", "4", "--vocab", "64",
+             "--dp", "2", "--tp", "4", "--dcn-dp"]
+        )
+        out = capsys.readouterr().out
+        assert code == 1 and "slice count" in out
+        # pp does not compose
+        assert train_app.main(["--pp", "2", "--dcn-dp",
+                               "--n-layers", "2"]) == 1
+        capsys.readouterr()
+
     def test_pp_rejects_tp(self, capsys):
         from hpc_patterns_tpu.apps import train_app
 
