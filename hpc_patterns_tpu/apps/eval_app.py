@@ -21,7 +21,7 @@ from hpc_patterns_tpu import topology
 from hpc_patterns_tpu.harness import RunLog, Verdict
 from hpc_patterns_tpu.harness.cli import base_parser
 from hpc_patterns_tpu.models import TransformerConfig, init_params
-from hpc_patterns_tpu.models.transformer import forward, masked_causal_nll
+from hpc_patterns_tpu.models.transformer import loss_fn
 
 
 def build_parser():
@@ -45,18 +45,28 @@ def build_parser():
     p.add_argument("--attention", default="full")
     p.add_argument("--pos-embed", default="learned",
                    choices=["learned", "rope"])
+    p.add_argument("--loss-chunk", type=int, default=0, metavar="C",
+                   help="online-logsumexp NLL over vocab chunks of C "
+                        "(must divide --vocab): the (B,T,V) f32 logits "
+                        "never materialize — evaluate long sequences at "
+                        "full vocabulary (0 = dense)")
     return p
 
 
 def run(args) -> int:
     log = RunLog(args.log, truncate=not args.log_append)
     topology.init_distributed_from_env()
-    cfg = TransformerConfig(
-        vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
-        n_layers=args.n_layers, d_ff=4 * args.d_model, max_seq=args.seq,
-        attention=args.attention, n_kv_heads=args.n_kv_heads,
-        pos_embed=args.pos_embed,
-    )
+    try:
+        cfg = TransformerConfig(
+            vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
+            n_layers=args.n_layers, d_ff=4 * args.d_model, max_seq=args.seq,
+            attention=args.attention, n_kv_heads=args.n_kv_heads,
+            pos_embed=args.pos_embed, loss_chunk=args.loss_chunk,
+        )
+    except ValueError as e:
+        log.print(f"ERROR: {e}")
+        log.print("FAILURE")
+        return 1
     params = init_params(jax.random.PRNGKey(0), cfg)
     if args.checkpoint_dir:
         from hpc_patterns_tpu.utils.checkpoint import restore_params
@@ -91,9 +101,10 @@ def run(args) -> int:
                                   seq=args.seq, vocab=cfg.vocab,
                                   steps=args.batches)
 
-    nll_fn = jax.jit(
-        lambda p, t: masked_causal_nll(forward(p, t, cfg), t)
-    )
+    # loss_fn owns the dense-vs-chunked branch (cfg.loss_chunk), so eval
+    # and train NLL semantics cannot drift; no experts here, so the MoE
+    # aux term loss_fn would add is identically zero
+    nll_fn = jax.jit(lambda p, t: loss_fn(p, t, cfg))
     nlls = [float(nll_fn(params, jnp.asarray(b))) for b in source]
     mean_nll = sum(nlls) / len(nlls)
     ppl = math.exp(mean_nll)

@@ -380,14 +380,19 @@ def run(args) -> int:
                   f"--ep {args.ep}")
         log.print("FAILURE")
         return 1
-    cfg = TransformerConfig(
-        vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
-        n_layers=args.n_layers, d_ff=4 * args.d_model, max_seq=args.seq,
-        attention=args.attention, remat=args.remat, n_experts=args.n_experts,
-        n_kv_heads=args.n_kv_heads, pos_embed=args.pos_embed,
-        fsdp=args.fsdp > 1, remat_policy=args.remat_policy,
-        loss_chunk=args.loss_chunk,
-    )
+    try:
+        cfg = TransformerConfig(
+            vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
+            n_layers=args.n_layers, d_ff=4 * args.d_model, max_seq=args.seq,
+            attention=args.attention, remat=args.remat, n_experts=args.n_experts,
+            n_kv_heads=args.n_kv_heads, pos_embed=args.pos_embed,
+            fsdp=args.fsdp > 1, remat_policy=args.remat_policy,
+            loss_chunk=args.loss_chunk,
+        )
+    except ValueError as e:
+        log.print(f"ERROR: {e}")
+        log.print("FAILURE")
+        return 1
     if args.pp > 1:
         if args.fsdp > 1:
             log.print("ERROR: --fsdp is not supported with --pp (stage "

@@ -116,6 +116,24 @@ class TestEvalApp:
         assert code == 0
         assert "perplexity" in out and "SUCCESS" in out
 
+    def test_chunked_eval_matches_dense(self, capsys):
+        # same perplexity with and without --loss-chunk (logits-free)
+        import re
+
+        from hpc_patterns_tpu.apps import eval_app
+
+        def ppl(extra):
+            code = eval_app.main(
+                ["--batches", "2", "--batch", "2", "--seq", "16",
+                 "--d-model", "32", "--n-layers", "1", "--vocab", "64"]
+                + extra
+            )
+            out = capsys.readouterr().out
+            assert code == 0, out
+            return float(re.search(r"nll (\d+\.\d+)", out).group(1))
+
+        assert abs(ppl([]) - ppl(["--loss-chunk", "16"])) < 1e-3
+
     def test_token_file_eval(self, capsys, tmp_path):
         import numpy as np
 
