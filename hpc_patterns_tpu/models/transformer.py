@@ -326,6 +326,16 @@ def _moe_block(h, lp, cfg: TransformerConfig, mesh):
     # batch*ep, fall back to batch-only token sharding (ep still
     # partitions the experts; routing work is then replicated across ep).
     batch_over_ep = B % (b_size * mesh_axis_size(mesh, ep)) == 0
+    if not batch_over_ep and mesh_axis_size(mesh, ep) > 1:
+        import warnings
+
+        warnings.warn(
+            f"moe: batch {B} does not divide batch_shards*ep "
+            f"({b_size}*{mesh_axis_size(mesh, ep)}); routing runs "
+            "replicated across ep (experts still partitioned) — pad the "
+            "batch to recover partitioned routing",
+            stacklevel=2,
+        )
     b_shards = b_size * (mesh_axis_size(mesh, ep) if batch_over_ep else 1)
     n_local = (B // b_shards) * (T // mesh_axis_size(mesh, sp))
     cap = moe.default_capacity(n_local, cfg.n_experts, cfg.capacity_factor)

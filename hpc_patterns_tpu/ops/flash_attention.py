@@ -40,6 +40,7 @@ Two public entry points:
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 
@@ -229,6 +230,68 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(ki == n_kv - 1)
     def _():
         dq_ref[:] = (dq_acc_ref[:] * scale).astype(dq_ref.dtype)
+
+
+def _fused_bwd_kernel(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref,
+                      v_ref, dk_ref, dv_ref, dqp_ref, dk_acc_ref, dv_acc_ref,
+                      *, scale: float, causal: bool, n_q: int):
+    # Fused backward: the _dkv_kernel walk — grid (B·Hkv, n_kv,
+    # group·n_q) — with ONE extra matmul per visible pair (dS·K), whose
+    # result is this pair's dQ contribution, written to its own slot of
+    # a (n_kv, B·H, Tq, D) partial slab and summed outside. This
+    # replaces the whole separate dQ pass: the two-pass backward runs 7
+    # block matmuls per visible pair (S and dP are recomputed in BOTH
+    # passes), the fused one runs 5 — the theoretical-minimum FLOP count
+    # (Dao 2023 §B) — at the cost of the slab's HBM round-trip (written
+    # in the inputs' dtype to halve it). Causality: invisible (fully
+    # future-q) steps skip compute AND the slab write; their slots are
+    # never targeted (the clamped q index map points them at the first
+    # visible block, whose own later step overwrites before flush), and
+    # the outside sum masks never-written slots analytically.
+    block_k, d = k_ref.shape
+    block_q = q_ref.shape[0]
+    j = pl.program_id(2)
+    qi = lax.rem(j, n_q)
+    q_start_g = offs_ref[0] + qi * block_q
+    k_start_g = offs_ref[1] + pl.program_id(1) * block_k
+
+    @pl.when(j == 0)
+    def _():
+        dk_acc_ref[:] = jnp.zeros((block_k, d), jnp.float32)
+        dv_acc_ref[:] = jnp.zeros((block_k, d), jnp.float32)
+
+    visible = (q_start_g + block_q - 1 >= k_start_g) if causal else True
+
+    @pl.when(visible)
+    def _():
+        q = q_ref[:]
+        do = do_ref[:]
+        k = k_ref[:]
+        v = v_ref[:]
+        lse = lse_ref[:]
+        delta = delta_ref[:]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, q_start_g, k_start_g)
+        p = jnp.exp(s - lse)
+        if causal:
+            p = jnp.where(s > _NEG_INF * 0.5, p, 0.0)
+        dv_acc_ref[:] = dv_acc_ref[:] + jnp.dot(
+            p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
+        )
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_acc_ref[:] = dk_acc_ref[:] + jnp.dot(
+            ds.astype(q.dtype).T, q, preferred_element_type=jnp.float32
+        )
+        dqp_ref[:] = (jnp.dot(
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32
+        ) * scale).astype(dqp_ref.dtype)
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _():
+        dk_ref[:] = (dk_acc_ref[:] * scale).astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc_ref[:].astype(dv_ref.dtype)
 
 
 def _dkv_kernel(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
@@ -485,18 +548,40 @@ def _forward_impl(q, k, v, offs, *, causal, scale, block_q, block_k,
     return out, (qr, kr, vr, outr, lse)
 
 
+# The fused backward materializes a (n_kv, B·H, Tq, D) partial-dQ slab;
+# above this byte budget the two-pass backward (no slab, more FLOPs) is
+# the memory-safe automatic choice. Overridable per call via ``bwd``, or
+# globally via HPCPAT_FLASH_BWD_SLAB_LIMIT (bytes; 0 forces two-pass).
+_FUSED_SLAB_LIMIT = int(
+    os.environ.get("HPCPAT_FLASH_BWD_SLAB_LIMIT", 2 << 30)
+)
+
+
 def _backward_impl(qr, kr, vr, outr, lse, offs, g, g_lse, *, causal, scale,
-                   block_q, block_k, interpret):
+                   block_q, block_k, interpret, bwd=None,
+                   block_q_bwd=None, block_k_bwd=None):
     """Shared backward. ``g``: (B, Tq, H, D) out-cotangent; ``g_lse``:
     (B, Tq, H) lse-cotangent or None. Returns (dq, dk, dv) user-layout
     (dk/dv with the narrow kv head count — the group sum happens in the
-    dkv kernel's accumulator)."""
+    dkv kernel's accumulator). ``bwd``: "fused" (single pass, 5 block
+    matmuls + partial-dQ slab), "split" (dQ pass + dK/dV pass, 7 block
+    matmuls, O(T·D) extra memory only), or None/"auto" (fused when the
+    slab fits _FUSED_SLAB_LIMIT)."""
     B, Tq, H, D = g.shape
     Tk = kr.shape[1]
     Hkv = kr.shape[0] // B
     group = H // Hkv
+    # the backward has its own block-size optimum: the fused kernel's
+    # 5-matmul body amortizes best at (1024, 1024) (measured on chip at
+    # T=8192: 135 TF/s vs 125 at the forward's (512, 1024)); callers may
+    # still pin both passes via block_q_bwd/block_k_bwd.
+    if block_q_bwd is None and block_q is None:
+        block_q_bwd = 1024
     scale, block_q, block_k, interpret = _resolve(
-        Tq, Tk, D, scale, block_q, block_k, interpret, validate=False
+        Tq, Tk, D, scale,
+        block_q if block_q_bwd is None else block_q_bwd,
+        block_k if block_k_bwd is None else block_k_bwd,
+        interpret, validate=False,
     )
 
     dor = _to_kernel_layout(g)
@@ -522,7 +607,14 @@ def _backward_impl(qr, kr, vr, outr, lse, offs, g, g_lse, *, causal, scale,
             dv = dv.reshape(B, Hkv, group, Tk, D).sum(2).reshape(-1, Tk, D)
         back = lambda x, h, t: x.reshape(B, h, t, D).transpose(0, 2, 1, 3)
         return back(dq, H, Tq), back(dk, Hkv, Tk), back(dv, Hkv, Tk)
+    if bwd not in (None, "auto", "fused", "split"):
+        raise ValueError(f"bwd {bwd!r} not in (None, 'auto', 'fused', 'split')")
     n_q = Tq // block_q
+    n_kv = Tk // block_k
+    slab_bytes = n_kv * B * H * Tq * D * jnp.dtype(qr.dtype).itemsize
+    use_fused = bwd == "fused" or (
+        bwd in (None, "auto") and slab_bytes <= _FUSED_SLAB_LIMIT
+    )
     row = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
     kv_idx = _kv_index_map(block_q, block_k, causal, H, Hkv)
     q_idx = _q_index_map(block_q, block_k, causal, n_q, H, Hkv)
@@ -536,6 +628,48 @@ def _backward_impl(qr, kr, vr, outr, lse, offs, g, g_lse, *, causal, scale,
     q_on2 = row((None, block_q, D), q_idx)
     vec_on2 = row((None, block_q, 1),
                   lambda bkv, ki, j, offs: q_idx(bkv, ki, j, offs))
+
+    if use_fused:
+        def dqp_idx(bkv, ki, j, offs):
+            r, qi, _ = q_idx(bkv, ki, j, offs)
+            return ki, r, qi, 0
+
+        dk, dv, dqp = pl.pallas_call(
+            functools.partial(_fused_bwd_kernel, scale=scale, causal=causal,
+                              n_q=n_q),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(B * Hkv, n_kv, group * n_q),
+                in_specs=[q_on2, q_on2, vec_on2, vec_on2, k_on1, k_on1],
+                out_specs=(k_on1, k_on1,
+                           row((None, None, block_q, D), dqp_idx)),
+                scratch_shapes=[
+                    pltpu.VMEM((block_k, D), jnp.float32),
+                    pltpu.VMEM((block_k, D), jnp.float32),
+                ],
+            ),
+            out_shape=(
+                jax.ShapeDtypeStruct((B * Hkv, Tk, D), kr.dtype, vma=vma),
+                jax.ShapeDtypeStruct((B * Hkv, Tk, D), vr.dtype, vma=vma),
+                jax.ShapeDtypeStruct((n_kv, B * H, Tq, D), qr.dtype,
+                                     vma=vma),
+            ),
+            interpret=interpret,
+        )(offs, qr, dor, lse, delta, kr, vr)
+        if causal:
+            # a slab slot (ki, ·, t, ·) was written iff the q block
+            # holding row t can see kv block ki; never-written slots
+            # hold whatever HBM held (possibly NaN) — select, not
+            # multiply
+            q_end_g = offs[0] + (
+                lax.iota(jnp.int32, Tq) // block_q + 1
+            ) * block_q - 1
+            k_start_g = offs[1] + lax.iota(jnp.int32, n_kv) * block_k
+            written = q_end_g[None, :] >= k_start_g[:, None]  # (n_kv, Tq)
+            dqp = jnp.where(written[:, None, :, None], dqp, 0)
+        dq = dqp.astype(jnp.float32).sum(0).astype(qr.dtype)
+        back = lambda x, h, t: x.reshape(B, h, t, D).transpose(0, 2, 1, 3)
+        return back(dq, H, Tq), back(dk, Hkv, Tk), back(dv, Hkv, Tk)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal),
@@ -581,16 +715,18 @@ def _zero_offs():
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10)
 )
-def _flash_with_vjp(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_with_vjp(q, k, v, causal, scale, block_q, block_k, interpret, bwd,
+                    block_q_bwd, block_k_bwd):
     out, _ = _forward_impl(q, k, v, _zero_offs(), causal=causal, scale=scale,
                            block_q=block_q, block_k=block_k,
                            interpret=interpret, need_lse=False)
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret, bwd,
+               block_q_bwd, block_k_bwd):
     out, residuals = _forward_impl(q, k, v, _zero_offs(), causal=causal,
                                    scale=scale, block_q=block_q,
                                    block_k=block_k, interpret=interpret,
@@ -598,11 +734,13 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     return out, residuals
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, bwd,
+               block_q_bwd, block_k_bwd, residuals, g):
     qr, kr, vr, outr, lse = residuals
     return _backward_impl(qr, kr, vr, outr, lse, _zero_offs(), g, None,
                           causal=causal, scale=scale, block_q=block_q,
-                          block_k=block_k, interpret=interpret)
+                          block_k=block_k, interpret=interpret, bwd=bwd,
+                          block_q_bwd=block_q_bwd, block_k_bwd=block_k_bwd)
 
 
 _flash_with_vjp.defvjp(_flash_fwd, _flash_bwd)
@@ -618,6 +756,9 @@ def flash_attention(
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
+    bwd: str | None = None,
+    block_q_bwd: int | None = None,
+    block_k_bwd: int | None = None,
 ):
     """Softmax attention over (batch, seq, heads, head_dim) inputs.
 
@@ -629,17 +770,18 @@ def flash_attention(
     recomputing P from the forward's saved logsumexp — O(block) VMEM in
     both directions.
     """
-    return _flash_with_vjp(q, k, v, causal, scale, block_q, block_k, interpret)
+    return _flash_with_vjp(q, k, v, causal, scale, block_q, block_k,
+                           interpret, bwd, block_q_bwd, block_k_bwd)
 
 
 # ----------------------------------------------------------------- block
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8)
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11)
 )
 def _flash_block_with_vjp(q, k, v, offs_i, causal, scale, block_q, block_k,
-                          interpret):
+                          interpret, bwd, block_q_bwd, block_k_bwd):
     offs = offs_i.reshape(2)
     out, (_, _, _, _, lse) = _forward_impl(
         q, k, v, offs, causal=causal, scale=scale, block_q=block_q,
@@ -651,7 +793,7 @@ def _flash_block_with_vjp(q, k, v, offs_i, causal, scale, block_q, block_k,
 
 
 def _flash_block_fwd(q, k, v, offs_i, causal, scale, block_q, block_k,
-                     interpret):
+                     interpret, bwd, block_q_bwd, block_k_bwd):
     offs = offs_i.reshape(2)
     out, residuals = _forward_impl(
         q, k, v, offs, causal=causal, scale=scale, block_q=block_q,
@@ -663,13 +805,14 @@ def _flash_block_fwd(q, k, v, offs_i, causal, scale, block_q, block_k,
     return (out, lse_user), (*residuals, offs)
 
 
-def _flash_block_bwd(causal, scale, block_q, block_k, interpret,
-                     residuals, g):
+def _flash_block_bwd(causal, scale, block_q, block_k, interpret, bwd,
+                     block_q_bwd, block_k_bwd, residuals, g):
     qr, kr, vr, outr, lse, offs = residuals
     g_out, g_lse = g
     dq, dk, dv = _backward_impl(
         qr, kr, vr, outr, lse, offs, g_out, g_lse, causal=causal,
         scale=scale, block_q=block_q, block_k=block_k, interpret=interpret,
+        bwd=bwd, block_q_bwd=block_q_bwd, block_k_bwd=block_k_bwd,
     )
     # offsets are integer positions: their cotangent is the symbolic
     # float0 zero (also exempt from shard_map's varying-axes check)
@@ -691,6 +834,9 @@ def flash_attention_block(
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
+    bwd: str | None = None,
+    block_q_bwd: int | None = None,
+    block_k_bwd: int | None = None,
 ):
     """One *partial* attention: local queries ``q`` (global position
     ``q_offset``) against one visiting K/V block (global position
@@ -716,4 +862,5 @@ def flash_attention_block(
         jnp.asarray(q_offset, jnp.int32), jnp.asarray(k_offset, jnp.int32)
     ])
     return _flash_block_with_vjp(q, k, v, offs_i, causal, scale, block_q,
-                                 block_k, interpret)
+                                 block_k, interpret, bwd,
+                                 block_q_bwd, block_k_bwd)

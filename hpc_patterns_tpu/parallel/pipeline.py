@@ -73,6 +73,13 @@ def pipeline_forward(
         stage_fn, stage_params,
         jax.ShapeDtypeStruct(mb_shape, x_microbatches.dtype),
     )
+    if not hasattr(y_shape, "shape"):
+        raise ValueError(
+            "stage_fn must return a single activation array; got a "
+            f"{type(y_shape).__name__} — aux-returning (MoE) stages are "
+            "only supported by pipeline_train_1f1b, which threads the "
+            "aux through the backward"
+        )
     if y_shape.shape != mb_shape or y_shape.dtype != x_microbatches.dtype:
         raise ValueError(
             f"stage_fn must preserve microbatch shape/dtype: "

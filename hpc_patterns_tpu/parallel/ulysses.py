@@ -61,9 +61,22 @@ def ulysses_attention(
             f"kv heads {Hkv}/{v.shape[2]} must match and divide heads {H}"
         )
     if Hkv != H and Hkv % size:
-        # can't scatter partial kv heads: fall back to expanded K/V
+        # can't scatter partial kv heads: fall back to expanded K/V.
+        # Loud (once per trace): the config silently paying group-factor
+        # more exchange traffic is exactly what a user tuning GQA+SP
+        # wants to know — use kv_heads % axis_size == 0 to keep the
+        # narrow path.
+        import warnings
+
         import jax.numpy as jnp
 
+        warnings.warn(
+            f"ulysses: axis size {size} does not divide kv_heads={Hkv}; "
+            f"expanding K/V to {H} heads for the all-to-all (narrow-K/V "
+            "exchange saving lost) — make kv_heads a multiple of the "
+            "sp axis size to keep the narrow path",
+            stacklevel=2,
+        )
         k = jnp.repeat(k, H // Hkv, axis=2)
         v = jnp.repeat(v, H // Hkv, axis=2)
 

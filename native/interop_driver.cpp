@@ -109,7 +109,7 @@ int main(int argc, char** argv) {
   Py_Initialize();
   char setup[2048];
   std::snprintf(setup, sizeof(setup),
-                "import ctypes, numpy as np\n"
+                "import ctypes, struct, numpy as np\n"
                 "import jax, jax.numpy as jnp\n"
                 "N = %ld\n"
                 "BUF = 0x%llx\n"
@@ -152,15 +152,22 @@ int main(int argc, char** argv) {
           "try:\n"
           "    a = jnp.full((N,), 2.0, jnp.float32)\n"
           "    jax.block_until_ready(a)\n"
-          "    mail[2] = float(a.addressable_shards[0].data"
-          ".unsafe_buffer_pointer())\n"
+          "    leg2_ptr = a.addressable_shards[0].data"
+          ".unsafe_buffer_pointer()\n"
+          // the address crosses the mailbox as its exact uint64 BIT
+          // pattern (a double-rounded address >= 2^53 would lose low
+          // bits and turn the native re-read into a wild dereference)
+          "    mail[2] = struct.unpack('<d', struct.pack('<Q',"
+          " leg2_ptr))[0]\n"
           "    mail[3] = 1.0\n"
           "except Exception as e:\n"
           "    print('leg2a error:', e)\n"
           "    mail[15] = 1.0\n"))
     return 1;
+  uint64_t leg2_bits = 0;
+  std::memcpy(&leg2_bits, &mail[2], sizeof(leg2_bits));
   const float* xla_mem =
-      reinterpret_cast<const float*>(static_cast<uintptr_t>(mail[2]));
+      reinterpret_cast<const float*>(static_cast<uintptr_t>(leg2_bits));
   for (long i = 0; i < n; ++i) {
     if (xla_mem[i] != 2.0f) {
       std::fprintf(stderr, "FAILURE: leg2 pre-read [%ld]=%f != 2\n", i,
@@ -176,7 +183,7 @@ int main(int argc, char** argv) {
           "    jax.block_until_ready(out)\n"
           "    optr = out.addressable_shards[0].data"
           ".unsafe_buffer_pointer()\n"
-          "    mail[4] = 1.0 if optr == int(mail[2]) else 0.0\n"
+          "    mail[4] = 1.0 if optr == leg2_ptr else 0.0\n"
           "except Exception as e:\n"
           "    print('leg2b error:', e)\n"
           "    mail[15] = 1.0\n"))

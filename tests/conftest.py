@@ -35,6 +35,8 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+import re
+
 import pytest  # noqa: E402
 
 
@@ -50,7 +52,11 @@ def pytest_collection_modifyitems(config, items):
     # two-tier suite: `pytest -q` = fast tier (< 5 min on the 8-device
     # CPU mesh); `pytest -q --slow` (or `-m slow`) adds the rest. CI
     # runs both: `pytest -q && pytest -q -m slow`.
-    if config.getoption("--slow") or "slow" in (config.getoption("-m") or ""):
+    # word-boundary match: `-m slow` (and expressions containing the
+    # bare marker) disable the skip, but `-m "not slow"` and custom
+    # markers merely containing the substring don't
+    markexpr = config.getoption("-m") or ""
+    if config.getoption("--slow") or re.search(r"(?<!not )\bslow\b", markexpr):
         return
     skip = pytest.mark.skip(reason="slow tier (run with --slow or -m slow)")
     for item in items:

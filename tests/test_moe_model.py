@@ -57,3 +57,34 @@ class TestMoEModel:
         params, _ = init_train_state(jax.random.PRNGKey(0), cfg, mesh)
         spec = params["layers"]["w1"].sharding.spec
         assert spec == jax.sharding.PartitionSpec(None, "ep", None, None)
+
+
+class TestRoutingShardingTelemetry:
+    def test_partitioned_routing_is_silent(self):
+        # flagship-shaped config: batch divides batch_shards*ep, so
+        # routing work partitions over ep — no fallback warning allowed
+        import warnings
+
+        cfg = TransformerConfig(**{**MOE_TINY, "capacity_factor": 8.0})
+        mesh = topology.make_mesh({"dp": 2, "ep": 2}, jax.devices()[:4])
+        from hpc_patterns_tpu.models.sharding import shard_params
+
+        params = shard_params(init_params(jax.random.PRNGKey(0), cfg), mesh, cfg)
+        tokens = make_batch(jax.random.PRNGKey(1), cfg, 4, 16, mesh)
+        with warnings.catch_warnings():
+            warnings.filterwarnings("error", message=".*routing runs replicated.*")
+            loss = float(jax.jit(lambda p, t: loss_fn(p, t, cfg, mesh))(params, tokens))
+        assert np.isfinite(loss)
+
+    def test_replicated_routing_warns(self):
+        # batch 2 cannot split over dp*ep = 4 token shards: routing
+        # replicates across ep and must SAY so
+        cfg = TransformerConfig(**{**MOE_TINY, "capacity_factor": 8.0})
+        mesh = topology.make_mesh({"dp": 2, "ep": 2}, jax.devices()[:4])
+        from hpc_patterns_tpu.models.sharding import shard_params
+
+        params = shard_params(init_params(jax.random.PRNGKey(0), cfg), mesh, cfg)
+        tokens = make_batch(jax.random.PRNGKey(1), cfg, 2, 16, mesh)
+        with pytest.warns(UserWarning, match="routing runs replicated"):
+            loss = float(jax.jit(lambda p, t: loss_fn(p, t, cfg, mesh))(params, tokens))
+        assert np.isfinite(loss)

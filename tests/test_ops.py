@@ -106,8 +106,9 @@ class TestFlashAttention:
         assert np.all(np.asarray(o_b) == 0)
         assert np.all(np.asarray(lse_b) < -1e29)
 
+    @pytest.mark.parametrize("bwd", ["fused", "split"])
     @pytest.mark.parametrize("causal", [True, False])
-    def test_gqa_narrow_kv_matches_expanded(self, causal):
+    def test_gqa_narrow_kv_matches_expanded(self, causal, bwd):
         # K/V with fewer heads stream through the kernel index maps;
         # result and grads must equal the expanded-K/V oracle, with
         # dk/dv returned narrow (the group sum in the kernel
@@ -123,7 +124,8 @@ class TestFlashAttention:
                                    atol=2e-5)
         g1 = jax.grad(
             lambda q, k, v: flash_attention(q, k, v, causal=causal,
-                                            block_q=32, block_k=32).sum(),
+                                            block_q=32, block_k=32,
+                                            bwd=bwd).sum(),
             argnums=(0, 1, 2),
         )(q, k, v)
         g2 = jax.grad(
@@ -136,18 +138,27 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-5)
 
+    def test_bad_bwd_rejected(self):
+        q, k, v = _qkv(jax.random.PRNGKey(9), B=1, T=32, H=2, D=16)
+        with pytest.raises(ValueError, match="bwd"):
+            jax.grad(lambda q: flash_attention(q, k, v, bwd="fuse").sum())(q)
+
     def test_mismatched_kv_heads_rejected(self):
         q, k, v = _qkv(jax.random.PRNGKey(9), B=1, T=32, H=4, D=16)
         with pytest.raises(ValueError, match="kv heads"):
             flash_attention(q, k[:, :, :3], v[:, :, :3])
 
+    @pytest.mark.parametrize("bwd", ["fused", "split"])
     @pytest.mark.parametrize("causal", [True, False])
-    def test_grad_matches_oracle(self, causal):
+    def test_grad_matches_oracle(self, causal, bwd):
+        # both backward impls stay oracle-exact: the auto heuristic picks
+        # fused at every test-scale shape, so "split" (the memory-safe
+        # big-model fallback) must be pinned here or it loses coverage
         q, k, v = _qkv(jax.random.PRNGKey(3), B=1, T=64, H=2, D=16)
 
         def loss_flash(q, k, v):
             return flash_attention(q, k, v, causal=causal,
-                                   block_q=32, block_k=32).sum()
+                                   block_q=32, block_k=32, bwd=bwd).sum()
 
         def loss_dense(q, k, v):
             return full_attention(q, k, v, causal=causal).sum()

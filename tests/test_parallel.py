@@ -2,6 +2,8 @@
 single-device oracle (the analytic-validation style of SURVEY.md §4.2),
 run as 8-way SPMD on the CPU mesh (conftest.py)."""
 
+import warnings
+
 import numpy as np
 import pytest
 
@@ -222,27 +224,36 @@ class TestGQANarrowKV:
     @pytest.mark.parametrize("impl", ["dense", "flash"])
     def test_ulysses_narrow_kv_scatter(self, mesh8, impl):
         # kv_heads divides the axis: the narrow K/V ride the all-to-alls
+        # — and do so SILENTLY (a warning here would mean the expansion
+        # fallback stole the narrow-K/V win from a conforming config)
         q, k, v = self._gqa_qkv(jax.random.PRNGKey(13), hkv=8, h=16)
-        got = _shmap_seq(
-            mesh8,
-            lambda q, k, v: parallel.ulysses_attention(
-                q, k, v, "x", causal=True, impl=impl
-            ),
-            q, k, v,
-        )
+        with warnings.catch_warnings():
+            warnings.filterwarnings("error", message=".*expanding K/V.*")
+            got = _shmap_seq(
+                mesh8,
+                lambda q, k, v: parallel.ulysses_attention(
+                    q, k, v, "x", causal=True, impl=impl
+                ),
+                q, k, v,
+            )
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(self._want(q, k, v)), atol=2e-5
         )
 
     @pytest.mark.slow  # expansion fallback = pre-GQA path, stable
     def test_ulysses_narrow_kv_fallback(self, mesh8):
-        # kv_heads does NOT divide the axis: expansion fallback, same math
+        # kv_heads does NOT divide the axis: expansion fallback, same
+        # math, and LOUD — the lost narrow-K/V exchange saving must not
+        # be silent
         q, k, v = self._gqa_qkv(jax.random.PRNGKey(14), hkv=2)
-        got = _shmap_seq(
-            mesh8,
-            lambda q, k, v: parallel.ulysses_attention(q, k, v, "x", causal=True),
-            q, k, v,
-        )
+        with pytest.warns(UserWarning, match="expanding K/V"):
+            got = _shmap_seq(
+                mesh8,
+                lambda q, k, v: parallel.ulysses_attention(
+                    q, k, v, "x", causal=True
+                ),
+                q, k, v,
+            )
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(self._want(q, k, v)), atol=2e-5
         )
