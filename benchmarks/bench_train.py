@@ -47,6 +47,8 @@ def main():
         attention=arg("attn", "flash" if on_tpu else "full", str),
         remat=bool(arg("remat", 0, int)),
         n_kv_heads=arg("kv", 0, int),
+        loss_chunk=arg("chunk", 0, int),
+        remat_policy=arg("rp", "split", str),
     )
     batch = arg("batch", 8 if on_tpu else 2, int)
     seq = cfg.max_seq
@@ -108,7 +110,8 @@ def main():
     flops_tok = 6 * n_params + 12 * cfg.n_layers * seq * cfg.d_model * 0.5
     print(f"config: d={cfg.d_model} L={cfg.n_layers} H={cfg.n_heads} "
           f"ff={cfg.d_ff} T={seq} B={batch} attn={cfg.attention} "
-          f"remat={cfg.remat} offload={offload} params={n_params/1e6:.1f}M")
+          f"remat={cfg.remat}/{cfg.remat_policy} chunk={cfg.loss_chunk} "
+          f"offload={offload} params={n_params/1e6:.1f}M")
     print(f"step: {t_step*1e3:.2f} ms  throughput: "
           f"{tok_per_step/t_step:,.0f} tok/s  "
           f"model flops util: {flops_tok*tok_per_step/t_step/1e12:.1f} TF/s")

@@ -45,6 +45,12 @@ def build_parser():
     p.add_argument("--cpu-devices-per-proc", type=int, default=2,
                    help="virtual CPU devices per process "
                         "(xla_force_host_platform_device_count)")
+    p.add_argument("--slices", type=int, default=0,
+                   help="treat the processes as this many equal TPU "
+                        "slices (sets HPCPAT_SLICE_GROUPING so "
+                        "group_by_slice/--dcn-dp see an N-slice system "
+                        "whose DCN axis crosses real process "
+                        "boundaries); 0 = no slice override")
     p.add_argument("--port", type=int, default=0,
                    help="coordinator port (0 = pick a free one)")
     p.add_argument("--timeout", type=float, default=600.0,
@@ -61,11 +67,16 @@ def _free_port() -> int:
 
 
 def _child_env(base: dict, coord: str, nprocs: int, pid: int,
-               cpu_devices: int) -> dict:
+               cpu_devices: int, slices: int = 0) -> dict:
     env = topology.cpu_worker_env(base, cpu_devices)
     env[topology.ENV_COORDINATOR] = coord
     env[topology.ENV_NUM_PROCESSES] = str(nprocs)
     env[topology.ENV_PROCESS_ID] = str(pid)
+    if slices:
+        # contiguous equal groups of processes per slice; the SAME value
+        # goes to every child so each computes the identical grouping
+        mapping = ",".join(str(q * slices // nprocs) for q in range(nprocs))
+        env[topology.ENV_SLICE_GROUPING] = "process:" + mapping
     # children must resolve `-m hpc_patterns_tpu...` regardless of cwd
     pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
@@ -91,13 +102,16 @@ def run(args) -> int:
     if nprocs < 1:
         print("ERROR: -np must be >= 1")
         return 2
+    if args.slices and nprocs % args.slices:
+        print(f"ERROR: -np {nprocs} must divide by --slices {args.slices}")
+        return 2
     coord = f"127.0.0.1:{args.port or _free_port()}"
     procs, pumps = [], []
     for pid in range(nprocs):
         proc = subprocess.Popen(
             cmd,
             env=_child_env(os.environ, coord, nprocs, pid,
-                           args.cpu_devices_per_proc),
+                           args.cpu_devices_per_proc, args.slices),
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import sys
 import time
+from functools import partial
 
 import jax
 
@@ -79,6 +80,9 @@ def build_parser():
                    help="expert-parallel axis (requires --n-experts)")
     p.add_argument("--n-experts", type=int, default=0,
                    help="MoE experts per layer (0 = dense MLP)")
+    p.add_argument("--n-experts-top-k", type=int, default=1,
+                   help="experts consulted per token (1 = Switch top-1; "
+                        "k>=2 = normalized top-k gates, GShard style)")
     p.add_argument("--prefetch", type=int, default=0, metavar="DEPTH",
                    help="stream fresh synthetic batches through the async "
                         "prefetch loader (0 = one static batch)")
@@ -169,6 +173,13 @@ def _train_loop(args, log, cfg, mesh, params, opt_state, step_fn, *,
     t_steps = []
     ckpt_path = None
     diverged = False
+    drop_rates_fn = None
+    if cfg.n_experts and args.pp <= 1:
+        # routing-drop telemetry: built ONCE (a fresh jit wrapper per
+        # step would re-trace the whole forward every step)
+        from hpc_patterns_tpu.models.transformer import moe_drop_rates
+
+        drop_rates_fn = jax.jit(partial(moe_drop_rates, cfg=cfg, mesh=mesh))
     for i in range(args.steps):
         t0 = time.perf_counter()
         batch = next(batch_iter) if batch_iter is not None else tokens
@@ -176,7 +187,15 @@ def _train_loop(args, log, cfg, mesh, params, opt_state, step_fn, *,
         loss_val = float(loss)  # blocks: readback is the completion fence
         t_steps.append(time.perf_counter() - t0)
         losses.append(loss_val)
-        log.emit(kind="step", step=i, loss=loss_val, dt_s=t_steps[-1])
+        extra = {}
+        if drop_rates_fn is not None:
+            # capacity drops during training are otherwise invisible
+            # (they surface only as quality loss): one diagnostic
+            # forward on this step's batch
+            drops = drop_rates_fn(params, batch)
+            extra["moe_drop_rate"] = round(float(drops.max()), 4)
+        log.emit(kind="step", step=i, loss=loss_val, dt_s=t_steps[-1],
+                 **extra)
         if loss_val != loss_val or abs(loss_val) == float("inf"):
             # failure detection: a diverged run must halt at the first
             # bad step with a diagnostic, not burn the remaining budget
@@ -385,6 +404,7 @@ def run(args) -> int:
             vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
             n_layers=args.n_layers, d_ff=4 * args.d_model, max_seq=args.seq,
             attention=args.attention, remat=args.remat, n_experts=args.n_experts,
+            n_experts_top_k=args.n_experts_top_k,
             n_kv_heads=args.n_kv_heads, pos_embed=args.pos_embed,
             fsdp=args.fsdp > 1, remat_policy=args.remat_policy,
             loss_chunk=args.loss_chunk,
@@ -398,13 +418,6 @@ def run(args) -> int:
             log.print("ERROR: --fsdp is not supported with --pp (stage "
                       "params live inside the pipeline shard_map); use "
                       "--fsdp with the dp/sp/tp/ep train path")
-            log.print("FAILURE")
-            return 1
-        if args.loss_chunk:
-            log.print("ERROR: --loss-chunk is not supported with --pp "
-                      "(the pipeline loss head materializes per-"
-                      "microbatch logits); use it on the dp/sp/tp/ep "
-                      "train path")
             log.print("FAILURE")
             return 1
         if args.dcn_dp:

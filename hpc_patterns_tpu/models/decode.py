@@ -8,17 +8,23 @@ compilation for the whole generation (the XLA ground rule).
 
 TPU-shaped choices:
 
-- the cache is (layers, batch, max_len, kv_heads, head_dim) in the
-  compute dtype, written in place with ``dynamic_update_slice`` under a
-  donated jit — steady-state HBM traffic is the cache read, not a
+- the cache is (layers, batch, kv_heads, max_len, head_dim) in the
+  compute dtype — KERNEL layout, sequence contiguous per (batch, kv
+  head) row — written in place with ``dynamic_update_slice`` under a
+  donated jit; steady-state HBM traffic is the cache read, not a
   re-materialization;
 - grouped-query attention pays off here: the cache stores ``kv_heads``
   (not ``n_heads``) heads, and decode attends with GROUPED queries
   against the unexpanded cache — both the memory and the per-step
   bandwidth saving GQA exists for;
-- decode attention is one (B, kv_heads, group, S) masked score block
-  per step against the streamed cache; position masking replaces
-  slicing so shapes stay static;
+- decode attention is the flash-decode Pallas kernel by default
+  (ops/flash_decode.py): one streamed pass over the cache whose HBM
+  traffic is proportional to the fill POSITION (blocks past it are
+  never fetched — clamped index map), vs the XLA gather path that
+  reads all of max_len and masks. ``cfg.decode_attn = "gather"`` keeps
+  the einsum path: position masking over the full cache, static
+  shapes — the partitioning-friendly form sharded (tp) serving needs
+  (GSPMD splits einsums; it cannot split a pallas_call);
 - MoE decode routes drop-free (capacity = token count): training-time
   capacity drops are load-balance pressure over B·T competing tokens,
   which a decode step doesn't have — and serving must never drop a
@@ -47,12 +53,25 @@ from hpc_patterns_tpu.parallel.ring_attention import full_attention
 
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
-    """Zeroed KV cache: {"k","v"}: (L, B, max_len, kv_heads, head_dim)
-    in the compute dtype, plus the fill length. GQA stores kv_heads
-    only — the cache is n_heads/kv_heads times smaller than MHA's."""
+    """Zeroed KV cache: {"k","v"}: PER-LAYER tuples of (B, kv_heads,
+    max_len, head_dim) in the compute dtype (kernel layout: the
+    sequence axis contiguous per (batch, kv head) row, what
+    ops/flash_decode.py streams). Per-layer arrays — not one stacked
+    (L, ...) block — so each decode step's dynamic_update_slice aliases
+    its own buffer inside the generation scan's carry: the step's HBM
+    traffic is the attention read plus one row write, NOT a rewrite of
+    the whole cache (a stacked cache driven through a layer lax.scan
+    re-materializes every byte every token — measured 25 ms/token at an
+    8k cache where the read cost is ~3 ms). GQA stores kv_heads only —
+    the cache is n_heads/kv_heads times smaller than MHA's."""
     dt = jnp.dtype(cfg.dtype)
-    shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
-    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    shape = (batch, cfg.kv_heads, max_len, cfg.head_dim)
+    # independent buffers per key AND per layer: sharing one zeros tuple
+    # would alias k and v, and a donated jit would then double-donate
+    # each buffer (silent copy fallback — exactly the in-place update
+    # this layout exists for)
+    fresh = lambda: tuple(jnp.zeros(shape, dt) for _ in range(cfg.n_layers))
+    return {"k": fresh(), "v": fresh()}
 
 
 def _mlp(x, lp, cfg: TransformerConfig):
@@ -69,8 +88,12 @@ def _mlp(x, lp, cfg: TransformerConfig):
         # a decode step has no such competition, so drop-free routing is
         # both the correct serving semantic and what makes incremental
         # decode equal a drop-free full forward (test_decode's oracle).
+        # capacity = token count stays drop-free for ANY k: a token's k
+        # choices hit k DISTINCT experts, so no expert can be assigned
+        # more than N tokens
         y, _ = moe.moe_dense(flat, lp["router"], lp["w1"], lp["w2"],
-                             capacity=flat.shape[0])
+                             capacity=flat.shape[0],
+                             top_k=cfg.n_experts_top_k)
         return x + y.reshape(*lead, D).astype(dt)
     h = jax.nn.gelu(jnp.dot(h, lp["w1"].astype(dt)))
     return x + jnp.dot(h, lp["w2"].astype(dt))
@@ -104,19 +127,34 @@ def prefill(params, prompt, cfg: TransformerConfig, max_len: int):
             pos = jnp.arange(T, dtype=jnp.int32)
             q = apply_rope(q, pos, cfg)
             k = apply_rope(k, pos, cfg)
-        # full_attention consumes the narrow GQA K/V directly (grouped-
-        # query scores; no expanded HBM copy)
-        o = full_attention(q, k, v, causal=True)
+        # long prompts go through the flash kernel (the dense oracle
+        # materializes the (T, T) scores — an 8k-token prompt would be
+        # a 17 GB allocation at B=8); short/ragged prompts and sharded
+        # (gather-mode) serving keep the einsum path, which consumes
+        # the narrow GQA K/V directly
+        if cfg.decode_attn == "flash" and T % 128 == 0:
+            from hpc_patterns_tpu.ops import flash_attention
+
+            o = flash_attention(q, k, v, causal=True)
+        else:
+            o = full_attention(q, k, v, causal=True)
         o = jnp.dot(o.reshape(B, T, cfg.d_model), lp["wo"].astype(dt))
         h = _mlp(h + o.astype(dt), lp, cfg)
-        # pad the captured K/V out to the static cache length
-        pad = [(0, 0), (0, max_len - T), (0, 0), (0, 0)]
-        return h, (jnp.pad(k, pad).astype(dt), jnp.pad(v, pad).astype(dt))
+        # capture in kernel layout (B, Hkv, T, D), padded to the static
+        # cache length — one transpose at prefill, zero per decode step
+        kc = jnp.einsum("bthd->bhtd", k)
+        vc = jnp.einsum("bthd->bhtd", v)
+        pad = [(0, 0), (0, 0), (0, max_len - T), (0, 0)]
+        return h, (jnp.pad(kc, pad).astype(dt), jnp.pad(vc, pad).astype(dt))
 
     x, (ks, vs) = lax.scan(body, x, params["layers"])
     x = _rmsnorm(x, params["ln_f_scale"])
     logits = jnp.dot(x[:, -1], params["lm_head"].astype(dt))
-    return logits.astype(jnp.float32), {"k": ks, "v": vs}
+    L = cfg.n_layers
+    return logits.astype(jnp.float32), {
+        "k": tuple(ks[l] for l in range(L)),
+        "v": tuple(vs[l] for l in range(L)),
+    }
 
 
 def decode_step(params, cache, pos, tokens, cfg: TransformerConfig):
@@ -135,8 +173,7 @@ def decode_step(params, cache, pos, tokens, cfg: TransformerConfig):
 
     Hkv, g, Dh = cfg.kv_heads, cfg.n_heads // cfg.kv_heads, cfg.head_dim
 
-    def body(h, layer_in):
-        lp, k_cache, v_cache = layer_in
+    def body(h, lp, k_cache, v_cache):
         hn = _rmsnorm(h, lp["ln1_scale"])
         q, k_new, v_new = project_qkv(hn, lp, cfg)  # (B, H/Hkv, Dh)
         if cfg.pos_embed == "rope":
@@ -146,34 +183,58 @@ def decode_step(params, cache, pos, tokens, cfg: TransformerConfig):
             q = apply_rope(q, pos, cfg)
             k_new = apply_rope(k_new, pos, cfg)
         k_cache = lax.dynamic_update_slice(
-            k_cache, k_new[:, None].astype(dt), (0, pos, 0, 0)
+            k_cache, k_new[:, :, None].astype(dt), (0, 0, pos, 0)
         )
         v_cache = lax.dynamic_update_slice(
-            v_cache, v_new[:, None].astype(dt), (0, pos, 0, 0)
+            v_cache, v_new[:, :, None].astype(dt), (0, 0, pos, 0)
         )
         # GQA grouped attention against the UNEXPANDED cache: q head
         # k*g+j (project_qkv's order) reads kv head k directly — no
         # materialized n_heads-wide repeat of the cache, so the per-step
         # HBM traffic is the kv_heads-narrow cache read, which is the
         # saving GQA exists for
-        qg = q.reshape(B, Hkv, g, Dh)
-        s = jnp.einsum(
-            "bkgd,bskd->bkgs", qg.astype(jnp.float32),
-            k_cache.astype(jnp.float32),
-        ) * scale
-        visible = lax.broadcasted_iota(jnp.int32, s.shape, 3) <= pos
-        s = jnp.where(visible, s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bkgs,bskd->bkgd", p, v_cache.astype(jnp.float32))
+        if cfg.decode_attn == "flash":
+            from hpc_patterns_tpu.ops.flash_decode import (
+                flash_decode_attention,
+            )
+
+            o = flash_decode_attention(q, k_cache, v_cache, pos,
+                                       scale=scale)
+        else:
+            # precision=HIGHEST: a TPU f32 einsum at default precision
+            # rounds its inputs to bf16 on the MXU; true f32 here both
+            # matches the flash kernel's f32 math (greedy tokens agree
+            # across impls) and is free — the step is cache-read-bound
+            qg = q.reshape(B, Hkv, g, Dh)
+            s = jnp.einsum(
+                "bkgd,bksd->bkgs", qg.astype(jnp.float32),
+                k_cache.astype(jnp.float32),
+                precision=lax.Precision.HIGHEST,
+            ) * scale
+            visible = lax.broadcasted_iota(jnp.int32, s.shape, 3) <= pos
+            s = jnp.where(visible, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bkgs,bksd->bkgd", p,
+                           v_cache.astype(jnp.float32),
+                           precision=lax.Precision.HIGHEST)
         o = jnp.dot(o.reshape(B, cfg.d_model).astype(dt),
                     lp["wo"].astype(dt))
         h = _mlp(h + o, lp, cfg)
         return h, (k_cache, v_cache)
 
-    x, (ks, vs) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    # UNROLLED layer loop (static per-layer param slices fuse; a lax.scan
+    # here would stack the updated caches into a fresh (L, ...) block —
+    # a full cache rewrite per token): each layer's cache buffer aliases
+    # through the generation scan's carry, so the update is in place
+    ks, vs = [], []
+    for l in range(cfg.n_layers):
+        lp = jax.tree.map(lambda a: a[l], params["layers"])
+        x, (k_l, v_l) = body(x, lp, cache["k"][l], cache["v"][l])
+        ks.append(k_l)
+        vs.append(v_l)
     x = _rmsnorm(x, params["ln_f_scale"])
     logits = jnp.dot(x, params["lm_head"].astype(dt))
-    return logits.astype(jnp.float32), {"k": ks, "v": vs}
+    return logits.astype(jnp.float32), {"k": tuple(ks), "v": tuple(vs)}
 
 
 def _pick(logits, key, temperature, greedy: bool, top_k: int):

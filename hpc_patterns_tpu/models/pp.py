@@ -49,6 +49,7 @@ from hpc_patterns_tpu.models.transformer import (
     TransformerConfig,
     _layer,
     _rmsnorm,
+    chunked_masked_causal_nll,
     init_params,
     masked_causal_nll,
 )
@@ -84,11 +85,20 @@ def _stage_fn(layers_shard, h, cfg):
     return h
 
 
-def _loss_head(lp, y, target_tokens):
+def _loss_head(lp, y, target_tokens, *, loss_chunk: int = 0):
     """Final-norm + LM head + the shared masked causal NLL
     (transformer.masked_causal_nll — identical loss semantics to
-    transformer.loss_fn by construction)."""
+    transformer.loss_fn by construction). With ``loss_chunk`` the NLL is
+    the online-logsumexp chunked form: the per-microbatch (b, T, vocab)
+    logits never materialize, which is where the long-context memory
+    wall bites hardest inside a pipeline stage (the 1F1B tick holds the
+    stage's activations AND the loss head's intermediates live)."""
     x = _rmsnorm(y, lp["ln_f_scale"])
+    if loss_chunk:
+        return chunked_masked_causal_nll(
+            x, lp["lm_head"].astype(y.dtype), target_tokens,
+            chunk=loss_chunk,
+        )
     logits = jnp.dot(x, lp["lm_head"].astype(y.dtype)).astype(jnp.float32)
     return masked_causal_nll(logits, target_tokens)
 
@@ -129,7 +139,7 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
             layers_shard,
             x_mb,
             toks,
-            _loss_head,
+            partial(_loss_head, loss_chunk=cfg.loss_chunk),
             axis_pp,
             loss_params=head,
             return_input_grads=True,

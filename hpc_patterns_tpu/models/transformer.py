@@ -106,6 +106,11 @@ class TransformerConfig:
     n_experts: int = 0
     capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # experts consulted per token: 1 = Switch top-1; k >= 2 routes each
+    # token to its k highest-gate experts with the k gates renormalized
+    # (GShard style, first choices claim capacity slots before any
+    # second choice). Drop telemetry for either: moe_drop_rates
+    n_experts_top_k: int = 1
     # fully-sharded data parallelism (ZeRO-3 style): params, grads, and
     # optimizer state shard over axis_fsdp; XLA inserts the per-layer
     # all-gather (fwd/bwd) and gradient reduce-scatter from the
@@ -120,6 +125,12 @@ class TransformerConfig:
     # (see chunked_masked_causal_nll). Must divide vocab. Training-loss
     # path only (eval/decode read real logits).
     loss_chunk: int = 0
+    # decode-step attention against the KV cache (models/decode.py):
+    # "flash" = the single-query Pallas kernel streaming the live cache
+    # prefix (ops/flash_decode.py); "gather" = the XLA einsum+mask path
+    # over the full static cache — required for GSPMD-sharded (tp)
+    # serving, where einsums partition but a pallas_call does not
+    decode_attn: str = "flash"
     # mesh axis names (data / sequence(context) / tensor / expert)
     axis_dp: str = "dp"
     axis_sp: str = "sp"
@@ -167,6 +178,17 @@ class TransformerConfig:
             raise ValueError(
                 f"loss_chunk {self.loss_chunk} must be 0 or divide "
                 f"vocab {self.vocab}"
+            )
+        if self.n_experts and not (
+            1 <= self.n_experts_top_k <= max(self.n_experts, 1)
+        ):
+            raise ValueError(
+                f"n_experts_top_k {self.n_experts_top_k} outside "
+                f"[1, n_experts={self.n_experts}]"
+            )
+        if self.decode_attn not in ("flash", "gather"):
+            raise ValueError(
+                f"decode_attn {self.decode_attn!r} not in ('flash', 'gather')"
             )
         if self.remat_policy not in ("nothing", "attn", "dots", "dots_attn",
                                      "split"):
@@ -304,18 +326,25 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
     )(q, k, v)
 
 
-def _moe_block(h, lp, cfg: TransformerConfig, mesh):
-    """Switch-style MoE MLP: top-1 routed experts over the ep axis
-    (parallel/moe.py). Returns (out, aux_loss)."""
+def _moe_block(h, lp, cfg: TransformerConfig, mesh, with_stats=False):
+    """Top-k routed experts over the ep axis (parallel/moe.py; k =
+    cfg.n_experts_top_k, 1 = Switch). Returns (out, aux_loss), plus the
+    kept fraction when ``with_stats`` (the telemetry moe_drop_rates
+    surfaces)."""
     from hpc_patterns_tpu.parallel import moe
 
     B, T, D = h.shape
+    k = cfg.n_experts_top_k
     if mesh is None:
-        cap = moe.default_capacity(B * T, cfg.n_experts, cfg.capacity_factor)
-        y, aux = moe.moe_dense(
-            h.reshape(B * T, D), lp["router"], lp["w1"], lp["w2"], capacity=cap
+        # capacity scales with k: top-k routes k·N assignments, so the
+        # slot budget is k·N·cf/E (GShard's sizing; k=1 is unchanged)
+        cap = moe.default_capacity(B * T * k, cfg.n_experts,
+                                   cfg.capacity_factor)
+        out = moe.moe_dense(
+            h.reshape(B * T, D), lp["router"], lp["w1"], lp["w2"],
+            capacity=cap, top_k=k, with_stats=with_stats,
         )
-        return y.reshape(B, T, D), aux
+        return (out[0].reshape(B, T, D), *out[1:])
 
     sp, ep = cfg.axis_sp, cfg.axis_ep
     bx = cfg.batch_axes
@@ -338,43 +367,46 @@ def _moe_block(h, lp, cfg: TransformerConfig, mesh):
         )
     b_shards = b_size * (mesh_axis_size(mesh, ep) if batch_over_ep else 1)
     n_local = (B // b_shards) * (T // mesh_axis_size(mesh, sp))
-    cap = moe.default_capacity(n_local, cfg.n_experts, cfg.capacity_factor)
+    cap = moe.default_capacity(n_local * k, cfg.n_experts,
+                               cfg.capacity_factor)
 
     has = lambda ax: ax in mesh.axis_names
 
     def local(hl, router, w1l, w2l):
         b, t, d = hl.shape
         if has(ep):
-            y, aux = moe.moe_ep(
+            y, aux, *st = moe.moe_ep(
                 hl.reshape(b * t, d), router, w1l, w2l,
-                axis=ep, capacity=cap,
+                axis=ep, capacity=cap, top_k=k, with_stats=with_stats,
             )
         else:  # no expert axis in this mesh: all experts local
-            y, aux = moe.moe_dense(
-                hl.reshape(b * t, d), router, w1l, w2l, capacity=cap
+            y, aux, *st = moe.moe_dense(
+                hl.reshape(b * t, d), router, w1l, w2l, capacity=cap,
+                top_k=k, with_stats=with_stats,
             )
         # moe_ep means aux over ep (as a comm axis); with tokens also
-        # sharded on ep, fold every data axis for the global scalar
+        # sharded on ep, fold every data axis for the global scalars
+        scalars = [aux, *st]
         for ax in (*bx, sp):
             if has(ax):
-                aux = lax.pmean(aux, ax)
-        return y.reshape(b, t, d), aux
+                scalars = [lax.pmean(v, ax) for v in scalars]
+        return (y.reshape(b, t, d), *scalars)
 
     tok_spec = (
         resolve_spec(P((*bx, ep), sp, None), mesh, cfg.mesh_axes)
         if has(ep) and batch_over_ep
         else resolve_spec(P(cfg.batch_axes, sp, None), mesh, cfg.mesh_axes)
     )
-    y, aux = jax.shard_map(
+    out = jax.shard_map(
         local,
         mesh=mesh,
         in_specs=(tok_spec, P(None, None),
                   resolve_spec(P(ep, None, None), mesh, cfg.mesh_axes),
                   resolve_spec(P(ep, None, None), mesh, cfg.mesh_axes)),
-        out_specs=(tok_spec, P()),
+        out_specs=(tok_spec, P()) + ((P(),) if with_stats else ()),
         check_vma=False,  # all_to_all + pmean replication not VMA-provable
     )(h, lp["router"], lp["w1"], lp["w2"])
-    return y, aux
+    return out
 
 
 def _qkv_block(x, lp, cfg: TransformerConfig, mesh):
@@ -409,9 +441,11 @@ def _qkv_block(x, lp, cfg: TransformerConfig, mesh):
     return q, k, v
 
 
-def _post_block(x, o, lp, cfg: TransformerConfig, mesh, act_spec):
+def _post_block(x, o, lp, cfg: TransformerConfig, mesh, act_spec,
+                with_stats=False):
     """Post-attention: output projection, residual, norm, mlp/moe.
-    Returns (x, moe_aux)."""
+    Returns (x, moe_aux) — with ``with_stats`` also the MoE kept
+    fraction (1.0 for dense layers)."""
     B, T, D = x.shape
     dt = x.dtype
 
@@ -423,13 +457,14 @@ def _post_block(x, o, lp, cfg: TransformerConfig, mesh, act_spec):
 
     h = _rmsnorm(x, lp["ln2_scale"])
     if cfg.n_experts:
-        h, aux = _moe_block(h, lp, cfg, mesh)
+        h, aux, *st = _moe_block(h, lp, cfg, mesh, with_stats=with_stats)
         h = h.astype(dt)
     else:
         h = jax.nn.gelu(jnp.dot(h, lp["w1"].astype(dt)))  # column-parallel
         h = jnp.dot(h, lp["w2"].astype(dt))  # row-parallel (psum by XLA)
         aux = jnp.zeros((), jnp.float32)
-    return c(x + h, act_spec), aux
+        st = [jnp.ones((), jnp.float32)] if with_stats else []
+    return (c(x + h, act_spec), aux, *st)
 
 
 def _layer(x, lp, cfg: TransformerConfig, mesh, act_spec,
@@ -521,6 +556,42 @@ def forward_hidden(params, tokens, cfg: TransformerConfig, mesh=None):
             aux_list.append(aux_i)
         auxes = jnp.stack(aux_list)
     return _rmsnorm(x, params["ln_f_scale"]), jnp.sum(auxes)
+
+
+def moe_drop_rates(params, tokens, cfg: TransformerConfig, mesh=None):
+    """Per-layer MoE routing drop rate on this batch: (n_layers,) f32,
+    the fraction of routed (token, choice) assignments that found no
+    capacity slot. The visibility companion to the oracle tests —
+    capacity drops during TRAINING are otherwise silent (they only show
+    up as quality loss); train_app logs this alongside the loss. Uses
+    the same forward math as training (routing is deterministic), no
+    gradients."""
+    if not cfg.n_experts:
+        raise ValueError("moe_drop_rates needs an MoE config")
+    dt = jnp.dtype(cfg.dtype)
+    B, T = tokens.shape
+    if mesh is not None:
+        act_spec = jax.sharding.NamedSharding(
+            mesh, resolve_spec(P(cfg.batch_axes, cfg.axis_sp, None), mesh,
+                               cfg.mesh_axes)
+        )
+    else:
+        act_spec = None
+    x = params["embed"].astype(dt)[tokens]
+    if cfg.pos_embed == "learned":
+        x = x + params["pos_embed"].astype(dt)[:T]
+    if mesh is not None:
+        x = lax.with_sharding_constraint(x, act_spec)
+
+    def body(h, lp):
+        q, k, v = _qkv_block(h, lp, cfg, mesh)
+        o = _attention(q, k, v, cfg, mesh)
+        h, _aux, kept = _post_block(h, o, lp, cfg, mesh, act_spec,
+                                    with_stats=True)
+        return h, kept
+
+    _, kepts = lax.scan(body, x, params["layers"])
+    return 1.0 - kepts
 
 
 def masked_causal_nll(logits, tokens):

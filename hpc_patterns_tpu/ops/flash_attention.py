@@ -550,10 +550,13 @@ def _forward_impl(q, k, v, offs, *, causal, scale, block_q, block_k,
 
 # The fused backward materializes a (n_kv, B·H, Tq, D) partial-dQ slab;
 # above this byte budget the two-pass backward (no slab, more FLOPs) is
-# the memory-safe automatic choice. Overridable per call via ``bwd``, or
-# globally via HPCPAT_FLASH_BWD_SLAB_LIMIT (bytes; 0 forces two-pass).
+# the memory-safe automatic choice. 1.5 GiB measured against a 16 GiB
+# chip: the T=32k flagship step fits with the (512, 2048) ladder rung's
+# 1.07 GiB slab but OOMs by ~270 MiB with the 2.15 GiB (1024, 1024)
+# slab. Overridable per call via ``bwd``, or globally via
+# HPCPAT_FLASH_BWD_SLAB_LIMIT (bytes; 0 forces two-pass).
 _FUSED_SLAB_LIMIT = int(
-    os.environ.get("HPCPAT_FLASH_BWD_SLAB_LIMIT", 2 << 30)
+    os.environ.get("HPCPAT_FLASH_BWD_SLAB_LIMIT", 3 << 29)
 )
 
 
@@ -574,9 +577,23 @@ def _backward_impl(qr, kr, vr, outr, lse, offs, g, g_lse, *, causal, scale,
     # the backward has its own block-size optimum: the fused kernel's
     # 5-matmul body amortizes best at (1024, 1024) (measured on chip at
     # T=8192: 135 TF/s vs 125 at the forward's (512, 1024)); callers may
-    # still pin both passes via block_q_bwd/block_k_bwd.
+    # still pin both passes via block_q_bwd/block_k_bwd. When the
+    # partial-dQ slab at that shape would bust the memory budget, the
+    # auto ladder steps to (512, 2048) — doubling block_k halves the
+    # slab (fewer kv chunks), and block_q must drop to keep the kernel
+    # inside VMEM — before giving up and going two-pass.
     if block_q_bwd is None and block_q is None:
         block_q_bwd = 1024
+        if bwd in (None, "auto", "fused") and Tk >= 4096:
+            slab_at = lambda bk: (Tk // bk) * B * H * Tq * D *                 jnp.dtype(qr.dtype).itemsize
+            if slab_at(1024) > _FUSED_SLAB_LIMIT:
+                # take the rung whenever the (1024,1024) slab busts the
+                # budget — halving the slab either fits directly or
+                # halves the q-chunk count (each chunk re-streams K/V,
+                # so fewer chunks beats smaller ones); (512,2048) also
+                # measured FASTER standalone at long T (137 vs 133 TF/s
+                # at T=16k)
+                block_q_bwd, block_k_bwd = 512, 2048
     scale, block_q, block_k, interpret = _resolve(
         Tq, Tk, D, scale,
         block_q if block_q_bwd is None else block_q_bwd,
@@ -612,8 +629,22 @@ def _backward_impl(qr, kr, vr, outr, lse, offs, g, g_lse, *, causal, scale,
     n_q = Tq // block_q
     n_kv = Tk // block_k
     slab_bytes = n_kv * B * H * Tq * D * jnp.dtype(qr.dtype).itemsize
+    # q-chunking: when the whole-Tq slab busts the budget, run the fused
+    # kernel over static query-range chunks — each call's slab is
+    # slab/nc, dK/dV accumulate across calls, and causal fetch-elision
+    # means early chunks never touch their future K/V blocks (the extra
+    # cost is re-streaming K/V once per chunk). This keeps the 5-matmul
+    # backward available at 65k+ context where one slab cannot fit.
+    n_chunks = 1
+    if bwd in (None, "auto", "fused") and slab_bytes > _FUSED_SLAB_LIMIT:
+        while (slab_bytes // n_chunks > _FUSED_SLAB_LIMIT
+               and n_chunks < 16
+               and Tq % (2 * n_chunks) == 0
+               and (Tq // (2 * n_chunks)) % block_q == 0):
+            n_chunks *= 2
     use_fused = bwd == "fused" or (
-        bwd in (None, "auto") and slab_bytes <= _FUSED_SLAB_LIMIT
+        bwd in (None, "auto")
+        and slab_bytes // n_chunks <= _FUSED_SLAB_LIMIT
     )
     row = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
     kv_idx = _kv_index_map(block_q, block_k, causal, H, Hkv)
@@ -630,17 +661,24 @@ def _backward_impl(qr, kr, vr, outr, lse, offs, g, g_lse, *, causal, scale,
                   lambda bkv, ki, j, offs: q_idx(bkv, ki, j, offs))
 
     if use_fused:
+        Tq_c = Tq // n_chunks
+        n_q_c = Tq_c // block_q
+        q_idx_c = _q_index_map(block_q, block_k, causal, n_q_c, H, Hkv)
+        q_on2c = row((None, block_q, D), q_idx_c)
+        vec_on2c = row((None, block_q, 1),
+                       lambda bkv, ki, j, offs: q_idx_c(bkv, ki, j, offs))
+
         def dqp_idx(bkv, ki, j, offs):
-            r, qi, _ = q_idx(bkv, ki, j, offs)
+            r, qi, _ = q_idx_c(bkv, ki, j, offs)
             return ki, r, qi, 0
 
-        dk, dv, dqp = pl.pallas_call(
+        fused_call = pl.pallas_call(
             functools.partial(_fused_bwd_kernel, scale=scale, causal=causal,
-                              n_q=n_q),
+                              n_q=n_q_c),
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=1,
-                grid=(B * Hkv, n_kv, group * n_q),
-                in_specs=[q_on2, q_on2, vec_on2, vec_on2, k_on1, k_on1],
+                grid=(B * Hkv, n_kv, group * n_q_c),
+                in_specs=[q_on2c, q_on2c, vec_on2c, vec_on2c, k_on1, k_on1],
                 out_specs=(k_on1, k_on1,
                            row((None, None, block_q, D), dqp_idx)),
                 scratch_shapes=[
@@ -651,23 +689,43 @@ def _backward_impl(qr, kr, vr, outr, lse, offs, g, g_lse, *, causal, scale,
             out_shape=(
                 jax.ShapeDtypeStruct((B * Hkv, Tk, D), kr.dtype, vma=vma),
                 jax.ShapeDtypeStruct((B * Hkv, Tk, D), vr.dtype, vma=vma),
-                jax.ShapeDtypeStruct((n_kv, B * H, Tq, D), qr.dtype,
+                jax.ShapeDtypeStruct((n_kv, B * H, Tq_c, D), qr.dtype,
                                      vma=vma),
             ),
             interpret=interpret,
-        )(offs, qr, dor, lse, delta, kr, vr)
-        if causal:
-            # a slab slot (ki, ·, t, ·) was written iff the q block
-            # holding row t can see kv block ki; never-written slots
-            # hold whatever HBM held (possibly NaN) — select, not
-            # multiply
-            q_end_g = offs[0] + (
-                lax.iota(jnp.int32, Tq) // block_q + 1
-            ) * block_q - 1
-            k_start_g = offs[1] + lax.iota(jnp.int32, n_kv) * block_k
-            written = q_end_g[None, :] >= k_start_g[:, None]  # (n_kv, Tq)
-            dqp = jnp.where(written[:, None, :, None], dqp, 0)
-        dq = dqp.astype(jnp.float32).sum(0).astype(qr.dtype)
+        )
+
+        dq_parts = []
+        dk_acc = dv_acc = None
+        for i in range(n_chunks):
+            lo = i * Tq_c
+            offs_i = offs + jnp.array([lo, 0], jnp.int32)
+            dk_i, dv_i, dqp = fused_call(
+                offs_i, qr[:, lo:lo + Tq_c], dor[:, lo:lo + Tq_c],
+                lse[:, lo:lo + Tq_c], delta[:, lo:lo + Tq_c], kr, vr,
+            )
+            if causal:
+                # a slab slot (ki, ·, t, ·) was written iff the q block
+                # holding row t can see kv block ki; never-written slots
+                # hold whatever HBM held (possibly NaN) — select, not
+                # multiply
+                q_end_g = offs_i[0] + (
+                    lax.iota(jnp.int32, Tq_c) // block_q + 1
+                ) * block_q - 1
+                k_start_g = offs[1] + lax.iota(jnp.int32, n_kv) * block_k
+                written = q_end_g[None, :] >= k_start_g[:, None]
+                dqp = jnp.where(written[:, None, :, None], dqp, 0)
+            dq_parts.append(dqp.astype(jnp.float32).sum(0).astype(qr.dtype))
+            if dk_acc is None:
+                dk_acc, dv_acc = (dk_i.astype(jnp.float32),
+                                  dv_i.astype(jnp.float32))
+            else:
+                dk_acc = dk_acc + dk_i.astype(jnp.float32)
+                dv_acc = dv_acc + dv_i.astype(jnp.float32)
+        dq = (dq_parts[0] if n_chunks == 1
+              else jnp.concatenate(dq_parts, axis=1))
+        dk = dk_acc.astype(kr.dtype)
+        dv = dv_acc.astype(vr.dtype)
         back = lambda x, h, t: x.reshape(B, h, t, D).transpose(0, 2, 1, 3)
         return back(dq, H, Tq), back(dk, Hkv, Tk), back(dv, Hkv, Tk)
 

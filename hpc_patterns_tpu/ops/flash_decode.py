@@ -1,0 +1,160 @@
+"""Single-query (decode-step) flash attention streaming the KV cache.
+
+The serving-side analog of ops/flash_attention.py (the framework rule:
+hot loops are Pallas — docs/ARCHITECTURE.md; reference analog: the
+own-the-hot-loop principle of concurency/sycl_con.cpp:26-33). A decode
+step is cache-read-bound — the framework's own measurement proved GQA's
+full n_heads/kv_heads bandwidth saving shows up end-to-end
+(benchmarks/RESULTS.md "KV-cache decoding") — so the kernel's job is to
+make exactly one streamed pass over the *live* prefix of the cache:
+
+- grid = (batch·kv_heads, S_max/BLOCK_S): each step loads one
+  (BLOCK_S, head_dim) cache block into VMEM while the previous block
+  computes (Pallas double-buffers the stream); the online-softmax state
+  (m, l, acc) for the g = n_heads/kv_heads grouped queries carries in
+  f32 scratch across the S axis.
+- the current fill position arrives via scalar prefetch, and the cache
+  index map CLAMPS blocks past it to the last live block — consecutive
+  clamped steps revisit that block, Pallas elides the fetch, and
+  ``pl.when`` skips the compute. Per-step HBM traffic is proportional
+  to the POSITION, not the allocated cache length (the XLA gather path
+  always reads all of max_len and masks).
+- GQA is native: the q block is the (g, head_dim) group sharing this
+  kv head; the cache is streamed kv_heads-narrow. MHA is g = 1.
+
+The cache must be kernel-layout: (batch·kv_heads, S_max, head_dim) with
+S contiguous — models/decode.py stores it that way from prefill on
+(a per-step transpose would itself read the whole cache and defeat the
+point).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                   acc_ref, *, scale: float):
+    # grid (B·Hkv, n_s): one kv-cache block per step, grouped-query
+    # online softmax carried in scratch over the S axis.
+    g, d = q_ref.shape
+    block_s = k_ref.shape[0]
+    si = pl.program_id(1)
+    n_s = pl.num_programs(1)
+    pos = pos_ref[0]
+
+    @pl.when(si == 0)
+    def _():
+        m_ref[:] = jnp.full((g, 1), _NEG_INF, jnp.float32)
+        l_ref[:] = jnp.zeros((g, 1), jnp.float32)
+        acc_ref[:] = jnp.zeros((g, d), jnp.float32)
+
+    # a block fully past the fill position contributes nothing: its
+    # fetch was elided by the clamped index map, its compute is skipped
+    @pl.when(si * block_s <= pos)
+    def _():
+        # f32 score/value math (unlike the training kernel's native-
+        # dtype matmuls): a decode step is cache-READ-bound — the f32
+        # compute is free next to the bf16 stream, and it reproduces
+        # the gather path's f32 einsum numerics so greedy tokens match
+        q = q_ref[:].astype(jnp.float32)
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+                    precision=lax.Precision.HIGHEST) * scale
+        k_pos = si * block_s + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos <= pos, s, _NEG_INF)
+        m = m_ref[:]
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        rescale = jnp.exp(m - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * rescale + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * rescale + jnp.dot(
+            p, v, preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST,
+        )
+
+    @pl.when(si == n_s - 1)
+    def _():
+        o_ref[:] = acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+
+
+def flash_decode_attention(
+    q,
+    k_cache,
+    v_cache,
+    pos,
+    *,
+    scale: float | None = None,
+    block_s: int = 2048,
+    interpret: bool | None = None,
+):
+    """Attention of one new token per sequence against the KV cache.
+
+    ``q``: (B, n_heads, head_dim) — the current token's queries;
+    ``k_cache``/``v_cache``: (B, kv_heads, S_max, head_dim), the live
+    prefix being rows [0, pos]; ``pos``: traced int32 scalar, the
+    position being decoded (== number of already-cached tokens; the
+    row at ``pos`` must already hold this token's K/V). Returns
+    (B, n_heads, head_dim) f32. Numerically the gather-path softmax
+    (models/decode.py) evaluated blockwise in f32.
+    """
+    B, H, D = q.shape
+    Hkv, S = k_cache.shape[1], k_cache.shape[2]
+    if H % Hkv or v_cache.shape[1] != Hkv:
+        raise ValueError(
+            f"kv heads {Hkv}/{v_cache.shape[1]} must match and divide "
+            f"n_heads {H}"
+        )
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    block_s = min(block_s, S)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    g = H // Hkv
+
+    qr = q.reshape(B * Hkv, g, D)          # q head k·g+j -> row b·Hkv+k
+    kr = k_cache.reshape(B * Hkv, S, D)
+    vr = v_cache.reshape(B * Hkv, S, D)
+    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+
+    # ceil-div grid: a ragged last block reads the padded tile and the
+    # k_pos <= pos mask (pos < S always) zeroes whatever it holds
+    n_s = -(-S // block_s)
+
+    def kv_idx(r, si, pos_ref):
+        # clamp past-the-fill blocks to the last live one: consecutive
+        # clamped steps revisit it and Pallas skips the fetch
+        return r, jnp.minimum(si, pos_ref[0] // block_s), 0
+
+    row = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=float(scale)),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B * Hkv, n_s),
+            in_specs=[
+                row((None, g, D), lambda r, si, pos: (r, 0, 0)),
+                row((None, block_s, D), kv_idx),
+                row((None, block_s, D), kv_idx),
+            ],
+            out_specs=row((None, g, D), lambda r, si, pos: (r, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((g, 1), jnp.float32),   # running max
+                pltpu.VMEM((g, 1), jnp.float32),   # running sumexp
+                pltpu.VMEM((g, D), jnp.float32),   # output accumulator
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * Hkv, g, D), jnp.float32),
+        interpret=interpret,
+    )(pos_arr, qr, kr, vr)
+    return out.reshape(B, H, D)

@@ -25,31 +25,60 @@ import jax.numpy as jnp
 from hpc_patterns_tpu.comm import collectives, ring
 
 
-def _dispatch_combine(x, router_w, n_experts: int, capacity: int):
-    """Top-1 routing tensors for local tokens x: (N, D).
+def _dispatch_combine(x, router_w, n_experts: int, capacity: int,
+                      top_k: int = 1):
+    """Top-k routing tensors for local tokens x: (N, D).
 
     Returns (dispatch (N, E, C) f32 0/1, combine (N, E, C) f32 gate,
-    aux_loss scalar). Position within an expert's capacity is assigned
-    in token order (cumsum), the Switch transformer formulation.
+    aux_loss scalar, kept_frac scalar — the fraction of routed
+    (token, choice) assignments that got a capacity slot; 1 - kept_frac
+    is the drop rate the training telemetry reports). Position within
+    an expert's capacity is assigned in token order (cumsum), the
+    Switch transformer formulation; for ``top_k > 1`` the walk is
+    CHOICE-major — every token's first choice claims its slot before
+    any second choice competes (GShard's priority rule, so raising k
+    never evicts a first-choice assignment) — and the k gates are
+    renormalized to sum to one per token.
     """
     n = x.shape[0]
     logits = jnp.dot(x.astype(jnp.float32), router_w.astype(jnp.float32))
     gates = jax.nn.softmax(logits, axis=-1)  # (N, E)
-    expert = jnp.argmax(gates, axis=-1)  # (N,)
-    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)  # (N, E)
-    # slot index of each token within its expert (0-based, token order)
-    position = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # (N, E), -1 elsewhere
-    kept = onehot * (position < capacity)  # overflow dropped
-    pos_clamped = jnp.clip(position, 0, capacity - 1).astype(jnp.int32)
-    slot_onehot = jax.nn.one_hot(pos_clamped, capacity, dtype=jnp.float32)
-    dispatch = kept[..., None] * slot_onehot  # (N, E, C)
-    top_gate = jnp.sum(gates * onehot, axis=-1)  # (N,)
-    combine = dispatch * top_gate[:, None, None]
-    # Switch load-balancing auxiliary loss: E * sum_e f_e * P_e
-    f = onehot.mean(axis=0)
+    if top_k == 1:
+        expert = jnp.argmax(gates, axis=-1)  # (N,)
+        onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)
+        # slot index of each token within its expert (0-based, token order)
+        position = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # -1 elsewhere
+        kept = onehot * (position < capacity)  # overflow dropped
+        pos_clamped = jnp.clip(position, 0, capacity - 1).astype(jnp.int32)
+        slot_onehot = jax.nn.one_hot(pos_clamped, capacity, dtype=jnp.float32)
+        dispatch = kept[..., None] * slot_onehot  # (N, E, C)
+        top_gate = jnp.sum(gates * onehot, axis=-1)  # (N,)
+        combine = dispatch * top_gate[:, None, None]
+        first_frac = onehot.mean(axis=0)
+        kept_frac = jnp.sum(kept) / n
+    else:
+        vals, idx = jax.lax.top_k(gates, top_k)           # (N, k)
+        norm = vals / jnp.sum(vals, axis=-1, keepdims=True)
+        oh = jax.nn.one_hot(idx, n_experts, dtype=jnp.float32)  # (N, k, E)
+        flat = oh.transpose(1, 0, 2).reshape(top_k * n, n_experts)
+        position = jnp.cumsum(flat, axis=0) * flat - 1.0
+        kept = flat * (position < capacity)
+        pos_clamped = jnp.clip(position, 0, capacity - 1).astype(jnp.int32)
+        slot_onehot = jax.nn.one_hot(pos_clamped, capacity,
+                                     dtype=jnp.float32)
+        disp_choice = (kept[..., None] * slot_onehot).reshape(
+            top_k, n, n_experts, capacity
+        )
+        dispatch = disp_choice.sum(0)  # choices hit distinct experts
+        combine = jnp.einsum("knec,nk->nec", disp_choice, norm)
+        first_frac = oh[:, 0].mean(axis=0)
+        kept_frac = jnp.sum(kept) / (top_k * n)
+    # Switch load-balancing auxiliary loss: E * sum_e f_e * P_e, with
+    # f the FIRST-choice routing fraction (the k=1 definition; the
+    # balance pressure targets the primary assignment)
     p = gates.mean(axis=0)
-    aux = n_experts * jnp.sum(f * p)
-    return dispatch, combine, aux
+    aux = n_experts * jnp.sum(first_frac * p)
+    return dispatch, combine, aux, kept_frac
 
 
 def _expert_ffn(xin, w1, w2, activation=None):
@@ -64,20 +93,26 @@ def default_capacity(n_tokens: int, n_experts: int,
     return max(1, int(n_tokens * capacity_factor / n_experts))
 
 
-def moe_dense(x, router_w, w1, w2, *, capacity: int, activation=None):
+def moe_dense(x, router_w, w1, w2, *, capacity: int, activation=None,
+              top_k: int = 1, with_stats: bool = False):
     """Single-device oracle: all E experts local. x: (N, D); w1: (E, D,
-    F); w2: (E, F, D). Returns (y (N, D), aux_loss)."""
+    F); w2: (E, F, D). Returns (y (N, D), aux_loss), plus the kept
+    fraction when ``with_stats`` (drop rate = 1 - kept)."""
     E = w1.shape[0]
-    dispatch, combine, aux = _dispatch_combine(x, router_w, E, capacity)
+    dispatch, combine, aux, kept = _dispatch_combine(
+        x, router_w, E, capacity, top_k
+    )
     # routing math stays f32; dispatch/FFN run in x's (MXU-native) dtype
     xin = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), x)
     out = _expert_ffn(xin, w1, w2, activation)
     y = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), out)
+    if with_stats:
+        return y.astype(x.dtype), aux, kept
     return y.astype(x.dtype), aux
 
 
 def moe_ep(x, router_w, w1_local, w2_local, *, axis: str, capacity: int,
-           activation=None):
+           activation=None, top_k: int = 1, with_stats: bool = False):
     """Expert-parallel MoE layer (rank-local; run inside ``shard_map``).
 
     ``x``: (N_local, D) this rank's tokens. ``w1_local``/``w2_local``:
@@ -90,7 +125,9 @@ def moe_ep(x, router_w, w1_local, w2_local, *, axis: str, capacity: int,
     P = ring.axis_size(axis)
     e_local = w1_local.shape[0]
     E = e_local * P
-    dispatch, combine, aux = _dispatch_combine(x, router_w, E, capacity)
+    dispatch, combine, aux, kept = _dispatch_combine(
+        x, router_w, E, capacity, top_k
+    )
     xin = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), x)  # (E, C, D)
     # tokens to their experts' owners: (E, C, D) -> (E/P, P*C, D)
     xin = collectives.all_to_all(xin, axis, split_axis=0, concat_axis=1)
@@ -98,6 +135,9 @@ def moe_ep(x, router_w, w1_local, w2_local, *, axis: str, capacity: int,
     # results back to the tokens' owners: (E/P, P*C, D) -> (E, C, D)
     out = collectives.all_to_all(out, axis, split_axis=1, concat_axis=0)
     y = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), out)
-    # aux is per-shard; average across ranks for a global scalar
+    # aux/kept are per-shard; average across ranks for global scalars
     aux = collectives.allreduce(aux, axis, "mean")
+    if with_stats:
+        return (y.astype(x.dtype), aux,
+                collectives.allreduce(kept, axis, "mean"))
     return y.astype(x.dtype), aux

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 from collections import defaultdict
 from typing import Mapping, Sequence
 
@@ -184,13 +185,70 @@ def group_by_host(devices: Sequence[jax.Device] | None = None) -> dict[int, list
     return dict(groups)
 
 
+# Slice-topology override for environments without real multi-slice
+# hardware (HPCPAT_SLICE_GROUPING): "process" treats each OS process as
+# one slice (apps/launch.py sets it so a -np N launch IS an N-slice
+# system and the DCN-axis collectives cross real process boundaries);
+# "process:a,b,..." maps process id -> slice id (several processes per
+# slice); "devices:K" groups by device id in runs of K (single-process
+# synthetic slices for tests). Every process computes the same grouping
+# from the same env value — the SPMD invariant group_by_slice must keep.
+ENV_SLICE_GROUPING = "HPCPAT_SLICE_GROUPING"
+
+
+def _slice_id_fn():
+    spec = os.environ.get(ENV_SLICE_GROUPING)
+    if not spec:
+        return lambda d: getattr(d, "slice_index", 0)
+    kind, _, arg = spec.partition(":")
+    if kind == "process":
+        if not arg:
+            return lambda d: d.process_index
+        try:
+            mapping = [int(s) for s in arg.split(",")]
+        except ValueError as e:
+            raise TopologyError(
+                f"{ENV_SLICE_GROUPING}={spec!r}: 'process:map' wants "
+                "comma-separated integers"
+            ) from e
+
+        def by_process(d):
+            if d.process_index >= len(mapping):
+                raise TopologyError(
+                    f"{ENV_SLICE_GROUPING}={spec!r} maps "
+                    f"{len(mapping)} processes; device {d} is from "
+                    f"process {d.process_index}"
+                )
+            return mapping[d.process_index]
+
+        return by_process
+    if kind == "devices":
+        try:
+            k = int(arg)
+        except ValueError:
+            k = 0
+        if k < 1:
+            raise TopologyError(
+                f"{ENV_SLICE_GROUPING}={spec!r}: 'devices:K' needs a "
+                "positive integer K"
+            )
+        return lambda d: d.id // k
+    raise TopologyError(
+        f"{ENV_SLICE_GROUPING}={spec!r}: want 'process[:map]' or "
+        "'devices:K'"
+    )
+
+
 def group_by_slice(devices: Sequence[jax.Device] | None = None) -> dict[int, list[jax.Device]]:
-    """Group devices by TPU slice (multi-slice = DCN between groups)."""
+    """Group devices by TPU slice (multi-slice = DCN between groups).
+    ``HPCPAT_SLICE_GROUPING`` overrides the hardware ``slice_index`` —
+    see :data:`ENV_SLICE_GROUPING`."""
     if devices is None:
         devices = get_devices()
+    slice_id = _slice_id_fn()
     groups: dict[int, list[jax.Device]] = defaultdict(list)
     for d in devices:
-        groups[getattr(d, "slice_index", 0)].append(d)
+        groups[slice_id(d)].append(d)
     return dict(groups)
 
 

@@ -47,7 +47,8 @@ class TestPrefill:
         want = forward(params, prompt, cfg)[:, -1]
         np.testing.assert_allclose(np.asarray(logits), np.asarray(want),
                                    atol=1e-5)
-        assert cache["k"].shape == (2, 2, 16, 4, 8)
+        assert len(cache["k"]) == 2  # per-layer buffers (in-place DUS)
+        assert cache["k"][0].shape == (2, 4, 16, 8)
 
     def test_bad_lengths_rejected(self):
         cfg, params, prompt = _setup()
@@ -87,6 +88,9 @@ class TestGenerate:
         # contention, which a capacity-limited decode would fail
         pytest.param({"n_experts": 2, "capacity_factor": 2.0},
                      marks=pytest.mark.slow),
+        # top-2 routing must serve with top-2 too (a top-1 decode of a
+        # top-k-trained model silently diverges from forward)
+        {"n_experts": 2, "n_experts_top_k": 2, "capacity_factor": 2.0},
         pytest.param({"dtype": "bfloat16"}, marks=pytest.mark.slow),
         # post-rope keys in the cache
         pytest.param({"pos_embed": "rope"}, marks=pytest.mark.slow),
@@ -129,11 +133,15 @@ class TestShardedServing:
         # tp collectives; tokens must be bit-identical to local decode
         from hpc_patterns_tpu.models.sharding import shard_params
 
+        # decode_attn="gather": sharded serving rides GSPMD-partitioned
+        # einsums (a pallas_call does not auto-partition); tokens must
+        # still match the (default, flash-kernel) local decode exactly
         cfg, params, prompt = _setup()
         want = np.asarray(greedy_generate(params, prompt, cfg, 6))
-        p_sh = shard_params(params, mesh_dp_sp_tp, cfg)
+        gcfg = TransformerConfig(**{**BASE, "decode_attn": "gather"})
+        p_sh = shard_params(params, mesh_dp_sp_tp, gcfg)
         got = np.asarray(jax.device_get(
-            greedy_generate(p_sh, prompt, cfg, 6)
+            greedy_generate(p_sh, prompt, gcfg, 6)
         ))
         np.testing.assert_array_equal(got, want)
 

@@ -101,6 +101,21 @@ class TestTrainApp:
         assert code == 0, out
         assert "1f1b" in out and "SUCCESS" in out
 
+    @pytest.mark.slow  # unrolled-1F1B compile dominates (~1 min)
+    def test_pp_chunked_loss_run(self, capsys):
+        # --pp x --loss-chunk trains: the pipeline loss head computes
+        # the chunked (logits-free) NLL per microbatch
+        from hpc_patterns_tpu.apps import train_app
+
+        code = train_app.main(
+            ["--steps", "3", "--batch", "4", "--seq", "8", "--d-model", "16",
+             "--n-layers", "2", "--n-heads", "2", "--vocab", "32",
+             "--pp", "2", "--microbatches", "2", "--loss-chunk", "8"]
+        )
+        out = capsys.readouterr().out
+        assert code == 0, out
+        assert "1f1b" in out and "SUCCESS" in out
+
     def test_diverged_run_halts_early_and_fails(self, capsys, tmp_path):
         import os
 
@@ -118,28 +133,17 @@ class TestTrainApp:
         # a diverged run must never persist its NaN state
         assert not os.listdir(tmp_path)
 
-    @staticmethod
-    def _fake_slices(ds):
-        # argument-RESPECTING synthetic slices (a mock that ignores its
-        # devices argument would hide prefix-selection bugs)
-        def fake(devices=None):
-            devices = ds if devices is None else devices
-            out = {}
-            for d in devices:
-                out.setdefault(0 if d.id < 4 else 1, []).append(d)
-            return out
-        return fake
-
     @pytest.mark.parametrize("dp,tp", [("2", "4"), ("-1", "2")])
     def test_dcn_dp_mesh(self, capsys, monkeypatch, dp, tp):
         # dp across synthetic slices, tp within one (make_hybrid_mesh);
         # the -1/tp=2 case uses only part of each slice, so the device
-        # pick must be per-slice, never a flat prefix
+        # pick must be per-slice, never a flat prefix. Slices come from
+        # the production env override (no monkeypatched grouping) — the
+        # same protocol the cross-process launch test drives for real
         from hpc_patterns_tpu import topology
         from hpc_patterns_tpu.apps import train_app
 
-        monkeypatch.setattr(topology, "group_by_slice",
-                            self._fake_slices(topology.get_devices()))
+        monkeypatch.setenv(topology.ENV_SLICE_GROUPING, "devices:4")
         code = train_app.main(
             ["--steps", "2", "--batch", "4", "--seq", "16", "--d-model",
              "32", "--n-layers", "1", "--n-heads", "4", "--vocab", "64",

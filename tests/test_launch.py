@@ -17,9 +17,10 @@ from hpc_patterns_tpu.apps import launch
 pytestmark = pytest.mark.slow  # each case boots 2 jax processes
 
 
-def _launch(app_args, np_=2, devices=2):
+def _launch(app_args, np_=2, devices=2, slices=0):
     return launch.main([
-        "-np", str(np_), "--cpu-devices-per-proc", str(devices), "--",
+        "-np", str(np_), "--cpu-devices-per-proc", str(devices),
+        *(["--slices", str(slices)] if slices else []), "--",
         sys.executable, "-m", *app_args,
     ])
 
@@ -63,6 +64,22 @@ class TestLaunch:
                         "--vocab", "128"], devices=1)
         out = capsys.readouterr().out
         assert code == 0, out
+
+    def test_train_dcn_dp_slices_across_processes(self, capsys):
+        # the multi-slice hybrid-mesh path with REAL process boundaries:
+        # --slices 2 makes each OS process one "slice" (the production
+        # HPCPAT_SLICE_GROUPING protocol, not a monkeypatch), so the
+        # --dcn-dp gradient psum is a genuine DCN-analog collective
+        # crossing processes while the tp collectives stay
+        # slice-internal (each process's own 4 devices)
+        code = _launch(["hpc_patterns_tpu.apps.train_app", "--dcn-dp",
+                        "--dp", "-1", "--tp", "2", "--steps", "2",
+                        "--batch", "4", "--seq", "32",
+                        "--d-model", "32", "--n-layers", "1",
+                        "--vocab", "128"], devices=4, slices=2)
+        out = capsys.readouterr().out
+        assert code == 0, out
+        assert "SUCCESS" in out
 
     def test_train_sp_ring_attention_across_processes(self, capsys):
         # ring attention with the sp axis spanning both OS processes:

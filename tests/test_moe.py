@@ -76,3 +76,83 @@ class TestMoE:
     def test_default_capacity(self):
         assert moe.default_capacity(128, 8) == 20
         assert moe.default_capacity(4, 64) == 1
+
+
+class TestTopK:
+    def test_top2_drop_free_equals_gate_mixture(self, weights):
+        # capacity >= all: top-2 output must equal the analytic mixture
+        # sum_j norm_gate_j * FFN_j(x) over each token's 2 best experts
+        router, w1, w2 = weights
+        x = jax.random.normal(jax.random.PRNGKey(5), (24, D), jnp.float32)
+        y, aux = moe.moe_dense(x, router, w1, w2, capacity=48, top_k=2)
+
+        gates = jax.nn.softmax(x @ router, axis=-1)
+        vals, idx = jax.lax.top_k(gates, 2)
+        norm = vals / vals.sum(-1, keepdims=True)
+        ffn = jnp.stack([
+            jax.nn.gelu(x @ w1[e]) @ w2[e] for e in range(E)
+        ])  # (E, N, D)
+        want = sum(
+            norm[:, j, None] * jnp.take_along_axis(
+                ffn, idx[:, j][None, :, None], axis=0
+            )[0]
+            for j in range(2)
+        )
+        np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                                   atol=2e-5)
+        assert np.isfinite(float(aux))
+
+    def test_top2_first_choices_never_evicted(self, weights):
+        # GShard priority: raising k must not change which FIRST choices
+        # get slots — at capacity 1, top-1 kept set == the first-choice
+        # assignments kept under top-2
+        router, w1, w2 = weights
+        x = jax.random.normal(jax.random.PRNGKey(6), (32, D), jnp.float32)
+        d1, _, _, kept1 = moe._dispatch_combine(x, router, E, 1, top_k=1)
+        d2, _, _, _ = moe._dispatch_combine(x, router, E, 1, top_k=2)
+        # a token's first choice occupies the same slot in both
+        gates = jax.nn.softmax(x @ router, axis=-1)
+        first = jnp.argmax(gates, axis=-1)
+        oh = jax.nn.one_hot(first, E)
+        np.testing.assert_array_equal(
+            np.asarray(jnp.einsum("nec,ne->nc", d1, oh)),
+            np.asarray(jnp.einsum("nec,ne->nc", d2, oh)),
+        )
+        assert 0.0 < float(kept1) <= 1.0
+
+    def test_ep_top2_matches_dense_per_shard(self, mesh8, weights):
+        router, w1, w2 = weights
+        cap = moe.default_capacity(2 * N_LOCAL, E)
+        x = jax.random.normal(jax.random.PRNGKey(7), (8 * N_LOCAL, D),
+                              jnp.float32)
+        y_ep, aux_ep, kept_ep = jax.jit(
+            jax.shard_map(
+                lambda xl, wa, wb: moe.moe_ep(
+                    xl, router, wa, wb, axis="x", capacity=cap, top_k=2,
+                    with_stats=True,
+                ),
+                mesh=mesh8,
+                in_specs=(P("x", None), P("x", None, None), P("x", None, None)),
+                out_specs=(P("x", None), P(), P()),
+                check_vma=False,
+            )
+        )(x, w1, w2)
+        want = np.concatenate([
+            np.asarray(moe.moe_dense(
+                x[r * N_LOCAL:(r + 1) * N_LOCAL], router, w1, w2,
+                capacity=cap, top_k=2,
+            )[0]) for r in range(8)
+        ])
+        np.testing.assert_allclose(np.asarray(y_ep), want, atol=2e-5)
+        assert np.isfinite(float(aux_ep))
+        assert 0.0 < float(kept_ep) <= 1.0
+
+    def test_stats_report_drops(self, weights):
+        router, w1, w2 = weights
+        x = jax.random.normal(jax.random.PRNGKey(8), (32, D), jnp.float32)
+        _, _, kept_tight = moe.moe_dense(x, router, w1, w2, capacity=1,
+                                         with_stats=True)
+        _, _, kept_roomy = moe.moe_dense(x, router, w1, w2, capacity=32,
+                                         with_stats=True)
+        assert float(kept_roomy) == 1.0
+        assert float(kept_tight) < 1.0

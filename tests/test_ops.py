@@ -138,6 +138,32 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-5)
 
+    def test_fused_bwd_q_chunked_matches(self, monkeypatch):
+        # long-context shape analog: shrink the slab budget until the
+        # fused backward must split the query range into 2 chunks; the
+        # chunked grads must equal the one-call fused grads exactly
+        import importlib
+
+        fa = importlib.import_module("hpc_patterns_tpu.ops.flash_attention")
+
+        q, k, v = _qkv(jax.random.PRNGKey(12), B=1, T=128, H=2, D=16)
+        grad = lambda: jax.grad(
+            lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                            block_q=32, block_k=32,
+                                            bwd="fused").sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        want = grad()
+        # slab = 4 kv chunks * 1 * 2 * 128 * 16 * 4 B = 64 KiB; half of
+        # it forces n_chunks = 2 (Tq/4 = 32 still divides block_q)
+        monkeypatch.setattr(fa, "_FUSED_SLAB_LIMIT", 32768)
+        got = grad()
+        for a, b in zip(got, want):
+            # chunked dK/dV accumulate call-by-call in f32 and the dQ
+            # slab-sum association changes: equal to f32 rounding
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
+
     def test_bad_bwd_rejected(self):
         q, k, v = _qkv(jax.random.PRNGKey(9), B=1, T=32, H=2, D=16)
         with pytest.raises(ValueError, match="bwd"):

@@ -116,6 +116,23 @@ class TestPPModel:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-5)
 
+    def test_pp_chunked_loss_matches_oracle(self, setup):
+        # --pp x --loss-chunk: the last stage's loss head computes the
+        # per-microbatch NLL by online logsumexp over vocab chunks (the
+        # logits never materialize) and must equal the dense-head
+        # single-device oracle — loss AND grads (the chunked head's
+        # backward recomputes each chunk inside the 1F1B tick)
+        cfg, params, tokens, want_loss, want_g = setup
+        ccfg = TransformerConfig(**{**CFG, "loss_chunk": 8})
+        mesh = topology.make_mesh({"pp": 4}, jax.devices()[:4])
+        loss, grads = pplib.pp_loss_and_grads(
+            params, tokens, ccfg, mesh, microbatches=2
+        )
+        np.testing.assert_allclose(float(loss), want_loss, rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(grads), jax.tree.leaves(want_g)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5)
+
     def test_layers_must_divide(self, setup):
         cfg, params, tokens, _, _ = setup
         mesh = topology.make_mesh({"pp": 4}, jax.devices()[:4])

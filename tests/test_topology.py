@@ -170,8 +170,9 @@ class TestHybridMesh:
 
         ds = topology.get_devices()
         fake_groups = {0: ds[:4], 1: ds[4:]}
-        monkeypatch.setattr(topology, "group_by_slice",
-                            lambda devices=None: fake_groups)
+        # the production slice-override path (no monkeypatching): the
+        # same env protocol apps/launch.py --slices uses cross-process
+        monkeypatch.setenv(topology.ENV_SLICE_GROUPING, "devices:4")
         mesh = topology.make_hybrid_mesh({"dp": -1}, {"tp": -1}, ds)
         assert mesh.shape == {"dp": 2, "tp": 4}
         # row d of the mesh = fake slice d
@@ -186,6 +187,19 @@ class TestHybridMesh:
         # tp-psum folds within each slice: rows 0-3 sum to 6, 4-7 to 22
         want = np.repeat([6.0, 22.0], 4)
         np.testing.assert_allclose(np.asarray(got), want)
+
+    def test_slice_grouping_env(self, monkeypatch):
+        ds = topology.get_devices()
+        monkeypatch.setenv(topology.ENV_SLICE_GROUPING, "devices:2")
+        assert sorted(topology.group_by_slice(ds)) == [0, 1, 2, 3]
+        # process mapping: all CPU devices are process 0 -> slice 0
+        monkeypatch.setenv(topology.ENV_SLICE_GROUPING, "process:0,1")
+        assert set(topology.group_by_slice(ds)) == {0}
+        monkeypatch.setenv(topology.ENV_SLICE_GROUPING, "process")
+        assert set(topology.group_by_slice(ds)) == {0}
+        monkeypatch.setenv(topology.ENV_SLICE_GROUPING, "banana")
+        with pytest.raises(topology.TopologyError, match="SLICE_GROUPING"):
+            topology.group_by_slice(ds)
 
     def test_single_slice_degenerates(self):
         mesh = topology.make_hybrid_mesh({"dp": -1}, {"tp": 8})
