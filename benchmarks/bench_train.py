@@ -49,6 +49,7 @@ def main():
         n_kv_heads=arg("kv", 0, int),
         loss_chunk=arg("chunk", 0, int),
         remat_policy=arg("rp", "split", str),
+        pos_embed=arg("pos", "learned", str),
     )
     batch = arg("batch", 8 if on_tpu else 2, int)
     seq = cfg.max_seq

@@ -83,6 +83,11 @@ def build_parser():
     p.add_argument("--n-experts-top-k", type=int, default=1,
                    help="experts consulted per token (1 = Switch top-1; "
                         "k>=2 = normalized top-k gates, GShard style)")
+    p.add_argument("--moe-dispatch", default="auto",
+                   choices=["auto", "einsum", "scatter"],
+                   help="routing dispatch: one-hot einsum (oracle form) "
+                        "or stable-sort scatter (O(N+E*C) memory); auto "
+                        "switches to scatter past ~16 MB of one-hots")
     p.add_argument("--prefetch", type=int, default=0, metavar="DEPTH",
                    help="stream fresh synthetic batches through the async "
                         "prefetch loader (0 = one static batch)")
@@ -405,6 +410,7 @@ def run(args) -> int:
             n_layers=args.n_layers, d_ff=4 * args.d_model, max_seq=args.seq,
             attention=args.attention, remat=args.remat, n_experts=args.n_experts,
             n_experts_top_k=args.n_experts_top_k,
+            moe_dispatch=args.moe_dispatch,
             n_kv_heads=args.n_kv_heads, pos_embed=args.pos_embed,
             fsdp=args.fsdp > 1, remat_policy=args.remat_policy,
             loss_chunk=args.loss_chunk,

@@ -111,6 +111,11 @@ class TransformerConfig:
     # (GShard style, first choices claim capacity slots before any
     # second choice). Drop telemetry for either: moe_drop_rates
     n_experts_top_k: int = 1
+    # routing dispatch: "einsum" (one-hot (N, E, C) tensors — oracle
+    # form, O(N²·cf/E) memory), "scatter" (stable-sort, O(N + E·C) —
+    # identical assignments, the at-scale form), or "auto" (scatter
+    # once the one-hot tensors would exceed ~16 MB)
+    moe_dispatch: str = "auto"
     # fully-sharded data parallelism (ZeRO-3 style): params, grads, and
     # optimizer state shard over axis_fsdp; XLA inserts the per-layer
     # all-gather (fwd/bwd) and gradient reduce-scatter from the
@@ -178,6 +183,11 @@ class TransformerConfig:
             raise ValueError(
                 f"loss_chunk {self.loss_chunk} must be 0 or divide "
                 f"vocab {self.vocab}"
+            )
+        if self.moe_dispatch not in ("auto", "einsum", "scatter"):
+            raise ValueError(
+                f"moe_dispatch {self.moe_dispatch!r} not in "
+                "('auto', 'einsum', 'scatter')"
             )
         if self.n_experts and not (
             1 <= self.n_experts_top_k <= max(self.n_experts, 1)
@@ -335,6 +345,20 @@ def _moe_block(h, lp, cfg: TransformerConfig, mesh, with_stats=False):
 
     B, T, D = h.shape
     k = cfg.n_experts_top_k
+
+    def resolve_dispatch(n_local, cap):
+        if cfg.moe_dispatch != "auto":
+            return cfg.moe_dispatch
+        # scatter once the one-hot (N, E, C) tensors stop being small:
+        # measured equal-or-faster on chip at small shapes (182.6-196.3
+        # vs 199.4 ms/step at 4k tokens, adjacent runs) and strictly
+        # enabling at scale (the 16k-token config OOMs under einsum,
+        # trains at 436.8 ms/step under scatter) — einsum remains the
+        # oracle form and the tiny-shape default
+        return ("scatter"
+                if n_local * cfg.n_experts * cap * 4 > 16 << 20
+                else "einsum")
+
     if mesh is None:
         # capacity scales with k: top-k routes k·N assignments, so the
         # slot budget is k·N·cf/E (GShard's sizing; k=1 is unchanged)
@@ -343,6 +367,7 @@ def _moe_block(h, lp, cfg: TransformerConfig, mesh, with_stats=False):
         out = moe.moe_dense(
             h.reshape(B * T, D), lp["router"], lp["w1"], lp["w2"],
             capacity=cap, top_k=k, with_stats=with_stats,
+            dispatch=resolve_dispatch(B * T, cap),
         )
         return (out[0].reshape(B, T, D), *out[1:])
 
@@ -372,17 +397,20 @@ def _moe_block(h, lp, cfg: TransformerConfig, mesh, with_stats=False):
 
     has = lambda ax: ax in mesh.axis_names
 
+    disp = resolve_dispatch(n_local, cap)
+
     def local(hl, router, w1l, w2l):
         b, t, d = hl.shape
         if has(ep):
             y, aux, *st = moe.moe_ep(
                 hl.reshape(b * t, d), router, w1l, w2l,
                 axis=ep, capacity=cap, top_k=k, with_stats=with_stats,
+                dispatch=disp,
             )
         else:  # no expert axis in this mesh: all experts local
             y, aux, *st = moe.moe_dense(
                 hl.reshape(b * t, d), router, w1l, w2l, capacity=cap,
-                top_k=k, with_stats=with_stats,
+                top_k=k, with_stats=with_stats, dispatch=disp,
             )
         # moe_ep means aux over ep (as a comm axis); with tokens also
         # sharded on ep, fold every data axis for the global scalars

@@ -371,8 +371,10 @@ def _resolve(Tq, Tk, D, scale, block_q, block_k, interpret, *,
     a standalone kernel microbench prefers (512, 512) at T=2048 by 26%,
     but IN SITU — inside the full train step, competing with the
     surrounding matmuls for VMEM and scheduling — (512, 1024) wins at
-    every measured shape. Trust the end-to-end number, not the
-    microbench.
+    every measured shape. Round 3 re-confirmed at long T: standalone
+    fwd prefers (512, 2048) at T=8192 by 16% (133 vs 115 TF/s) and
+    LOSES in situ (175.9 vs 172.1 ms/step). Trust the end-to-end
+    number, not the microbench.
     """
     if scale is None:
         scale = 1.0 / (D ** 0.5)

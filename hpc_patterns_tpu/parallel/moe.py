@@ -81,6 +81,100 @@ def _dispatch_combine(x, router_w, n_experts: int, capacity: int,
     return dispatch, combine, aux, kept_frac
 
 
+def _scatter_dispatch(x, gates, n_experts: int, capacity: int,
+                      top_k: int):
+    """Sort/scatter routing: the O(N·D + E·C·D) replacement for the
+    one-hot einsum dispatch, whose (N, E, C) tensors are O(N²·cf/E)
+    and OOM a 16 GB chip near 16k tokens (measured — RESULTS.md
+    "MoE top-k rows"). Same assignment semantics as the einsum path by
+    construction: a STABLE argsort of the choice-major expert ids gives
+    each (token, choice) the same within-expert rank the cumsum
+    formulation computes, so the kept set and slot layout are
+    identical (oracle-tested equal).
+
+    Returns (xin (E, C, D), combine(out) -> y (N, D), aux, kept_frac).
+    """
+    n = x.shape[0]
+    if top_k == 1:
+        vals = jnp.max(gates, axis=-1, keepdims=True)       # (N, 1)
+        idx = jnp.argmax(gates, axis=-1)[:, None]           # (N, 1)
+        norm = jnp.ones_like(vals)
+        first_frac = jax.nn.one_hot(idx[:, 0], n_experts,
+                                    dtype=jnp.float32).mean(0)
+        gate_per_choice = vals
+    else:
+        vals, idx = jax.lax.top_k(gates, top_k)             # (N, k)
+        norm = vals / jnp.sum(vals, axis=-1, keepdims=True)
+        first_frac = jax.nn.one_hot(idx[:, 0], n_experts,
+                                    dtype=jnp.float32).mean(0)
+        gate_per_choice = norm
+    k = idx.shape[1]
+    # choice-major flat (GShard priority: all first choices precede any
+    # second choice), matching the einsum path's walk order
+    expert_flat = idx.T.reshape(k * n)                      # (kN,)
+    token_flat = jnp.tile(jnp.arange(n, dtype=jnp.int32), k)
+    gate_flat = gate_per_choice.T.reshape(k * n)
+    order = jnp.argsort(expert_flat, stable=True)
+    sorted_e = expert_flat[order]
+    start = jnp.searchsorted(sorted_e, jnp.arange(n_experts),
+                             side="left")
+    rank = jnp.arange(k * n, dtype=jnp.int32) - start[sorted_e].astype(
+        jnp.int32
+    )
+    keep = rank < capacity
+    kept_frac = jnp.sum(keep) / (k * n)
+    # dropped entries scatter to a trash row past the real slots
+    slot = jnp.where(keep, sorted_e * capacity + rank,
+                     n_experts * capacity)
+    src_tok = token_flat[order]
+    xin_flat = jnp.zeros((n_experts * capacity + 1, x.shape[1]), x.dtype)
+    xin_flat = xin_flat.at[slot].set(x[src_tok])
+    xin = xin_flat[:-1].reshape(n_experts, capacity, x.shape[1])
+
+    gate_sorted = gate_flat[order]
+
+    def combine(out):
+        out_flat = out.reshape(n_experts * capacity, -1)
+        picked = jnp.where(
+            keep[:, None],
+            out_flat[jnp.clip(slot, 0, n_experts * capacity - 1)], 0.0
+        )
+        y = jnp.zeros((n, out_flat.shape[1]), out_flat.dtype)
+        return y.at[src_tok].add(picked * gate_sorted[:, None].astype(
+            out_flat.dtype
+        ))
+
+    p_mean = gates.mean(axis=0)
+    aux = n_experts * jnp.sum(first_frac * p_mean)
+    return xin, combine, aux, kept_frac
+
+
+def _route(x, router_w, n_experts: int, capacity: int, top_k: int,
+           dispatch: str):
+    """Shared routing front-end for moe_dense and moe_ep: resolve the
+    dispatch form once and return ``(xin (E, C, D), combine(out) -> y,
+    aux, kept_frac)`` — the one place the einsum/scatter selection and
+    the router math live, so the two entry points cannot drift."""
+    if dispatch == "scatter":
+        logits = jnp.dot(x.astype(jnp.float32),
+                         router_w.astype(jnp.float32))
+        gates = jax.nn.softmax(logits, axis=-1)
+        return _scatter_dispatch(x, gates, n_experts, capacity, top_k)
+    if dispatch == "einsum":
+        disp, combine, aux, kept = _dispatch_combine(
+            x, router_w, n_experts, capacity, top_k
+        )
+        # routing math stays f32; dispatch/FFN run in x's dtype
+        xin = jnp.einsum("nec,nd->ecd", disp.astype(x.dtype), x)
+
+        def combine_fn(out):
+            return jnp.einsum("nec,ecd->nd", combine.astype(out.dtype),
+                              out)
+
+        return xin, combine_fn, aux, kept
+    raise ValueError(f"dispatch {dispatch!r} not in ('einsum', 'scatter')")
+
+
 def _expert_ffn(xin, w1, w2, activation=None):
     """Batched per-expert FFN: xin (E, C, D), w1 (E, D, F), w2 (E, F, D)."""
     act = activation or jax.nn.gelu
@@ -94,25 +188,29 @@ def default_capacity(n_tokens: int, n_experts: int,
 
 
 def moe_dense(x, router_w, w1, w2, *, capacity: int, activation=None,
-              top_k: int = 1, with_stats: bool = False):
+              top_k: int = 1, with_stats: bool = False,
+              dispatch: str = "einsum"):
     """Single-device oracle: all E experts local. x: (N, D); w1: (E, D,
     F); w2: (E, F, D). Returns (y (N, D), aux_loss), plus the kept
-    fraction when ``with_stats`` (drop rate = 1 - kept)."""
+    fraction when ``with_stats`` (drop rate = 1 - kept).
+
+    ``dispatch``: "einsum" (one-hot (N, E, C) tensors — the teaching/
+    oracle form, O(N²·cf/E) memory) or "scatter" (stable-sort routing,
+    O(N + E·C) — same assignments by construction, the at-scale form).
+    """
     E = w1.shape[0]
-    dispatch, combine, aux, kept = _dispatch_combine(
-        x, router_w, E, capacity, top_k
-    )
-    # routing math stays f32; dispatch/FFN run in x's (MXU-native) dtype
-    xin = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), x)
+    xin, combine_fn, aux, kept = _route(x, router_w, E, capacity, top_k,
+                                        dispatch)
     out = _expert_ffn(xin, w1, w2, activation)
-    y = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), out)
+    y = combine_fn(out)
     if with_stats:
         return y.astype(x.dtype), aux, kept
     return y.astype(x.dtype), aux
 
 
 def moe_ep(x, router_w, w1_local, w2_local, *, axis: str, capacity: int,
-           activation=None, top_k: int = 1, with_stats: bool = False):
+           activation=None, top_k: int = 1, with_stats: bool = False,
+           dispatch: str = "einsum"):
     """Expert-parallel MoE layer (rank-local; run inside ``shard_map``).
 
     ``x``: (N_local, D) this rank's tokens. ``w1_local``/``w2_local``:
@@ -125,16 +223,14 @@ def moe_ep(x, router_w, w1_local, w2_local, *, axis: str, capacity: int,
     P = ring.axis_size(axis)
     e_local = w1_local.shape[0]
     E = e_local * P
-    dispatch, combine, aux, kept = _dispatch_combine(
-        x, router_w, E, capacity, top_k
-    )
-    xin = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), x)  # (E, C, D)
+    xin, combine_fn, aux, kept = _route(x, router_w, E, capacity, top_k,
+                                        dispatch)
     # tokens to their experts' owners: (E, C, D) -> (E/P, P*C, D)
     xin = collectives.all_to_all(xin, axis, split_axis=0, concat_axis=1)
     out = _expert_ffn(xin, w1_local, w2_local, activation)
     # results back to the tokens' owners: (E/P, P*C, D) -> (E, C, D)
     out = collectives.all_to_all(out, axis, split_axis=1, concat_axis=0)
-    y = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), out)
+    y = combine_fn(out)
     # aux/kept are per-shard; average across ranks for global scalars
     aux = collectives.allreduce(aux, axis, "mean")
     if with_stats:
