@@ -49,6 +49,7 @@ def main():
         max_seq=prompt_len + slack,
         dtype="bfloat16" if on_tpu else "float32",
         n_kv_heads=arg("kv", 0),
+        kv_cache_dtype=arg("cache", "compute", str),
     )
     impls = [a.split("=", 1)[1] for a in sys.argv[1:]
              if a.startswith("--impl=")] or ["flash", "gather"]

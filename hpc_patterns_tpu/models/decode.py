@@ -8,8 +8,9 @@ compilation for the whole generation (the XLA ground rule).
 
 TPU-shaped choices:
 
-- the cache is (layers, batch, kv_heads, max_len, head_dim) in the
-  compute dtype — KERNEL layout, sequence contiguous per (batch, kv
+- the cache is per-layer (batch, kv_heads, max_len, head_dim) buffers
+  in the compute dtype (or int8 + per-row scales, kv_cache_dtype) —
+  KERNEL layout, sequence contiguous per (batch, kv
   head) row — written in place with ``dynamic_update_slice`` under a
   donated jit; steady-state HBM traffic is the cache read, not a
   re-materialization;
@@ -52,9 +53,26 @@ from hpc_patterns_tpu.models.transformer import (
 from hpc_patterns_tpu.parallel.ring_attention import full_attention
 
 
+def _quantize_rows(x):
+    """Per-row symmetric int8 quantization of (..., D) rows: returns
+    (int8 values, f32 scales shaped (...,)) with x ~= q * scale."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _dequant(cache, scale):
+    return cache.astype(jnp.float32) * scale[..., None].astype(jnp.float32)
+
+
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
     """Zeroed KV cache: {"k","v"}: PER-LAYER tuples of (B, kv_heads,
-    max_len, head_dim) in the compute dtype (kernel layout: the
+    max_len, head_dim) in the compute dtype — or int8 when
+    cfg.kv_cache_dtype == "int8", with per-row f32 dequant scales in
+    extra "k_scale"/"v_scale" tuples (B, kv_heads, max_len), halving
+    the cache bytes — (kernel layout: the
     sequence axis contiguous per (batch, kv head) row, what
     ops/flash_decode.py streams). Per-layer arrays — not one stacked
     (L, ...) block — so each decode step's dynamic_update_slice aliases
@@ -64,14 +82,21 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
     re-materializes every byte every token — measured 25 ms/token at an
     8k cache where the read cost is ~3 ms). GQA stores kv_heads only —
     the cache is n_heads/kv_heads times smaller than MHA's."""
-    dt = jnp.dtype(cfg.dtype)
+    dt = (jnp.int8 if cfg.kv_cache_dtype == "int8"
+          else jnp.dtype(cfg.dtype))
     shape = (batch, cfg.kv_heads, max_len, cfg.head_dim)
     # independent buffers per key AND per layer: sharing one zeros tuple
     # would alias k and v, and a donated jit would then double-donate
     # each buffer (silent copy fallback — exactly the in-place update
     # this layout exists for)
-    fresh = lambda: tuple(jnp.zeros(shape, dt) for _ in range(cfg.n_layers))
-    return {"k": fresh(), "v": fresh()}
+    fresh = lambda sh, d: tuple(jnp.zeros(sh, d)
+                                for _ in range(cfg.n_layers))
+    cache = {"k": fresh(shape, dt), "v": fresh(shape, dt)}
+    if cfg.kv_cache_dtype == "int8":
+        # per-row dequant scales ride alongside (tiny: D times smaller)
+        cache["k_scale"] = fresh(shape[:-1], jnp.float32)
+        cache["v_scale"] = fresh(shape[:-1], jnp.float32)
+    return cache
 
 
 def _mlp(x, lp, cfg: TransformerConfig):
@@ -151,6 +176,13 @@ def prefill(params, prompt, cfg: TransformerConfig, max_len: int):
     x = _rmsnorm(x, params["ln_f_scale"])
     logits = jnp.dot(x[:, -1], params["lm_head"].astype(dt))
     L = cfg.n_layers
+    if cfg.kv_cache_dtype == "int8":
+        kq, ksc = zip(*(_quantize_rows(ks[l]) for l in range(L)))
+        vq, vsc = zip(*(_quantize_rows(vs[l]) for l in range(L)))
+        return logits.astype(jnp.float32), {
+            "k": tuple(kq), "v": tuple(vq),
+            "k_scale": tuple(ksc), "v_scale": tuple(vsc),
+        }
     return logits.astype(jnp.float32), {
         "k": tuple(ks[l] for l in range(L)),
         "v": tuple(vs[l] for l in range(L)),
@@ -172,8 +204,9 @@ def decode_step(params, cache, pos, tokens, cfg: TransformerConfig):
         )
 
     Hkv, g, Dh = cfg.kv_heads, cfg.n_heads // cfg.kv_heads, cfg.head_dim
+    int8_cache = cfg.kv_cache_dtype == "int8"
 
-    def body(h, lp, k_cache, v_cache):
+    def body(h, lp, k_cache, v_cache, k_scale=None, v_scale=None):
         hn = _rmsnorm(h, lp["ln1_scale"])
         q, k_new, v_new = project_qkv(hn, lp, cfg)  # (B, H/Hkv, Dh)
         if cfg.pos_embed == "rope":
@@ -182,12 +215,28 @@ def decode_step(params, cache, pos, tokens, cfg: TransformerConfig):
             # post-rope (see prefill)
             q = apply_rope(q, pos, cfg)
             k_new = apply_rope(k_new, pos, cfg)
-        k_cache = lax.dynamic_update_slice(
-            k_cache, k_new[:, :, None].astype(dt), (0, 0, pos, 0)
-        )
-        v_cache = lax.dynamic_update_slice(
-            v_cache, v_new[:, :, None].astype(dt), (0, 0, pos, 0)
-        )
+        if int8_cache:
+            k_q, k_s = _quantize_rows(k_new)
+            v_q, v_s = _quantize_rows(v_new)
+            k_cache = lax.dynamic_update_slice(
+                k_cache, k_q[:, :, None], (0, 0, pos, 0)
+            )
+            v_cache = lax.dynamic_update_slice(
+                v_cache, v_q[:, :, None], (0, 0, pos, 0)
+            )
+            k_scale = lax.dynamic_update_slice(
+                k_scale, k_s[:, :, None], (0, 0, pos)
+            )
+            v_scale = lax.dynamic_update_slice(
+                v_scale, v_s[:, :, None], (0, 0, pos)
+            )
+        else:
+            k_cache = lax.dynamic_update_slice(
+                k_cache, k_new[:, :, None].astype(dt), (0, 0, pos, 0)
+            )
+            v_cache = lax.dynamic_update_slice(
+                v_cache, v_new[:, :, None].astype(dt), (0, 0, pos, 0)
+            )
         # GQA grouped attention against the UNEXPANDED cache: q head
         # k*g+j (project_qkv's order) reads kv head k directly — no
         # materialized n_heads-wide repeat of the cache, so the per-step
@@ -199,42 +248,59 @@ def decode_step(params, cache, pos, tokens, cfg: TransformerConfig):
             )
 
             o = flash_decode_attention(q, k_cache, v_cache, pos,
+                                       k_scale=k_scale, v_scale=v_scale,
                                        scale=scale)
         else:
+            # ONE gather attention block for both cache dtypes (an int8
+            # cache dequantizes in the einsum stream — elementwise
+            # producers fuse, the HBM reads stay int8).
             # precision=HIGHEST: a TPU f32 einsum at default precision
             # rounds its inputs to bf16 on the MXU; true f32 here both
             # matches the flash kernel's f32 math (greedy tokens agree
             # across impls) and is free — the step is cache-read-bound
+            if int8_cache:
+                kd = _dequant(k_cache, k_scale)
+                vd = _dequant(v_cache, v_scale)
+            else:
+                kd = k_cache.astype(jnp.float32)
+                vd = v_cache.astype(jnp.float32)
             qg = q.reshape(B, Hkv, g, Dh)
             s = jnp.einsum(
-                "bkgd,bksd->bkgs", qg.astype(jnp.float32),
-                k_cache.astype(jnp.float32),
+                "bkgd,bksd->bkgs", qg.astype(jnp.float32), kd,
                 precision=lax.Precision.HIGHEST,
             ) * scale
             visible = lax.broadcasted_iota(jnp.int32, s.shape, 3) <= pos
             s = jnp.where(visible, s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
-            o = jnp.einsum("bkgs,bksd->bkgd", p,
-                           v_cache.astype(jnp.float32),
+            o = jnp.einsum("bkgs,bksd->bkgd", p, vd,
                            precision=lax.Precision.HIGHEST)
         o = jnp.dot(o.reshape(B, cfg.d_model).astype(dt),
                     lp["wo"].astype(dt))
         h = _mlp(h + o, lp, cfg)
-        return h, (k_cache, v_cache)
+        return h, (k_cache, v_cache, k_scale, v_scale)
 
     # UNROLLED layer loop (static per-layer param slices fuse; a lax.scan
     # here would stack the updated caches into a fresh (L, ...) block —
     # a full cache rewrite per token): each layer's cache buffer aliases
     # through the generation scan's carry, so the update is in place
-    ks, vs = [], []
+    ks, vs, kss, vss = [], [], [], []
     for l in range(cfg.n_layers):
         lp = jax.tree.map(lambda a: a[l], params["layers"])
-        x, (k_l, v_l) = body(x, lp, cache["k"][l], cache["v"][l])
+        scales = ({"k_scale": cache["k_scale"][l],
+                   "v_scale": cache["v_scale"][l]} if int8_cache else {})
+        x, (k_l, v_l, ks_l, vs_l) = body(x, lp, cache["k"][l],
+                                         cache["v"][l], **scales)
         ks.append(k_l)
         vs.append(v_l)
+        kss.append(ks_l)
+        vss.append(vs_l)
     x = _rmsnorm(x, params["ln_f_scale"])
     logits = jnp.dot(x, params["lm_head"].astype(dt))
-    return logits.astype(jnp.float32), {"k": tuple(ks), "v": tuple(vs)}
+    new_cache = {"k": tuple(ks), "v": tuple(vs)}
+    if int8_cache:
+        new_cache["k_scale"] = tuple(kss)
+        new_cache["v_scale"] = tuple(vss)
+    return logits.astype(jnp.float32), new_cache
 
 
 def _pick(logits, key, temperature, greedy: bool, top_k: int):

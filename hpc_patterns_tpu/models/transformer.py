@@ -136,6 +136,11 @@ class TransformerConfig:
     # over the full static cache — required for GSPMD-sharded (tp)
     # serving, where einsums partition but a pallas_call does not
     decode_attn: str = "flash"
+    # KV-cache storage dtype for decode: "compute" (the model dtype) or
+    # "int8" (per-row symmetric quantization — HALF the cache bytes and
+    # per-step read traffic on the cache-read-bound decode path;
+    # dequantized in the kernel/einsum stream)
+    kv_cache_dtype: str = "compute"
     # mesh axis names (data / sequence(context) / tensor / expert)
     axis_dp: str = "dp"
     axis_sp: str = "sp"
@@ -195,6 +200,11 @@ class TransformerConfig:
             raise ValueError(
                 f"n_experts_top_k {self.n_experts_top_k} outside "
                 f"[1, n_experts={self.n_experts}]"
+            )
+        if self.kv_cache_dtype not in ("compute", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype {self.kv_cache_dtype!r} not in "
+                "('compute', 'int8')"
             )
         if self.decode_attn not in ("flash", "gather"):
             raise ValueError(
