@@ -303,6 +303,82 @@ def decode_step(params, cache, pos, tokens, cfg: TransformerConfig):
     return logits.astype(jnp.float32), new_cache
 
 
+def extend_step(params, cache, pos, tokens, cfg: TransformerConfig):
+    """Multi-token cache extension: feed ``tokens`` (B, c) occupying
+    positions ``pos .. pos+c-1`` through the model against the existing
+    cache, writing their K/V and returning logits for EVERY chunk
+    position — ``decode_step`` generalized from c=1. The verification
+    primitive of speculative decoding (models/speculative.py): one
+    batched pass scores a whole proposed chunk at large-matmul shapes
+    instead of c sequential single-token steps. Causality within the
+    chunk: query i attends cache rows <= pos+i. Compute-dtype caches
+    only (the c=1 step covers int8 serving), and the attention is the
+    GATHER form regardless of cfg.decode_attn — a c-row query block
+    against the cache is partitioning-friendly XLA territory, and the
+    flash-decode kernel is single-query by design; expect the usual
+    f32-association differences vs sequential flash steps.
+
+    Returns (logits (B, c, vocab) f32, updated cache).
+    """
+    if cfg.kv_cache_dtype != "compute":
+        raise ValueError("extend_step supports compute-dtype caches only")
+    dt = jnp.dtype(cfg.dtype)
+    B, c = tokens.shape
+    scale = 1.0 / (cfg.head_dim ** 0.5)
+    x = params["embed"].astype(dt)[tokens]  # (B, c, D)
+    positions = pos + jnp.arange(c, dtype=jnp.int32)
+    if cfg.pos_embed == "learned":
+        x = x + lax.dynamic_slice_in_dim(
+            params["pos_embed"].astype(dt), pos, c, axis=0
+        )
+
+    Hkv, g, Dh = cfg.kv_heads, cfg.n_heads // cfg.kv_heads, cfg.head_dim
+
+    def body(h, lp, k_cache, v_cache):
+        hn = _rmsnorm(h, lp["ln1_scale"])
+        q, k_new, v_new = project_qkv(hn, lp, cfg)  # (B, c, H/Hkv, Dh)
+        if cfg.pos_embed == "rope":
+            q = apply_rope(q, positions, cfg)
+            k_new = apply_rope(k_new, positions, cfg)
+        # chunk K/V into kernel layout rows at pos..pos+c-1
+        k_cache = lax.dynamic_update_slice(
+            k_cache, jnp.einsum("bchd->bhcd", k_new).astype(dt),
+            (0, 0, pos, 0),
+        )
+        v_cache = lax.dynamic_update_slice(
+            v_cache, jnp.einsum("bchd->bhcd", v_new).astype(dt),
+            (0, 0, pos, 0),
+        )
+        qg = q.reshape(B, c, Hkv, g, Dh)
+        s = jnp.einsum(
+            "bckgd,bksd->bkgcs", qg.astype(jnp.float32),
+            k_cache.astype(jnp.float32),
+            precision=lax.Precision.HIGHEST,
+        ) * scale
+        # query i sees cache rows <= pos+i (its own row included)
+        row_pos = lax.broadcasted_iota(jnp.int32, s.shape, 4)
+        q_pos = pos + lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        s = jnp.where(row_pos <= q_pos, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bkgcs,bksd->bckgd", p,
+                       v_cache.astype(jnp.float32),
+                       precision=lax.Precision.HIGHEST)
+        o = jnp.dot(o.reshape(B, c, cfg.d_model).astype(dt),
+                    lp["wo"].astype(dt))
+        h = _mlp(h + o, lp, cfg)
+        return h, (k_cache, v_cache)
+
+    ks, vs = [], []
+    for l in range(cfg.n_layers):
+        lp = jax.tree.map(lambda a: a[l], params["layers"])
+        x, (k_l, v_l) = body(x, lp, cache["k"][l], cache["v"][l])
+        ks.append(k_l)
+        vs.append(v_l)
+    x = _rmsnorm(x, params["ln_f_scale"])
+    logits = jnp.dot(x, params["lm_head"].astype(dt))
+    return logits.astype(jnp.float32), {"k": tuple(ks), "v": tuple(vs)}
+
+
 def _pick(logits, key, temperature, greedy: bool, top_k: int):
     """Next-token choice. ``greedy`` (static) picks the branch; the
     temperature itself stays traced so every sampling temperature

@@ -1,0 +1,140 @@
+"""Speculative greedy decoding: a small draft model proposes, the
+target model verifies in one batched pass.
+
+The serving-latency play the KV-cache machinery enables: plain greedy
+decode is one big-model forward per token (cache-read-bound,
+benchmarks/RESULTS.md); here a cheap draft model runs ``gamma``
+sequential steps and the target scores the whole proposed chunk with
+ONE ``decode.extend_step`` — large-matmul shapes instead of gamma
+sequential single-token reads. With greedy acceptance the output is
+PROVABLY identical to the target's own greedy decode, whatever the
+draft proposes (the oracle the tests pin): accepted proposals are
+exactly the tokens the target would have picked, and the first
+disagreement is replaced by the target's token.
+
+Bookkeeping invariant (both caches, one shared position cursor): at the
+top of each iteration the caches hold K/V for the prompt and every
+emitted token EXCEPT the last, which is ``cur`` (pending). The draft
+runs gamma+1 steps (the +1 writes the last proposal's K/V so a fully
+accepted round leaves no hole), the target extend writes
+[cur, proposals...]; rejected rows go stale and are simply overwritten
+when the cursor re-crosses them — position masking makes stale rows
+invisible (the same static-shape trick as the cache itself).
+
+Batch is 1 per call: acceptance lengths diverge per sequence, and a
+per-row position cursor cannot drive a single dynamic_update_slice
+(vmap over sequences instead if needed).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from hpc_patterns_tpu.models.decode import (
+    decode_step,
+    extend_step,
+    prefill,
+)
+from hpc_patterns_tpu.models.transformer import TransformerConfig
+
+
+@partial(jax.jit, static_argnums=(1, 3, 5, 6))
+def _speculative_jit(params, cfg, draft_params, draft_cfg, prompt,
+                     new_tokens, gamma):
+    B, T = prompt.shape
+    max_len = T + new_tokens + gamma + 1  # slack for the final round
+    logits, cache = prefill(params, prompt, cfg, max_len)
+    _, dcache = prefill(draft_params, prompt, draft_cfg, max_len)
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (1,)
+
+    out = jnp.zeros((new_tokens + gamma + 1,), jnp.int32)
+    out = out.at[0].set(first[0])
+
+    def cond(state):
+        _, _, _, _, n_out = state
+        return n_out < new_tokens
+
+    def iteration(state):
+        cache, dcache, pos, cur, n_out = state
+        # --- draft proposes gamma tokens (gamma+1 steps: the extra one
+        # writes the last proposal's K/V — see module docstring)
+        props = []
+        tok = cur
+        dc = dcache
+        for j in range(gamma + 1):
+            dlogits, dc = decode_step(draft_params, dc, pos + j, tok,
+                                      draft_cfg)
+            tok = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+            if j < gamma:
+                props.append(tok[0])
+        props = jnp.stack(props)  # (gamma,)
+
+        # --- target verifies [cur, props] in ONE extend
+        chunk = jnp.concatenate([cur, props])[None, :]  # (1, gamma+1)
+        vlogits, cache = extend_step(params, cache, pos, chunk, cfg)
+        t_all = jnp.argmax(vlogits[0], axis=-1).astype(jnp.int32)  # (gamma+1,)
+
+        # longest accepted prefix: props[j] must equal the target's own
+        # next token t_all[j]; a in [0, gamma] by construction
+        matches = (props == t_all[:gamma]).astype(jnp.int32)
+        a = jnp.sum(jnp.cumprod(matches))
+        nxt = t_all[a]  # the target's token at the first disagreement
+        # emitted this round: props[:a] then nxt (positions > a are
+        # filler, overwritten by the next round's slice)
+        props_padded = jnp.concatenate([props, props[-1:]])
+        emit = jnp.where(jnp.arange(gamma + 1) < a, props_padded, nxt)
+        return cache, dc, pos + a + 1, nxt[None], n_out + a + 1, emit
+
+    def body(state_out):
+        state, out = state_out
+        n_out = state[4]
+        cache, dc, pos2, cur2, n_out2, emit = iteration(state)
+        out = lax.dynamic_update_slice(out, emit, (n_out,))
+        return (cache, dc, pos2, cur2, n_out2), out
+
+    state = (cache, dcache, jnp.int32(T), first, jnp.int32(1))
+    (state, out) = lax.while_loop(
+        lambda so: cond(so[0]),
+        body,
+        (state, out),
+    )
+    return out[:new_tokens][None, :]
+
+
+def speculative_generate(params, cfg: TransformerConfig, draft_params,
+                         draft_cfg: TransformerConfig, prompt,
+                         new_tokens: int, *, gamma: int = 4):
+    """Greedy continuation (1, new_tokens) int32, token-identical to
+    ``greedy_generate(params, prompt, cfg, new_tokens)`` — the draft
+    only changes HOW FAST tokens come, never which tokens.
+
+    ``prompt``: (1, T); ``gamma``: proposals per round (the draft/target
+    cost ratio picks it — more acceptance, longer verified chunks).
+    Both configs must share the vocabulary; compute-dtype caches.
+    """
+    if prompt.shape[0] != 1:
+        raise ValueError(
+            "speculative decoding is per-sequence (batch 1): acceptance "
+            "lengths diverge per row; vmap over sequences instead"
+        )
+    if cfg.vocab != draft_cfg.vocab:
+        raise ValueError(
+            f"draft vocab {draft_cfg.vocab} != target vocab {cfg.vocab}"
+        )
+    if new_tokens < 1:
+        raise ValueError(f"new_tokens must be >= 1, got {new_tokens}")
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if prompt.shape[1] + new_tokens + gamma + 1 > min(cfg.max_seq,
+                                                     draft_cfg.max_seq):
+        raise ValueError(
+            f"prompt {prompt.shape[1]} + new {new_tokens} + gamma slack "
+            f"{gamma + 1} exceeds max_seq "
+            f"{min(cfg.max_seq, draft_cfg.max_seq)}"
+        )
+    return _speculative_jit(params, cfg, draft_params, draft_cfg, prompt,
+                            new_tokens, gamma)

@@ -201,8 +201,6 @@ class TestInt8KVCache:
         # per-row int8 quantization: the step logits stay close to the
         # full-precision cache's (the quantization error bound), and
         # the cache is half the bytes
-        from hpc_patterns_tpu.models.decode import decode_step, init_cache
-
         cfg, params, prompt = _setup()
         qcfg = TransformerConfig(**{**BASE, "kv_cache_dtype": "int8"})
         _, cache_f = prefill(params, prompt, cfg, 16)
@@ -227,3 +225,72 @@ class TestInt8KVCache:
     def test_bad_cache_dtype_rejected(self):
         with pytest.raises(ValueError, match="kv_cache_dtype"):
             TransformerConfig(**{**BASE, "kv_cache_dtype": "int4"})
+
+
+class TestSpeculative:
+    """Greedy speculative decoding must emit EXACTLY the target's own
+    greedy tokens — whatever the draft is (the acceptance rule only
+    short-circuits agreement; disagreements are replaced by the
+    target's token)."""
+
+    @pytest.mark.parametrize("gamma", [1, 3, 5])
+    def test_token_identical_to_greedy(self, gamma):
+        from hpc_patterns_tpu.models.speculative import speculative_generate
+
+        cfg, params, prompt = _setup(batch=1)
+        # a DIFFERENT (smaller, differently-seeded) model drafts
+        dcfg = TransformerConfig(**{**BASE, "d_model": 16, "d_ff": 32,
+                                    "n_layers": 1, "n_heads": 2})
+        dparams = init_params(jax.random.PRNGKey(42), dcfg)
+        want = np.asarray(greedy_generate(params, prompt, cfg, 10))
+        got = np.asarray(speculative_generate(
+            params, cfg, dparams, dcfg, prompt, 10, gamma=gamma
+        ))
+        np.testing.assert_array_equal(got, want)
+
+    def test_self_draft_is_still_exact(self):
+        # target drafting for itself: maximal acceptance, same tokens
+        from hpc_patterns_tpu.models.speculative import speculative_generate
+
+        cfg, params, prompt = _setup(batch=1)
+        want = np.asarray(greedy_generate(params, prompt, cfg, 8))
+        got = np.asarray(speculative_generate(
+            params, cfg, params, cfg, prompt, 8, gamma=4
+        ))
+        np.testing.assert_array_equal(got, want)
+
+    def test_guards(self):
+        from hpc_patterns_tpu.models.speculative import speculative_generate
+
+        cfg, params, prompt = _setup(batch=2)
+        with pytest.raises(ValueError, match="batch 1"):
+            speculative_generate(params, cfg, params, cfg, prompt, 4)
+        cfg1, params1, prompt1 = _setup(batch=1)
+        bad = TransformerConfig(**{**BASE, "vocab": 32})
+        with pytest.raises(ValueError, match="vocab"):
+            speculative_generate(params1, cfg1, init_params(
+                jax.random.PRNGKey(1), bad), bad, prompt1, 4)
+
+
+class TestExtendStep:
+    def test_extend_matches_sequential_steps(self):
+        # one c-token extend == c single-token decode_steps: same
+        # logits at every position, same cache contents
+        cfg, params, prompt = _setup()
+        B, T = prompt.shape
+        _, cache_a = prefill(params, prompt, cfg, 16)
+        _, cache_b = prefill(params, prompt, cfg, 16)
+        chunk = jnp.array([[1, 2, 3], [4, 5, 6]], jnp.int32)
+        from hpc_patterns_tpu.models.decode import extend_step
+
+        le, cache_a = extend_step(params, cache_a, jnp.int32(T), chunk, cfg)
+        for j in range(3):
+            lj, cache_b = decode_step(params, cache_b, jnp.int32(T + j),
+                                      chunk[:, j], cfg)
+            np.testing.assert_allclose(np.asarray(le[:, j]),
+                                       np.asarray(lj), atol=2e-4,
+                                       err_msg=f"chunk position {j}")
+        for a, b in zip(jax.tree.leaves(cache_a), jax.tree.leaves(cache_b)):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       atol=2e-5)
