@@ -28,8 +28,12 @@ from hpc_patterns_tpu.models.transformer import (  # noqa: F401
 from hpc_patterns_tpu.models.train import make_train_step, make_optimizer  # noqa: F401
 from hpc_patterns_tpu.models.sharding import param_shardings, batch_sharding  # noqa: F401
 from hpc_patterns_tpu.models.decode import (  # noqa: F401
+    extend_step,
     generate,
     greedy_generate,
     init_cache,
     prefill,
+)
+from hpc_patterns_tpu.models.speculative import (  # noqa: F401
+    speculative_generate,
 )

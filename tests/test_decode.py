@@ -233,14 +233,23 @@ class TestSpeculative:
     short-circuits agreement; disagreements are replaced by the
     target's token)."""
 
+    @pytest.mark.parametrize("over", [
+        {},
+        {"pos_embed": "rope", "n_kv_heads": 2},  # the flagship serving
+        # config: vectorized rope over chunk positions + the grouped
+        # 5-axis extend einsum must stay oracle-exact too
+    ])
     @pytest.mark.parametrize("gamma", [1, 3, 5])
-    def test_token_identical_to_greedy(self, gamma):
+    def test_token_identical_to_greedy(self, gamma, over):
         from hpc_patterns_tpu.models.speculative import speculative_generate
 
-        cfg, params, prompt = _setup(batch=1)
+        cfg, params, prompt = _setup(batch=1, **over)
         # a DIFFERENT (smaller, differently-seeded) model drafts
-        dcfg = TransformerConfig(**{**BASE, "d_model": 16, "d_ff": 32,
-                                    "n_layers": 1, "n_heads": 2})
+        dcfg = TransformerConfig(**{**BASE, **over, "d_model": 16,
+                                    "d_ff": 32, "n_layers": 1,
+                                    "n_heads": 2,
+                                    "n_kv_heads": min(
+                                        2, over.get("n_kv_heads", 0))})
         dparams = init_params(jax.random.PRNGKey(42), dcfg)
         want = np.asarray(greedy_generate(params, prompt, cfg, 10))
         got = np.asarray(speculative_generate(
@@ -273,10 +282,15 @@ class TestSpeculative:
 
 
 class TestExtendStep:
-    def test_extend_matches_sequential_steps(self):
+    @pytest.mark.parametrize("over", [
+        {},
+        {"pos_embed": "rope"},
+        {"n_kv_heads": 2},
+    ])
+    def test_extend_matches_sequential_steps(self, over):
         # one c-token extend == c single-token decode_steps: same
         # logits at every position, same cache contents
-        cfg, params, prompt = _setup()
+        cfg, params, prompt = _setup(**over)
         B, T = prompt.shape
         _, cache_a = prefill(params, prompt, cfg, 16)
         _, cache_b = prefill(params, prompt, cfg, 16)
