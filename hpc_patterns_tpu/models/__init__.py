@@ -36,4 +36,5 @@ from hpc_patterns_tpu.models.decode import (  # noqa: F401
 )
 from hpc_patterns_tpu.models.speculative import (  # noqa: F401
     speculative_generate,
+    speculative_generate_batched,
 )

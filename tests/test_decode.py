@@ -308,3 +308,22 @@ class TestExtendStep:
             np.testing.assert_allclose(np.asarray(a, np.float32),
                                        np.asarray(b, np.float32),
                                        atol=2e-5)
+
+
+class TestSpeculativeBatched:
+    def test_batched_rows_match_greedy(self):
+        from hpc_patterns_tpu.models.speculative import (
+            speculative_generate_batched,
+        )
+
+        cfg, params, _ = _setup(batch=1)
+        dcfg = TransformerConfig(**{**BASE, "d_model": 16, "d_ff": 32,
+                                    "n_layers": 1, "n_heads": 2})
+        dparams = init_params(jax.random.PRNGKey(42), dcfg)
+        prompts = jax.random.randint(jax.random.PRNGKey(9), (3, 8), 0,
+                                     cfg.vocab, jnp.int32)
+        want = np.asarray(greedy_generate(params, prompts, cfg, 10))
+        got = np.asarray(speculative_generate_batched(
+            params, cfg, dparams, dcfg, prompts, 10, gamma=3
+        ))
+        np.testing.assert_array_equal(got, want)
