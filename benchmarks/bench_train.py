@@ -50,6 +50,7 @@ def main():
         loss_chunk=arg("chunk", 0, int),
         remat_policy=arg("rp", "split", str),
         pos_embed=arg("pos", "learned", str),
+        mlp_impl=arg("mlp", "dense", str),
     )
     batch = arg("batch", 8 if on_tpu else 2, int)
     seq = cfg.max_seq

@@ -1,0 +1,185 @@
+"""Probe: do AOT ``compiler_options`` reach the remote TPU compiler?
+
+Round 3 established that ``XLA_FLAGS`` is environment-bound through the
+axon tunnel: the client process's CPU XLA aborts on TPU flag names, and
+the remote compile service pins its own flags (RESULTS.md round 3).
+This probe tests the other route the verdict prescribed:
+``jit(step).lower(args).compile(compiler_options={...})`` ships options
+inside the compile *request*, bypassing the client env entirely.
+
+Protocol (per leg, headline train step — d=1024 L=8 ff=4096 GQA kv=2,
+flash, remat split, B=8 T=2048):
+  1. ``sentinel`` leg: a nonexistent option name. If compile raises, the
+     option string is being parsed by whoever compiles; if it is
+     silently accepted, options are dropped and timings below prove
+     nothing.
+  2. ``base`` leg: AOT compile with no options (same-session baseline).
+  3. flag legs: each candidate option, timed adjacent to base.
+
+All timings use the amortized differencing protocol (two compiles per
+leg: n-iter scan and n/2-iter scan).
+
+Usage: python benchmarks/probe_aot_flags.py [--iters=16]
+"""
+
+import sys
+from functools import partial
+
+import jax
+import optax
+from jax import lax
+
+from hpc_patterns_tpu.harness.timing import measure_forced
+from hpc_patterns_tpu.models import TransformerConfig
+from hpc_patterns_tpu.models.train import (
+    init_train_state,
+    make_batch,
+    make_optimizer,
+)
+from hpc_patterns_tpu.models.transformer import loss_fn
+
+
+def arg(name, default, cast):
+    for a in sys.argv[1:]:
+        if a.startswith(f"--{name}="):
+            return cast(a.split("=", 1)[1])
+    return default
+
+
+# candidate options: the in-situ diagnosis is matmul fusions at ~50%
+# MXU (fusion-context overhead), so the levers are vmem headroom for
+# bigger fusion tiles and the fusion/scheduling cost models. Unknown
+# names are harmless — the remote compiler rejects them and the leg is
+# reported as FAILED. First sweep (2026-07-31, this file's first run):
+# sentinel REJECTED remotely => options reach the compiler;
+# vmem 65536: 0.982x; vmem 98304: 1.063x; scheduler_rerun=2: 1.000x.
+CANDIDATES = [
+    {"xla_tpu_scoped_vmem_limit_kib": "49152"},
+    {"xla_tpu_scoped_vmem_limit_kib": "57344"},
+    {"xla_tpu_scoped_vmem_limit_kib": "65536"},
+    {"xla_tpu_scoped_vmem_limit_kib": "73728"},
+    {"xla_tpu_enable_experimental_fusion_cost_model": "true"},
+    {"xla_tpu_licm_size_inflation_ratio": "10"},
+    {"xla_tpu_rwb_fusion": "false"},
+    {"xla_tpu_enable_dot_strength_reduction": "false"},
+    {"xla_tpu_scoped_vmem_limit_kib": "65536",
+     "xla_tpu_enable_experimental_fusion_cost_model": "true"},
+]
+
+# --confirm=1: the second-pass list — sweep survivors re-measured with
+# the base re-timed before EVERY leg (chip drift over a long sweep is
+# comparable to the effects being measured)
+CONFIRM = [
+    {"xla_tpu_rwb_fusion": "false"},
+    {"xla_tpu_enable_dot_strength_reduction": "false"},
+    {"xla_tpu_rwb_fusion": "false",
+     "xla_tpu_enable_dot_strength_reduction": "false"},
+]
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = TransformerConfig(
+        vocab=32768 if on_tpu else 256,
+        d_model=1024 if on_tpu else 64,
+        n_heads=8 if on_tpu else 4,
+        n_layers=8 if on_tpu else 2,
+        d_ff=4096 if on_tpu else 128,
+        max_seq=2048 if on_tpu else 64,
+        dtype="bfloat16",
+        attention="flash" if on_tpu else "full",
+        remat=True,
+        remat_policy="split",
+        n_kv_heads=2 if on_tpu else 0,
+    )
+    batch = 8 if on_tpu else 2
+    iters = arg("iters", 16 if on_tpu else 4, int)
+    optimizer = make_optimizer()
+    params, opt_state = init_train_state(
+        jax.random.PRNGKey(0), cfg, optimizer=optimizer
+    )
+    tokens = make_batch(jax.random.PRNGKey(1), cfg, batch, cfg.max_seq)
+
+    def run_t(carry, tokens, n):
+        def one_step(carry, _):
+            params, opt_state = carry
+            loss, grads = jax.value_and_grad(partial(loss_fn, cfg=cfg))(
+                params, tokens
+            )
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), loss
+
+        _, losses = lax.scan(one_step, carry, None, length=n)
+        return losses[-1]
+
+    def compile_leg(options):
+        jitted = jax.jit(run_t, static_argnums=(2,))
+        out = []
+        for n in (iters, iters // 2):
+            lowered = jitted.lower((params, opt_state), tokens, n)
+            out.append(lowered.compile(compiler_options=options))
+        return out  # [compiled_many, compiled_base]
+
+    def time_leg(compiled_pair):
+        t_many = measure_forced(
+            lambda: compiled_pair[0]((params, opt_state), tokens),
+            repetitions=3,
+        ).min_s
+        t_base = measure_forced(
+            lambda: compiled_pair[1]((params, opt_state), tokens),
+            repetitions=3,
+        ).min_s
+        return max(t_many - t_base, 0.0) / (iters - iters // 2)
+
+    confirm = bool(arg("confirm", 0, int))
+    candidates = CONFIRM if confirm else CANDIDATES
+    # confirm mode re-times the base before EVERY leg: chip drift over a
+    # long sweep is comparable to the effects being measured
+    retime_every = 1 if confirm else 3
+
+    # --- leg 1: sentinel (first pass only) ---
+    sentinel_parsed = None
+    if not confirm:
+        try:
+            jax.jit(run_t, static_argnums=(2,)).lower(
+                (params, opt_state), tokens, 2
+            ).compile(
+                compiler_options={"xla_probe_nonexistent_option_xyz": "1"}
+            )
+            print("sentinel: ACCEPTED silently -> options are likely "
+                  "DROPPED before any compiler parses them")
+            sentinel_parsed = False
+        except Exception as e:
+            print(f"sentinel: REJECTED ({type(e).__name__}: "
+                  f"{str(e)[:200]}) -> options are parsed; flag legs are "
+                  "meaningful")
+            sentinel_parsed = True
+
+    # --- leg 2: base (kept compiled; re-timed periodically so chip
+    # drift within the session is visible, per the adjacency protocol) ---
+    base_pair = compile_leg(None)
+    base = time_leg(base_pair)
+    print(f"base (AOT, no options): {base * 1e3:.2f} ms/step", flush=True)
+
+    # --- flag legs ---
+    for idx, options in enumerate(candidates):
+        if idx and idx % retime_every == 0:
+            base = time_leg(base_pair)
+            print(f"base (re-timed): {base * 1e3:.2f} ms/step", flush=True)
+        name = ", ".join(f"{k}={v}" for k, v in options.items())
+        try:
+            pair = compile_leg(options)
+        except Exception as e:
+            print(f"{name}: compile FAILED "
+                  f"({type(e).__name__}: {str(e)[:200]})", flush=True)
+            continue
+        t = time_leg(pair)
+        print(f"{name}: {t * 1e3:.2f} ms/step ({t / base:.3f}x of base)",
+              flush=True)
+
+    print(f"sentinel_parsed={sentinel_parsed}")
+
+
+if __name__ == "__main__":
+    main()

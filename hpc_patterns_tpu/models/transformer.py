@@ -130,6 +130,13 @@ class TransformerConfig:
     # (see chunked_masked_causal_nll). Must divide vocab. Training-loss
     # path only (eval/decode read real logits).
     loss_chunk: int = 0
+    # training MLP implementation: "dense" = two XLA einsums (gelu
+    # fused by XLA; the (N, d_ff) activation materializes in HBM
+    # between them), "fused" = the Pallas fused kernel
+    # (ops/fused_mlp.py — matmul→gelu→matmul streamed through VMEM,
+    # d_ff activation never in HBM; one-pass fused backward). Dense
+    # MLP layers only (MoE routes through parallel/moe.py)
+    mlp_impl: str = "dense"
     # decode-step attention against the KV cache (models/decode.py):
     # "flash" = the single-query Pallas kernel streaming the live cache
     # prefix (ops/flash_decode.py); "gather" = the XLA einsum+mask path
@@ -209,6 +216,10 @@ class TransformerConfig:
         if self.decode_attn not in ("flash", "gather"):
             raise ValueError(
                 f"decode_attn {self.decode_attn!r} not in ('flash', 'gather')"
+            )
+        if self.mlp_impl not in ("dense", "fused"):
+            raise ValueError(
+                f"mlp_impl {self.mlp_impl!r} not in ('dense', 'fused')"
             )
         if self.remat_policy not in ("nothing", "attn", "dots", "dots_attn",
                                      "split"):
@@ -364,9 +375,12 @@ def _moe_block(h, lp, cfg: TransformerConfig, mesh, with_stats=False):
         # vs 199.4 ms/step at 4k tokens, adjacent runs) and strictly
         # enabling at scale (the 16k-token config OOMs under einsum,
         # trains at 436.8 ms/step under scatter) — einsum remains the
-        # oracle form and the tiny-shape default
+        # oracle form and the tiny-shape default. The footprint counts
+        # BOTH live one-hots (dispatch and combine) at their choice-major
+        # (k*N, E, C) f32 shape — not one (N, E, C) tensor, which
+        # undercounted by 2k and flipped to scatter late
         return ("scatter"
-                if n_local * cfg.n_experts * cap * 4 > 16 << 20
+                if 2 * k * n_local * cfg.n_experts * cap * 4 > 16 << 20
                 else "einsum")
 
     if mesh is None:
@@ -479,24 +493,71 @@ def _qkv_block(x, lp, cfg: TransformerConfig, mesh):
     return q, k, v
 
 
+def _post_attn(x, o, lp, cfg: TransformerConfig, mesh, act_spec):
+    """Output projection + residual + pre-MLP norm: the first half of
+    :func:`_post_block`, split out so split-remat can checkpoint it
+    while the fused MLP kernel stays OUTSIDE the remat region (same
+    reasoning as the attention kernel — a custom_vjp's residuals can't
+    be saved by any policy from outside the call)."""
+    B, T, D = x.shape
+    dt = x.dtype
+    o = jnp.dot(o.reshape(B, T, D), lp["wo"].astype(dt))  # row-parallel
+    x = x + o
+    if mesh is not None:
+        x = lax.with_sharding_constraint(x, act_spec)
+    return x, _rmsnorm(x, lp["ln2_scale"])
+
+
+def _mlp_fused(h, lp, cfg: TransformerConfig, mesh):
+    """The Pallas fused MLP on ``h`` (post-norm activations). Single
+    device runs the kernel directly; under a mesh it runs shard_mapped
+    (a pallas_call does not GSPMD-partition): tokens stay
+    (batch, sp)-sharded, w1/w2 enter column/row-sharded over tp, and
+    the row-parallel psum closes the block — the manual spelling of
+    exactly the collective XLA inserts for the einsum path."""
+    from hpc_patterns_tpu.ops.fused_mlp import fused_mlp
+
+    dt = h.dtype
+    w1 = lp["w1"].astype(dt)
+    w2 = lp["w2"].astype(dt)
+    if mesh is None:
+        return fused_mlp(h, w1, w2)
+    tp = cfg.axis_tp
+    has_tp = mesh_axis_size(mesh, tp) > 1
+    x_spec = resolve_spec(P(cfg.batch_axes, cfg.axis_sp, None), mesh,
+                          cfg.mesh_axes)
+    w1_spec = resolve_spec(P(None, tp), mesh, cfg.mesh_axes)
+    w2_spec = resolve_spec(P(tp, None), mesh, cfg.mesh_axes)
+
+    def local(h, w1, w2):
+        y = fused_mlp(h, w1, w2)
+        return lax.psum(y, tp) if has_tp else y
+
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(x_spec, w1_spec, w2_spec),
+        out_specs=x_spec,
+        check_vma=False,  # pallas_call can't declare vma
+    )(h, w1, w2)
+
+
 def _post_block(x, o, lp, cfg: TransformerConfig, mesh, act_spec,
                 with_stats=False):
     """Post-attention: output projection, residual, norm, mlp/moe.
     Returns (x, moe_aux) — with ``with_stats`` also the MoE kept
     fraction (1.0 for dense layers)."""
-    B, T, D = x.shape
     dt = x.dtype
 
     def c(y, spec):
         return lax.with_sharding_constraint(y, spec) if mesh is not None else y
 
-    o = jnp.dot(o.reshape(B, T, D), lp["wo"].astype(dt))  # row-parallel
-    x = c(x + o, act_spec)
-
-    h = _rmsnorm(x, lp["ln2_scale"])
+    x, h = _post_attn(x, o, lp, cfg, mesh, act_spec)
     if cfg.n_experts:
         h, aux, *st = _moe_block(h, lp, cfg, mesh, with_stats=with_stats)
         h = h.astype(dt)
+    elif cfg.mlp_impl == "fused":
+        h = _mlp_fused(h, lp, cfg, mesh).astype(dt)
+        aux = jnp.zeros((), jnp.float32)
+        st = [jnp.ones((), jnp.float32)] if with_stats else []
     else:
         h = jax.nn.gelu(jnp.dot(h, lp["w1"].astype(dt)))  # column-parallel
         h = jnp.dot(h, lp["w2"].astype(dt))  # row-parallel (psum by XLA)
@@ -518,6 +579,8 @@ def _layer(x, lp, cfg: TransformerConfig, mesh, act_spec,
     kernel call; see TransformerConfig.remat_policy)."""
     pre = partial(_qkv_block, cfg=cfg, mesh=mesh)
     post = partial(_post_block, cfg=cfg, mesh=mesh, act_spec=act_spec)
+    fused_split = (split_remat and cfg.mlp_impl == "fused"
+                   and not cfg.n_experts)
     if split_remat:
         # dots policy inside each block: elementwise interiors (rope,
         # norms, gelu) recompute, matmul outputs don't — recomputing
@@ -529,6 +592,21 @@ def _layer(x, lp, cfg: TransformerConfig, mesh, act_spec,
     o = _attention(q, k, v, cfg, mesh)
     # named so remat_policy="attn" can pin it under whole-layer remat
     o = checkpoint_name(o, "attn_out")
+    if fused_split:
+        # like attention, the fused MLP kernel must live OUTSIDE the
+        # remat region or its one-pass backward replays the forward:
+        # checkpoint only the o-proj/residual/norm half, then run the
+        # kernel on the saved norm output
+        pa = jax.checkpoint(
+            partial(_post_attn, cfg=cfg, mesh=mesh, act_spec=act_spec),
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+        x1, hn = pa(x, o, lp)
+        h = _mlp_fused(hn, lp, cfg, mesh).astype(x.dtype)
+        out = x1 + h
+        if mesh is not None:
+            out = lax.with_sharding_constraint(out, act_spec)
+        return out, jnp.zeros((), jnp.float32)
     return post(x, o, lp)
 
 
@@ -547,6 +625,35 @@ def forward(params, tokens, cfg: TransformerConfig, mesh=None, *,
     return logits
 
 
+def _embed_tokens(params, tokens, cfg: TransformerConfig, mesh, dt):
+    """Token + learned-position embedding lookup. Under fsdp the bf16
+    working copies of the feature-sharded tables are constrained
+    replicated BEFORE the gather — the explicit form of ZeRO-3's
+    all-gather-weights-just-before-use. Without it the partitioner must
+    inverse-reshard the batch-sharded activation cotangent into the
+    feature-sharded table layout in the backward, which it can only do
+    by "involuntary full rematerialization" (observed as
+    spmd_partitioner warnings on the fsdp dryrun leg); the explicit
+    replication compiles to a plain feature all-gather forward and a
+    reduce-scatter backward instead."""
+    T = tokens.shape[1]
+    replicate = mesh is not None and cfg.fsdp
+    emb = params["embed"].astype(dt)
+    if replicate:
+        emb = lax.with_sharding_constraint(
+            emb, jax.sharding.NamedSharding(mesh, P())
+        )
+    x = emb[tokens]
+    if cfg.pos_embed == "learned":
+        pos = params["pos_embed"].astype(dt)
+        if replicate:
+            pos = lax.with_sharding_constraint(
+                pos, jax.sharding.NamedSharding(mesh, P())
+            )
+        x = x + pos[:T]
+    return x
+
+
 def forward_hidden(params, tokens, cfg: TransformerConfig, mesh=None):
     """The trunk of :func:`forward` WITHOUT the LM head: final-norm
     hidden states (B, T, d_model) in compute dtype, plus the summed MoE
@@ -561,9 +668,7 @@ def forward_hidden(params, tokens, cfg: TransformerConfig, mesh=None):
         )
     else:
         act_spec = None
-    x = params["embed"].astype(dt)[tokens]
-    if cfg.pos_embed == "learned":
-        x = x + params["pos_embed"].astype(dt)[:T]
+    x = _embed_tokens(params, tokens, cfg, mesh, dt)
     if mesh is not None:
         x = lax.with_sharding_constraint(x, act_spec)
 
@@ -615,9 +720,7 @@ def moe_drop_rates(params, tokens, cfg: TransformerConfig, mesh=None):
         )
     else:
         act_spec = None
-    x = params["embed"].astype(dt)[tokens]
-    if cfg.pos_embed == "learned":
-        x = x + params["pos_embed"].astype(dt)[:T]
+    x = _embed_tokens(params, tokens, cfg, mesh, dt)
     if mesh is not None:
         x = lax.with_sharding_constraint(x, act_spec)
 

@@ -584,7 +584,11 @@ def _backward_impl(qr, kr, vr, outr, lse, offs, g, g_lse, *, causal, scale,
     # auto ladder steps to (512, 2048) — doubling block_k halves the
     # slab (fewer kv chunks), and block_q must drop to keep the kernel
     # inside VMEM — before giving up and going two-pass.
-    if block_q_bwd is None and block_q is None:
+    # the heuristic only fires when the caller pinned NOTHING: an
+    # explicit forward block_q or block_k carries into the backward
+    # (the resolve below falls back to them), and block_q_bwd/block_k_bwd
+    # pin the backward outright
+    if block_q_bwd is None and block_q is None and block_k is None:
         block_q_bwd = 1024
         if bwd in (None, "auto", "fused") and Tk >= 4096:
             slab_at = lambda bk: (Tk // bk) * B * H * Tq * D *                 jnp.dtype(qr.dtype).itemsize

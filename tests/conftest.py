@@ -35,9 +35,42 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-import re
-
 import pytest  # noqa: E402
+
+
+def _markexpr_selects_slow(markexpr: str) -> bool:
+    """True when the ``-m`` expression can select a slow-marked item —
+    evaluated with pytest's own expression engine, so parenthesized and
+    oddly-spaced forms (``not (slow)``, ``not  slow``) resolve the same
+    way pytest's selection will, instead of a regex approximation."""
+    if not markexpr:
+        return False
+    try:
+        from _pytest.mark.expression import Expression
+
+        expr = Expression.compile(markexpr)
+        # Two conditions, both required:
+        # 1. satisfiable by SOME slow-marked item — modeled as an item
+        #    marked only 'slow' and one marked 'slow' plus everything
+        #    else, so conjunctions like "slow and tpu" count;
+        # 2. the expression actually MENTIONS 'slow' — the tier is
+        #    explicit opt-in, so "not tpu" (satisfiable by a slow-only
+        #    item, but not asking for slow) keeps the fast tier.
+        names = set()
+
+        def matcher(name, extra):
+            names.add(name)
+            return name == "slow" or extra
+
+        sat = any(
+            bool(expr.evaluate(lambda n, e=extra: matcher(n, e)))
+            for extra in (False, True)
+        )
+        return sat and "slow" in names
+    except Exception:
+        # unparseable expression (pytest will error on it anyway):
+        # keep the skip wiring out of the way
+        return "slow" in markexpr
 
 
 def pytest_addoption(parser):
@@ -52,11 +85,11 @@ def pytest_collection_modifyitems(config, items):
     # two-tier suite: `pytest -q` = fast tier (< 5 min on the 8-device
     # CPU mesh); `pytest -q --slow` (or `-m slow`) adds the rest. CI
     # runs both: `pytest -q && pytest -q -m slow`.
-    # word-boundary match: `-m slow` (and expressions containing the
-    # bare marker) disable the skip, but `-m "not slow"` and custom
-    # markers merely containing the substring don't
+    # `-m slow` (and any expression a slow-marked item satisfies)
+    # disables the skip; `-m "not slow"` and expressions that merely
+    # contain the substring don't
     markexpr = config.getoption("-m") or ""
-    if config.getoption("--slow") or re.search(r"(?<!not )\bslow\b", markexpr):
+    if config.getoption("--slow") or _markexpr_selects_slow(markexpr):
         return
     skip = pytest.mark.skip(reason="slow tier (run with --slow or -m slow)")
     for item in items:
