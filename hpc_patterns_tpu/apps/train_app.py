@@ -88,6 +88,16 @@ def build_parser():
                    help="routing dispatch: one-hot einsum (oracle form) "
                         "or stable-sort scatter (O(N+E*C) memory); auto "
                         "switches to scatter past ~16 MB of one-hots")
+    p.add_argument("--mlp-impl", default="dense",
+                   choices=["dense", "fused"],
+                   help="dense-layer MLP: XLA einsums, or the Pallas "
+                        "fused matmul-gelu-matmul kernel (the d_ff "
+                        "activation never materializes in HBM)")
+    p.add_argument("--drop-rate-every", type=int, default=10, metavar="N",
+                   help="sample the MoE routing-drop telemetry every N "
+                        "steps (0 = off). The diagnostic is a second "
+                        "forward pass — at every step it would cost "
+                        "~25-30%% wall clock, so it is sampled")
     p.add_argument("--prefetch", type=int, default=0, metavar="DEPTH",
                    help="stream fresh synthetic batches through the async "
                         "prefetch loader (0 = one static batch)")
@@ -179,7 +189,7 @@ def _train_loop(args, log, cfg, mesh, params, opt_state, step_fn, *,
     ckpt_path = None
     diverged = False
     drop_rates_fn = None
-    if cfg.n_experts and args.pp <= 1:
+    if cfg.n_experts and args.pp <= 1 and args.drop_rate_every > 0:
         # routing-drop telemetry: built ONCE (a fresh jit wrapper per
         # step would re-trace the whole forward every step)
         from hpc_patterns_tpu.models.transformer import moe_drop_rates
@@ -193,10 +203,10 @@ def _train_loop(args, log, cfg, mesh, params, opt_state, step_fn, *,
         t_steps.append(time.perf_counter() - t0)
         losses.append(loss_val)
         extra = {}
-        if drop_rates_fn is not None:
+        if drop_rates_fn is not None and i % args.drop_rate_every == 0:
             # capacity drops during training are otherwise invisible
             # (they surface only as quality loss): one diagnostic
-            # forward on this step's batch
+            # forward on the sampled step's batch
             drops = drop_rates_fn(params, batch)
             extra["moe_drop_rate"] = round(float(drops.max()), 4)
         log.emit(kind="step", step=i, loss=loss_val, dt_s=t_steps[-1],
@@ -310,14 +320,17 @@ def _train_loop(args, log, cfg, mesh, params, opt_state, step_fn, *,
 
 def _run_pp(args, log, cfg) -> int:
     """--pp path: 1F1B pipeline training (models/pp.py), optionally
-    data-parallel and/or MoE (aux loss threaded through the schedule);
-    stage-local math only (no sp/tp/ep axes inside stages)."""
+    data-parallel (--dp, incl. --dcn-dp across slices), ZeRO-3 stage
+    params (--fsdp), host-offloaded optimizer state (--offload-opt),
+    and/or MoE (aux loss threaded through the schedule); stage-local
+    math only (no sp/tp/ep axes inside stages)."""
     from hpc_patterns_tpu.models import pp as pplib
 
     if args.sp > 1 or args.tp > 1 or args.ep > 1:
-        log.print("ERROR: --pp composes with --dp and --n-experts only "
-                  "(stage-local math; no sp/tp/ep axes inside pipeline "
-                  "stages — MoE experts route densely per stage)")
+        log.print("ERROR: --pp composes with --dp/--fsdp/--dcn-dp/"
+                  "--offload-opt and --n-experts only (stage-local "
+                  "math; no sp/tp/ep axes inside pipeline stages — MoE "
+                  "experts route densely per stage)")
         log.print("FAILURE")
         return 1
     if args.attention not in ("full", "flash"):
@@ -335,29 +348,81 @@ def _run_pp(args, log, cfg) -> int:
                   f"--pp {args.pp}")
         log.print("FAILURE")
         return 1
-    if args.batch % (args.microbatches * args.dp):
-        log.print(f"ERROR: --batch {args.batch} must divide by "
-                  f"--microbatches*--dp = {args.microbatches * args.dp}")
-        log.print("FAILURE")
-        return 1
 
     devices = topology.get_devices(args.backend)
-    axes = ({"dp": args.dp, "pp": args.pp} if args.dp > 1
-            else {"pp": args.pp})
-    mesh = topology.make_mesh(axes, devices[:args.dp * args.pp])
+    fs = args.fsdp if args.fsdp > 1 else 1
+    if args.dcn_dp:
+        # dp ACROSS slices: the once-per-step gradient pmean is the
+        # latency-tolerant collective; fsdp gathers and the per-tick
+        # stage ppermutes stay slice-internal (pp innermost = fastest
+        # ICI neighbors)
+        groups = topology.group_by_slice(devices)
+        n_slices = len(groups)
+        dp = n_slices if args.dp == -1 else args.dp
+        if dp != n_slices:
+            log.print(f"ERROR: --dcn-dp places dp across slices: --dp "
+                      f"{args.dp} != slice count {n_slices} (use -1 for "
+                      "auto)")
+            log.print("FAILURE")
+            return 1
+        ici = ({"fsdp": fs} if fs > 1 else {}) | {"pp": args.pp}
+        picked = [d for s in sorted(groups)
+                  for d in groups[s][:fs * args.pp]]
+        try:
+            mesh = topology.make_hybrid_mesh({"dp": dp}, ici, picked)
+        except topology.TopologyError as e:
+            log.print(f"ERROR: --dcn-dp: {e}")
+            log.print("FAILURE")
+            return 1
+    else:
+        dp = args.dp
+        axes = {}
+        if dp > 1:
+            axes["dp"] = dp
+        if fs > 1:
+            axes["fsdp"] = fs
+        axes["pp"] = args.pp
+        mesh = topology.make_mesh(axes, devices[:max(dp, 1) * fs * args.pp])
+    if args.batch % (args.microbatches * max(dp, 1) * fs):
+        log.print(f"ERROR: --batch {args.batch} must divide by "
+                  f"--microbatches*--dp*--fsdp = "
+                  f"{args.microbatches * max(dp, 1) * fs}")
+        log.print("FAILURE")
+        return 1
     optimizer = _make_cli_optimizer(args, log)
     if optimizer is None:
         return 1
-    params, opt_state = pplib.init_pp_train_state(jax.random.PRNGKey(0), cfg,
-                                                  optimizer=optimizer)
+    axis_fsdp = "fsdp" if fs > 1 else None
+    params, opt_state = pplib.init_pp_train_state(
+        jax.random.PRNGKey(0), cfg, optimizer=optimizer,
+        mesh=mesh if axis_fsdp else None, axis_fsdp=axis_fsdp,
+    )
+    offload_example = None
+    if args.offload_opt:
+        # same platform gating as the sharded-train path: host-memory
+        # compute annotations are TPU-only
+        if mesh.devices.flat[0].platform != "tpu":
+            log.print("note: --offload-opt needs a TPU backend "
+                      "(host-memory compute annotations); ignoring")
+        else:
+            from hpc_patterns_tpu.models.train import offload_opt_state
+
+            opt_state = offload_opt_state(opt_state)
+            offload_example = opt_state
+            log.print("optimizer state offloaded to pinned_host")
     step_fn = pplib.make_pp_train_step(
         cfg, mesh, microbatches=args.microbatches,
-        axis_dp="dp" if args.dp > 1 else None, optimizer=optimizer,
+        axis_dp="dp" if dp > 1 else None, axis_fsdp=axis_fsdp,
+        optimizer=optimizer, offload_opt_example=offload_example,
     )
+    label = f"pp={args.pp} 1f1b"
+    if fs > 1:
+        label += f" fsdp={fs}"
+    if args.dcn_dp:
+        label += f" dcn-dp={dp}"
     return _train_loop(
         args, log, cfg, mesh, params, opt_state, step_fn, name="train_pp",
-        result_extra={"microbatches": args.microbatches,
-                      "label": f"pp={args.pp} 1f1b"},
+        result_extra={"microbatches": args.microbatches, "label": label},
     )
 
 
@@ -378,11 +443,6 @@ def run(args) -> int:
     if args.accum > 1 and args.pp > 1:
         log.print("ERROR: --accum composes with the sharded-train path; "
                   "--pp already micro-batches via --microbatches")
-        log.print("FAILURE")
-        return 1
-    if args.offload_opt and args.pp > 1:
-        log.print("ERROR: --offload-opt composes with the sharded-train "
-                  "path only (the pp state lives inside the shard_map)")
         log.print("FAILURE")
         return 1
     if args.remat_policy != "split" and not args.remat:
@@ -414,23 +474,13 @@ def run(args) -> int:
             n_kv_heads=args.n_kv_heads, pos_embed=args.pos_embed,
             fsdp=args.fsdp > 1, remat_policy=args.remat_policy,
             loss_chunk=args.loss_chunk,
+            mlp_impl=args.mlp_impl,
         )
     except ValueError as e:
         log.print(f"ERROR: {e}")
         log.print("FAILURE")
         return 1
     if args.pp > 1:
-        if args.fsdp > 1:
-            log.print("ERROR: --fsdp is not supported with --pp (stage "
-                      "params live inside the pipeline shard_map); use "
-                      "--fsdp with the dp/sp/tp/ep train path")
-            log.print("FAILURE")
-            return 1
-        if args.dcn_dp:
-            log.print("ERROR: --dcn-dp is not supported with --pp; use "
-                      "it on the dp/sp/tp/ep train path")
-            log.print("FAILURE")
-            return 1
         return _run_pp(args, log, cfg)
     if args.attention == "flash" and args.sp > 1:
         log.print("ERROR: attention='flash' needs the sequence unsharded "

@@ -24,7 +24,20 @@ property: collectives on device-resident shards, no host staging.
 
 Composes with data parallelism: on a ("dp", "pp") mesh the batch is
 dp-sharded outside, the pipeline runs per dp-slice, and gradients are
-pmean'd over dp.
+pmean'd over dp. The dp axis may cross slices (a DCN axis from
+topology.make_hybrid_mesh): the once-per-step gradient pmean is the
+latency-tolerant collective, while the per-tick stage ppermutes stay
+slice-internal.
+
+Composes with FSDP (ZeRO-3) over an ``fsdp`` mesh axis: stage params
+are stored sharded on a feature dim (the same per-weight dims as
+models/sharding.param_specs), all-gathered JUST BEFORE the stage scan
+inside the pipeline shard_map, and their gradients leave as a
+reduce-scatter (psum_scatter) back to the shard — params, grads, AND
+optimizer state hold 1/fsdp of each stage weight per rank. The batch
+shards over (dp, fsdp) together, like the non-pp fsdp path. The
+embedding/head stay replicated (they are not stage params; shard them
+over fsdp via the vocab dim if they ever dominate).
 
 Composes with MoE: stages return their load-balance aux loss alongside
 the activation and the 1F1B schedule threads it through
@@ -103,17 +116,55 @@ def _loss_head(lp, y, target_tokens, *, loss_chunk: int = 0):
     return masked_causal_nll(logits, target_tokens)
 
 
+def _pp_layer_specs(cfg: TransformerConfig, axis_pp: str,
+                    axis_fsdp: str | None):
+    """Per-leaf PartitionSpecs for the stacked layer params inside the
+    pipeline: leading ``n_layers`` axis over pp, and (with
+    ``axis_fsdp``) the same per-weight feature dim models/
+    sharding.param_specs shards under fsdp — one rule table, two
+    parallelism schemes. tp/ep axes are dropped (no such axes inside
+    pipeline stages)."""
+    import dataclasses
+
+    from hpc_patterns_tpu.models import sharding as shardlib
+
+    base = shardlib.param_specs(
+        dataclasses.replace(cfg, fsdp=bool(axis_fsdp),
+                            axis_fsdp=axis_fsdp or "fsdp")
+    )["layers"]
+
+    def fix(spec):
+        rest = [ax if ax == axis_fsdp else None for ax in spec[1:]]
+        return P(axis_pp, *rest)
+
+    return jax.tree.map(fix, base, is_leaf=lambda x: isinstance(x, P))
+
+
+def _fsdp_dim(spec, axis_fsdp):
+    """Index of the fsdp-sharded dim in a layer-leaf spec (None when
+    the leaf is replicated over fsdp — norm scales, router)."""
+    for i, ax in enumerate(spec):
+        if ax == axis_fsdp:
+            return i
+    return None
+
+
 def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
                       *, microbatches: int, axis_pp: str = "pp",
-                      axis_dp: str | None = None):
+                      axis_dp: str | None = None,
+                      axis_fsdp: str | None = None):
     """Mean causal-LM loss and full-parameter gradients via a 1F1B
-    pipeline over ``axis_pp`` (optionally data-parallel over ``axis_dp``).
+    pipeline over ``axis_pp`` (optionally data-parallel over ``axis_dp``
+    and/or ZeRO-3-sharded over ``axis_fsdp`` — see module docstring).
 
     ``params``: the standard init_params pytree (layers stacked on
-    n_layers, which must divide by the pp axis size); ``tokens``:
-    (batch, seq) int32, batch divisible by microbatches (× dp size).
-    Loss and gradients are replicated on return (pipeline-internal
-    validity masks are resolved by psum/pmean over the mesh axes).
+    n_layers, which must divide by the pp axis size); with
+    ``axis_fsdp``, layer leaves sharded per
+    :func:`init_pp_train_state`'s placement. ``tokens``: (batch, seq)
+    int32, batch divisible by microbatches (× dp × fsdp size).
+    Loss, embedding, and head gradients are replicated on return;
+    layer gradients return fsdp-sharded when ``axis_fsdp`` is set
+    (matching the param storage, what the optimizer update consumes).
     """
     M = microbatches
     pp = mesh.shape[axis_pp]
@@ -122,8 +173,23 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
         raise ValueError(f"n_layers {L} must divide by pp={pp}")
     B = tokens.shape[0]
     dp = mesh.shape[axis_dp] if axis_dp else 1
-    if B % (M * dp):
-        raise ValueError(f"batch {B} must divide by microbatches*dp={M * dp}")
+    fs = mesh.shape[axis_fsdp] if axis_fsdp else 1
+    if B % (M * dp * fs):
+        raise ValueError(
+            f"batch {B} must divide by microbatches*dp*fsdp={M * dp * fs}"
+        )
+    layer_specs = _pp_layer_specs(cfg, axis_pp, axis_fsdp)
+    if axis_fsdp:
+        for name, spec in layer_specs.items():
+            d = _fsdp_dim(spec, axis_fsdp)
+            if d is None:
+                continue
+            size = params["layers"][name].shape[d]
+            if size % fs:
+                raise ValueError(
+                    f"layers[{name}] dim {d} ({size}) must divide by "
+                    f"fsdp={fs}"
+                )
 
     outer = {"embed": params["embed"]}
     if cfg.pos_embed == "learned":
@@ -134,9 +200,24 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
         toks = tokens_local.reshape(M, -1, tokens_local.shape[-1])
         x_mb = _embed(outer, toks, cfg)
 
+        if axis_fsdp:
+            # ZeRO-3 gather: materialize this stage's full weights just
+            # before use (the stored shard is 1/fs of each feature dim)
+            layers_full = {
+                k: (v if _fsdp_dim(layer_specs[k], axis_fsdp) is None
+                    else lax.all_gather(
+                        v, axis_fsdp,
+                        axis=_fsdp_dim(layer_specs[k], axis_fsdp),
+                        tiled=True,
+                    ))
+                for k, v in layers_shard.items()
+            }
+        else:
+            layers_full = layers_shard
+
         loss, layer_grads, extras = pipeline_train_1f1b(
             partial(_stage_fn, cfg=cfg),
-            layers_shard,
+            layers_full,
             x_mb,
             toks,
             partial(_loss_head, loss_chunk=cfg.loss_chunk),
@@ -171,23 +252,44 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
             ),
             outer_grads,
         )
-        grads_all = (outer_grads, layer_grads, head_grads)
+        if axis_fsdp:
+            # ZeRO-3 reduce-scatter: each rank keeps the grad tile of
+            # the shard it stores; /fs makes it the MEAN over the fsdp
+            # batch shards (the dp convention)
+            layer_grads = {
+                k: (lax.pmean(g, axis_fsdp)
+                    if _fsdp_dim(layer_specs[k], axis_fsdp) is None
+                    else lax.psum_scatter(
+                        g, axis_fsdp,
+                        scatter_dimension=_fsdp_dim(layer_specs[k],
+                                                    axis_fsdp),
+                        tiled=True,
+                    ) / fs)
+                for k, g in layer_grads.items()
+            }
+        small = (outer_grads, head_grads)
+        for ax in (axis_dp, axis_fsdp):
+            if ax:
+                loss = lax.pmean(loss, ax)
+                small = jax.tree.map(lambda g: lax.pmean(g, ax), small)
         if axis_dp:
-            loss = lax.pmean(loss, axis_dp)
-            grads_all = jax.tree.map(lambda g: lax.pmean(g, axis_dp),
-                                     grads_all)
+            layer_grads = jax.tree.map(
+                lambda g: lax.pmean(g, axis_dp), layer_grads
+            )
+        outer_grads, head_grads = small
+        grads_all = (outer_grads, layer_grads, head_grads)
         # grads are summed over microbatches; the loss head is per-
         # microbatch mean, so divide by M for the mean-loss gradient
         return loss[None], *jax.tree.map(lambda g: g / M, grads_all)
 
-    layer_spec = P(axis_pp)   # leading n_layers axis -> L/P per rank
-    tok_spec = P(axis_dp) if axis_dp else P()
+    batch_axes = tuple(a for a in (axis_dp, axis_fsdp) if a)
+    tok_spec = P(batch_axes) if batch_axes else P()
+    loss_spec = (P((*batch_axes, axis_pp)) if batch_axes else P(axis_pp))
     loss_r, outer_g, layer_g, head_g = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(), layer_spec, P(), tok_spec),
-        out_specs=(P(axis_pp) if not axis_dp else P((axis_dp, axis_pp)),
-                   P(), layer_spec, P()),
+        in_specs=(P(), layer_specs, P(), tok_spec),
+        out_specs=(loss_spec, P(), layer_specs, P()),
         check_vma=False,  # validity masks + psum-broadcasts aren't VMA-provable
     )(outer, params["layers"], head, tokens)
 
@@ -205,27 +307,80 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
 
 def make_pp_train_step(cfg: TransformerConfig, mesh, *, microbatches: int,
                        axis_pp: str = "pp", axis_dp: str | None = None,
-                       optimizer=None):
+                       axis_fsdp: str | None = None, optimizer=None,
+                       offload_opt_example=None):
     """Jitted ``step(params, opt_state, tokens) -> (loss, params,
-    opt_state)`` training the full model through the 1F1B pipeline."""
+    opt_state)`` training the full model through the 1F1B pipeline.
+
+    ``axis_fsdp``: ZeRO-3 stage params (see :func:`pp_loss_and_grads`);
+    the layer gradients arrive sharded like the params, so the
+    optimizer update runs shard-local. ``offload_opt_example``: a
+    host-resident optimizer state (models/train.offload_opt_state) —
+    the update pulls it to HBM, applies, pushes back, all inside the
+    one jit, exactly the sharded-train path's offload contract (the
+    pipeline state lives inside the shard_map, but the OPTIMIZER state
+    never does — it updates outside, where memory-kind streaming
+    composes unchanged)."""
     optimizer = optimizer or make_optimizer()
+    if offload_opt_example is not None:
+        from hpc_patterns_tpu.models.train import offload_shardings
+
+        host_sh, hbm_sh = offload_shardings(offload_opt_example)
+    else:
+        host_sh = hbm_sh = None
 
     def step(params, opt_state, tokens):
+        if hbm_sh is not None:
+            opt_state = jax.device_put(opt_state, hbm_sh)
         loss, grads = pp_loss_and_grads(
             params, tokens, cfg, mesh, microbatches=microbatches,
-            axis_pp=axis_pp, axis_dp=axis_dp,
+            axis_pp=axis_pp, axis_dp=axis_dp, axis_fsdp=axis_fsdp,
         )
         grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
+        if host_sh is not None:
+            opt_state = jax.device_put(opt_state, host_sh)
         return loss, params, opt_state
 
+    if host_sh is not None:
+        return jax.jit(
+            step, donate_argnums=(0, 1),
+            in_shardings=(None, host_sh, None),
+            out_shardings=(None, None, host_sh),
+        )
     return jax.jit(step, donate_argnums=(0, 1))
 
 
-def init_pp_train_state(key, cfg: TransformerConfig, optimizer=None):
-    """f32 params + opt state (replicated; the layer stack's leading axis
-    is what the pp shard_map slices)."""
+def init_pp_train_state(key, cfg: TransformerConfig, optimizer=None,
+                        mesh=None, *, axis_pp: str = "pp",
+                        axis_fsdp: str | None = None):
+    """f32 params + opt state. Replicated by default (the layer stack's
+    leading axis is what the pp shard_map slices); with ``mesh`` and
+    ``axis_fsdp``, layer leaves are PLACED sharded over (pp, fsdp) —
+    each rank materializes only its own stage-weight shard, and the
+    optax state inherits the placement (zeros_like preserves
+    sharding)."""
     optimizer = optimizer or make_optimizer()
-    params = init_params(key, cfg)
+    if mesh is not None and axis_fsdp:
+        from jax.sharding import NamedSharding
+
+        specs = _pp_layer_specs(cfg, axis_pp, axis_fsdp)
+        shardings = {
+            "layers": jax.tree.map(
+                lambda s: NamedSharding(mesh, s), specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+        }
+        replicated = NamedSharding(mesh, P())
+        full = jax.tree.map(
+            lambda _: replicated,
+            jax.eval_shape(lambda k: init_params(k, cfg), key),
+        )
+        full["layers"] = shardings["layers"]
+        params = jax.jit(
+            lambda k: init_params(k, cfg), out_shardings=full
+        )(key)
+    else:
+        params = init_params(key, cfg)
     return params, optimizer.init(params)

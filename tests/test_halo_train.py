@@ -116,6 +116,35 @@ class TestTrainApp:
         assert code == 0, out
         assert "1f1b" in out and "SUCCESS" in out
 
+    @pytest.mark.slow  # unrolled-1F1B compile dominates
+    def test_pp_fsdp_run(self, capsys):
+        # --pp x --fsdp: ZeRO-3 stage params through the 1F1B schedule
+        from hpc_patterns_tpu.apps import train_app
+
+        code = train_app.main(
+            ["--steps", "3", "--batch", "4", "--seq", "8", "--d-model",
+             "16", "--n-layers", "2", "--n-heads", "2", "--vocab", "32",
+             "--pp", "2", "--fsdp", "2", "--microbatches", "2"]
+        )
+        out = capsys.readouterr().out
+        assert code == 0, out
+        assert "fsdp=2" in out and "SUCCESS" in out
+
+    def test_pp_offload_opt_gated_on_cpu(self, capsys):
+        # --pp x --offload-opt: composes (no rejection); on a CPU
+        # backend the offload itself is gated with the same note as the
+        # sharded-train path
+        from hpc_patterns_tpu.apps import train_app
+
+        code = train_app.main(
+            ["--steps", "2", "--batch", "4", "--seq", "8", "--d-model",
+             "16", "--n-layers", "2", "--n-heads", "2", "--vocab", "32",
+             "--pp", "2", "--microbatches", "2", "--offload-opt"]
+        )
+        out = capsys.readouterr().out
+        assert code == 0, out
+        assert "ignoring" in out and "SUCCESS" in out
+
     def test_diverged_run_halts_early_and_fails(self, capsys, tmp_path):
         import os
 
@@ -164,10 +193,12 @@ class TestTrainApp:
         )
         out = capsys.readouterr().out
         assert code == 1 and "slice count" in out
-        # pp does not compose
-        assert train_app.main(["--pp", "2", "--dcn-dp",
+        # the same slice-count guard holds on the pp path (pp x dcn-dp
+        # COMPOSES since round 4 — only the dp mismatch errors)
+        assert train_app.main(["--pp", "2", "--dcn-dp", "--dp", "2",
                                "--n-layers", "2"]) == 1
-        capsys.readouterr()
+        out = capsys.readouterr().out
+        assert "slice count" in out
 
     def test_pp_rejects_tp(self, capsys):
         from hpc_patterns_tpu.apps import train_app
@@ -175,7 +206,7 @@ class TestTrainApp:
         code = train_app.main(["--pp", "2", "--tp", "2"])
         out = capsys.readouterr().out
         assert code == 1
-        assert "composes with --dp and --n-experts only" in out
+        assert "no sp/tp/ep axes inside pipeline stages" in out
 
     def test_mesh_run_with_resume(self, capsys, tmp_path):
         from hpc_patterns_tpu.apps import train_app

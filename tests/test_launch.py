@@ -81,6 +81,20 @@ class TestLaunch:
         assert code == 0, out
         assert "SUCCESS" in out
 
+    def test_train_pp_dcn_dp_slices_across_processes(self, capsys):
+        # pp x dcn-dp: the 1F1B stage ppermutes stay slice-internal
+        # (each process's own devices) while the once-per-step dp
+        # gradient pmean crosses the OS process boundary
+        code = _launch(["hpc_patterns_tpu.apps.train_app", "--dcn-dp",
+                        "--dp", "-1", "--pp", "2", "--steps", "2",
+                        "--batch", "4", "--microbatches", "2",
+                        "--seq", "32", "--d-model", "32",
+                        "--n-layers", "2", "--vocab", "128"],
+                       devices=4, slices=2)
+        out = capsys.readouterr().out
+        assert code == 0, out
+        assert "SUCCESS" in out and "dcn-dp=2" in out
+
     def test_train_sp_ring_attention_across_processes(self, capsys):
         # ring attention with the sp axis spanning both OS processes:
         # the per-step K/V ppermute crosses the process boundary
