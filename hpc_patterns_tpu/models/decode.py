@@ -38,12 +38,15 @@ Megatron-sharded params, GSPMD partitions these einsums the same way
 
 from __future__ import annotations
 
+import warnings
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import PartitionSpec as P
 
+from hpc_patterns_tpu.models.sharding_util import mesh_axis_size, resolve_spec
 from hpc_patterns_tpu.models.transformer import (
     TransformerConfig,
     _rmsnorm,
@@ -51,6 +54,48 @@ from hpc_patterns_tpu.models.transformer import (
     project_qkv,
 )
 from hpc_patterns_tpu.parallel.ring_attention import full_attention
+
+
+def _tp_size(mesh, cfg: TransformerConfig) -> int:
+    return mesh_axis_size(mesh, cfg.axis_tp) if mesh is not None else 1
+
+
+def _flash_partition(mesh, cfg: TransformerConfig) -> bool:
+    """Can the Pallas decode kernels run tp-sharded on this mesh?
+
+    GSPMD partitions einsums but not a ``pallas_call`` — the round-3
+    limitation that forced sharded serving onto the gather path. The
+    kernels' head axes are embarrassingly parallel though, so a
+    ``shard_map`` manual partition over ``axis_tp`` (contiguous head
+    blocks: q head k·g+j stays with kv head k) recovers the flash
+    kernels under tp whenever tp divides kv_heads. Returns False (with
+    a warning) when it cannot, and the caller keeps the gather path.
+    """
+    tp = _tp_size(mesh, cfg)
+    if tp <= 1:
+        return False
+    if cfg.kv_heads % tp:
+        warnings.warn(
+            f"decode: tp size {tp} does not divide kv_heads "
+            f"{cfg.kv_heads}; decode_attn='flash' falls back to the "
+            "gather path (shard_map needs whole kv-head blocks per "
+            "rank) — use a tp that divides kv_heads to keep the kernel",
+            stacklevel=3,
+        )
+        return False
+    return True
+
+
+def _flash_route(mesh, cfg: TransformerConfig):
+    """(use_flash, flash_sharded): the ONE flash/gather routing decision
+    shared by prefill and decode_step — the prompt pass and the step
+    pass must always take the same route under the same mesh."""
+    flash_sharded = (cfg.decode_attn == "flash"
+                     and _flash_partition(mesh, cfg))
+    use_flash = cfg.decode_attn == "flash" and (
+        _tp_size(mesh, cfg) <= 1 or flash_sharded
+    )
+    return use_flash, flash_sharded
 
 
 def _quantize_rows(x):
@@ -124,14 +169,19 @@ def _mlp(x, lp, cfg: TransformerConfig):
     return x + jnp.dot(h, lp["w2"].astype(dt))
 
 
-def prefill(params, prompt, cfg: TransformerConfig, max_len: int):
+def prefill(params, prompt, cfg: TransformerConfig, max_len: int,
+            mesh=None):
     """Run the prompt in one batched pass (MXU-shaped, exactly
     transformer.forward's math) while capturing each layer's K/V into a
     fresh cache. Returns (last_logits (B, V) f32, cache).
 
     ``max_len`` sizes the static cache (prompt + planned new tokens,
-    <= cfg.max_seq)."""
+    <= cfg.max_seq). ``mesh``: tp-sharded serving — the flash prefill
+    kernel runs shard_mapped over ``cfg.axis_tp`` and the captured
+    cache is constrained kv-head-sharded over tp (what the sharded
+    decode steps consume in place)."""
     B, T = prompt.shape
+    use_flash, flash_sharded = _flash_route(mesh, cfg)
     if not 0 < T <= max_len <= cfg.max_seq:
         raise ValueError(
             f"need 0 < prompt len {T} <= max_len {max_len} <= "
@@ -157,10 +207,19 @@ def prefill(params, prompt, cfg: TransformerConfig, max_len: int):
         # a 17 GB allocation at B=8); short/ragged prompts and sharded
         # (gather-mode) serving keep the einsum path, which consumes
         # the narrow GQA K/V directly
-        if cfg.decode_attn == "flash" and T % 128 == 0:
+        if use_flash and T % 128 == 0:
             from hpc_patterns_tpu.ops import flash_attention
 
-            o = flash_attention(q, k, v, causal=True)
+            if flash_sharded:
+                hspec = resolve_spec(P(None, None, cfg.axis_tp, None),
+                                     mesh, cfg.mesh_axes)
+                o = jax.shard_map(
+                    partial(flash_attention, causal=True), mesh=mesh,
+                    in_specs=(hspec, hspec, hspec), out_specs=hspec,
+                    check_vma=False,  # pallas_call can't declare vma
+                )(q, k, v)
+            else:
+                o = flash_attention(q, k, v, causal=True)
         else:
             o = full_attention(q, k, v, causal=True)
         o = jnp.dot(o.reshape(B, T, cfg.d_model), lp["wo"].astype(dt))
@@ -179,24 +238,51 @@ def prefill(params, prompt, cfg: TransformerConfig, max_len: int):
     if cfg.kv_cache_dtype == "int8":
         kq, ksc = zip(*(_quantize_rows(ks[l]) for l in range(L)))
         vq, vsc = zip(*(_quantize_rows(vs[l]) for l in range(L)))
-        return logits.astype(jnp.float32), {
+        cache = {
             "k": tuple(kq), "v": tuple(vq),
             "k_scale": tuple(ksc), "v_scale": tuple(vsc),
         }
-    return logits.astype(jnp.float32), {
-        "k": tuple(ks[l] for l in range(L)),
-        "v": tuple(vs[l] for l in range(L)),
-    }
+    else:
+        cache = {
+            "k": tuple(ks[l] for l in range(L)),
+            "v": tuple(vs[l] for l in range(L)),
+        }
+    if mesh is not None and _tp_size(mesh, cfg) > 1:
+        # pin the cache kv-head-sharded over tp so the per-step
+        # dynamic_update_slice and attention read stay rank-local (the
+        # sharded decode step's shard_map consumes exactly this layout)
+        from jax.sharding import NamedSharding
+
+        tp = cfg.axis_tp
+        sh = {
+            4: NamedSharding(mesh, resolve_spec(P(None, tp, None, None),
+                                                mesh, cfg.mesh_axes)),
+            3: NamedSharding(mesh, resolve_spec(P(None, tp, None),
+                                                mesh, cfg.mesh_axes)),
+        }
+        cache = jax.tree.map(
+            lambda a: lax.with_sharding_constraint(a, sh[a.ndim]), cache
+        )
+    return logits.astype(jnp.float32), cache
 
 
-def decode_step(params, cache, pos, tokens, cfg: TransformerConfig):
+def decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
+                mesh=None):
     """One token for every sequence in the batch: ``tokens`` (B,) int32
     at position ``pos`` (traced scalar — the true current length, so one
     compilation serves the whole generation). Returns
-    (logits (B, V) f32, updated cache)."""
+    (logits (B, V) f32, updated cache).
+
+    ``mesh``: for tp-sharded serving with ``decode_attn="flash"`` — the
+    single-query kernel runs under a ``shard_map`` manual partition
+    over ``cfg.axis_tp`` (heads are embarrassingly parallel in its
+    grid); all other einsums partition via GSPMD as before. Without a
+    mesh, sharded params still work through pure GSPMD on the gather
+    path."""
     dt = jnp.dtype(cfg.dtype)
     B = tokens.shape[0]
     scale = 1.0 / (cfg.head_dim ** 0.5)
+    use_flash, flash_sharded = _flash_route(mesh, cfg)
     x = params["embed"].astype(dt)[tokens]  # (B, D)
     if cfg.pos_embed == "learned":
         x = x + lax.dynamic_slice_in_dim(
@@ -242,14 +328,42 @@ def decode_step(params, cache, pos, tokens, cfg: TransformerConfig):
         # materialized n_heads-wide repeat of the cache, so the per-step
         # HBM traffic is the kv_heads-narrow cache read, which is the
         # saving GQA exists for
-        if cfg.decode_attn == "flash":
+        if use_flash:
             from hpc_patterns_tpu.ops.flash_decode import (
                 flash_decode_attention,
             )
 
-            o = flash_decode_attention(q, k_cache, v_cache, pos,
-                                       k_scale=k_scale, v_scale=v_scale,
-                                       scale=scale)
+            if flash_sharded:
+                # manual partition over tp: contiguous head blocks —
+                # q heads [c·H/tp, ...) are exactly the g-groups of kv
+                # heads [c·Hkv/tp, ...), so each rank runs the kernel
+                # on its own whole (q-group, cache) rows
+                tp = cfg.axis_tp
+                rs = lambda spec: resolve_spec(spec, mesh, cfg.mesh_axes)
+                spec_q = rs(P(None, tp, None))
+                spec_c = rs(P(None, tp, None, None))
+                args = [q, k_cache, v_cache,
+                        jnp.asarray(pos, jnp.int32).reshape(1)]
+                specs = [spec_q, spec_c, spec_c, P()]
+                if int8_cache:
+                    args += [k_scale, v_scale]
+                    specs += [rs(P(None, tp, None))] * 2
+
+                def local_attn(q, kc, vc, p, ks=None, vs=None):
+                    return flash_decode_attention(
+                        q, kc, vc, p[0], k_scale=ks, v_scale=vs,
+                        scale=scale,
+                    )
+
+                o = jax.shard_map(
+                    local_attn, mesh=mesh,
+                    in_specs=tuple(specs), out_specs=spec_q,
+                    check_vma=False,  # pallas_call can't declare vma
+                )(*args)
+            else:
+                o = flash_decode_attention(q, k_cache, v_cache, pos,
+                                           k_scale=k_scale,
+                                           v_scale=v_scale, scale=scale)
         else:
             # ONE gather attention block for both cache dtypes (an int8
             # cache dequantizes in the einsum stream — elementwise
@@ -379,6 +493,18 @@ def extend_step(params, cache, pos, tokens, cfg: TransformerConfig):
     return logits.astype(jnp.float32), {"k": tuple(ks), "v": tuple(vs)}
 
 
+def _topk_mask(logits, top_k: int):
+    """Top-k truncation (0 = off): everything below the kth-highest
+    logit goes to -inf, ties at the kth value all survive. THE single
+    definition of the sampling support — _pick samples from it and the
+    speculative verifier's warped distributions are built from it
+    (models/speculative.py), so the two can never drift apart."""
+    if top_k:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    return logits
+
+
 def _pick(logits, key, temperature, greedy: bool, top_k: int):
     """Next-token choice. ``greedy`` (static) picks the branch; the
     temperature itself stays traced so every sampling temperature
@@ -387,20 +513,18 @@ def _pick(logits, key, temperature, greedy: bool, top_k: int):
     sort)."""
     if greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    if top_k:
-        kth = lax.top_k(logits, top_k)[0][:, -1:]
-        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    logits = _topk_mask(logits, top_k)
     return jax.random.categorical(key, logits / temperature, axis=-1).astype(
         jnp.int32
     )
 
 
-@partial(jax.jit, static_argnums=(2, 3, 6, 7))
+@partial(jax.jit, static_argnums=(2, 3, 6, 7, 8))
 def _generate_jit(params, prompt, cfg, new_tokens, key, temperature,
-                  greedy, top_k):
+                  greedy, top_k, mesh=None):
     B, T = prompt.shape
     max_len = T + new_tokens
-    logits, cache = prefill(params, prompt, cfg, max_len)
+    logits, cache = prefill(params, prompt, cfg, max_len, mesh=mesh)
     key, sub = jax.random.split(key)
     first = _pick(logits, sub, temperature, greedy, top_k)
 
@@ -409,7 +533,8 @@ def _generate_jit(params, prompt, cfg, new_tokens, key, temperature,
 
     def step(carry, _):
         cache, pos, tok, key = carry
-        logits, cache = decode_step(params, cache, pos, tok, cfg)
+        logits, cache = decode_step(params, cache, pos, tok, cfg,
+                                    mesh=mesh)
         key, sub = jax.random.split(key)
         nxt = _pick(logits, sub, temperature, greedy, top_k)
         return (cache, pos + 1, nxt, key), tok
@@ -422,11 +547,14 @@ def _generate_jit(params, prompt, cfg, new_tokens, key, temperature,
 
 
 def generate(params, prompt, cfg: TransformerConfig, new_tokens: int, *,
-             key=None, temperature: float = 0.0, top_k: int = 0):
+             key=None, temperature: float = 0.0, top_k: int = 0,
+             mesh=None):
     """Continuation tokens (B, new_tokens) int32: greedy by default,
     temperature/top-k sampling when ``temperature > 0`` (``key``
     required then). One jit for prefill + the whole scan'd decode
-    loop."""
+    loop. ``mesh``: tp-sharded serving with the flash kernels (see
+    :func:`decode_step`); without it, sharded params serve via GSPMD
+    on the gather path."""
     if new_tokens < 1:
         raise ValueError(f"new_tokens must be >= 1, got {new_tokens}")
     if prompt.shape[1] + new_tokens > cfg.max_seq:
@@ -442,12 +570,12 @@ def generate(params, prompt, cfg: TransformerConfig, new_tokens: int, *,
         key = jax.random.PRNGKey(0)  # unused in greedy mode
     return _generate_jit(params, prompt, cfg, new_tokens, key,
                          jnp.float32(max(temperature, 1e-6)),
-                         temperature <= 0.0, int(top_k))
+                         temperature <= 0.0, int(top_k), mesh)
 
 
 def greedy_generate(params, prompt, cfg: TransformerConfig,
-                    new_tokens: int):
+                    new_tokens: int, *, mesh=None):
     """Greedy continuation: (B, new_tokens) int32. The oracle
     equivalence (identical to re-running forward() on the growing
     sequence each step) is the decode test's invariant."""
-    return generate(params, prompt, cfg, new_tokens)
+    return generate(params, prompt, cfg, new_tokens, mesh=mesh)

@@ -1,8 +1,8 @@
-"""Speculative greedy decoding: a small draft model proposes, the
-target model verifies in one batched pass.
+"""Speculative decoding: a small draft model proposes, the target
+model verifies in one batched pass. Greedy AND sampling modes.
 
-The serving-latency play the KV-cache machinery enables: plain greedy
-decode is one big-model forward per token (cache-read-bound,
+The serving-latency play the KV-cache machinery enables: plain decode
+is one big-model forward per token (cache-read-bound,
 benchmarks/RESULTS.md); here a cheap draft model runs ``gamma``
 sequential steps and the target scores the whole proposed chunk with
 ONE ``decode.extend_step`` — large-matmul shapes instead of gamma
@@ -11,6 +11,20 @@ PROVABLY identical to the target's own greedy decode, whatever the
 draft proposes (the oracle the tests pin): accepted proposals are
 exactly the tokens the target would have picked, and the first
 disagreement is replaced by the target's token.
+
+With ``temperature > 0`` the verify step is the standard
+rejection-sampling acceptance (speculative sampling): proposal j drawn
+from the draft's warped distribution q_j is accepted with probability
+min(1, p_j(x_j)/q_j(x_j)) against the target's warped p_j; the first
+rejection is replaced by a draw from the residual norm(max(p_j − q_j,
+0)), and a fully-accepted round appends a bonus draw from p_gamma. The
+emitted sequence is distributed EXACTLY as target-only sampling at the
+same temperature/top_k (the warped distributions are what
+decode._pick samples) — the distribution-exactness oracle in
+tests/test_decode.py pins the accept/resample primitive against the
+analytic law. Both modes share the distributions through one
+``_accept_resample``: greedy is the temperature→0 limit evaluated
+exactly (argmax + first-mismatch), not a separate bookkeeping path.
 
 Bookkeeping invariant (both caches, one shared position cursor): at the
 top of each iteration the caches hold K/V for the prompt and every
@@ -35,6 +49,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from hpc_patterns_tpu.models.decode import (
+    _pick,
+    _topk_mask,
     decode_step,
     extend_step,
     prefill,
@@ -42,61 +58,120 @@ from hpc_patterns_tpu.models.decode import (
 from hpc_patterns_tpu.models.transformer import TransformerConfig
 
 
-@partial(jax.jit, static_argnums=(1, 3, 5, 6))
+def _warp(logits, temperature, top_k: int):
+    """The warped next-token distribution ``decode._pick`` samples:
+    the SHARED ``_topk_mask`` support then temperature softmax —
+    _pick's categorical over masked-logits/temperature IS this softmax,
+    by construction (one mask definition, no drift). (..., V) f32."""
+    masked = _topk_mask(logits.astype(jnp.float32), top_k)
+    return jax.nn.softmax(masked / temperature, axis=-1)
+
+
+def _accept_resample(key, props, q_probs, p_probs):
+    """The speculative-sampling verify primitive (one round).
+
+    ``props``: (gamma,) proposal tokens drawn from the draft rows;
+    ``q_probs``: (gamma, V) the draft's warped distributions;
+    ``p_probs``: (gamma+1, V) the target's warped distributions at the
+    same positions (+1 = the bonus row). Returns ``(a, nxt)``: the
+    accepted-prefix length (proposal j accepted with probability
+    min(1, p_j(x_j)/q_j(x_j)), stopping at the first rejection) and
+    the round's closing token — a draw from the residual
+    norm(max(p_a − q_a, 0)) on rejection, or from p_gamma when all
+    gamma proposals were accepted (padding q with a zeros row makes
+    those the same expression). The emitted law [props[:a], nxt] is
+    exactly target-only ancestral sampling — the oracle test draws this
+    many times and checks the first-token marginal equals p analytically.
+    """
+    gamma = props.shape[0]
+    k_acc, k_nxt = jax.random.split(key)
+    sel = jnp.arange(gamma)
+    p_at = p_probs[sel, props]
+    q_at = q_probs[sel, props]
+    u = jax.random.uniform(k_acc, (gamma,))
+    accept = u * q_at < jnp.minimum(q_at, p_at)  # u < min(1, p/q), q>0
+    a = jnp.sum(jnp.cumprod(accept.astype(jnp.int32)))
+    q_padded = jnp.concatenate(
+        [q_probs, jnp.zeros_like(q_probs[:1])], axis=0
+    )
+    res = jnp.maximum(p_probs[a] - q_padded[a], 0.0)
+    res_sum = jnp.sum(res)
+    # p == q exactly leaves an empty residual; the limit law is p itself
+    dist = jnp.where(res_sum > 1e-12, res / res_sum, p_probs[a])
+    nxt = jax.random.categorical(k_nxt, jnp.log(dist + 1e-30))
+    return a, nxt.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnums=(1, 3, 5, 6, 8, 9))
 def _speculative_jit(params, cfg, draft_params, draft_cfg, prompt,
-                     new_tokens, gamma):
+                     new_tokens, gamma, key=None, greedy=True, top_k=0,
+                     temperature=1.0):
     B, T = prompt.shape
     max_len = T + new_tokens + gamma + 1  # slack for the final round
     logits, cache = prefill(params, prompt, cfg, max_len)
     _, dcache = prefill(draft_params, prompt, draft_cfg, max_len)
-    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (1,)
+    if key is None:
+        key = jax.random.PRNGKey(0)  # unused in greedy mode
+    key, sub = jax.random.split(key)
+    first = _pick(logits, sub, temperature, greedy, top_k)  # (1,)
 
     out = jnp.zeros((new_tokens + gamma + 1,), jnp.int32)
     out = out.at[0].set(first[0])
 
     def cond(state):
-        _, _, _, _, n_out = state
+        _, _, _, _, n_out, _ = state
         return n_out < new_tokens
 
     def iteration(state):
-        cache, dcache, pos, cur, n_out = state
+        cache, dcache, pos, cur, n_out, key = state
         # --- draft proposes gamma tokens (gamma+1 steps: the extra one
         # writes the last proposal's K/V — see module docstring)
         props = []
+        qs = []
         tok = cur
         dc = dcache
         for j in range(gamma + 1):
             dlogits, dc = decode_step(draft_params, dc, pos + j, tok,
                                       draft_cfg)
-            tok = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+            key, sub = jax.random.split(key)
+            tok = _pick(dlogits, sub, temperature, greedy, top_k)
             if j < gamma:
                 props.append(tok[0])
+                if not greedy:
+                    qs.append(_warp(dlogits[0], temperature, top_k))
         props = jnp.stack(props)  # (gamma,)
 
         # --- target verifies [cur, props] in ONE extend
         chunk = jnp.concatenate([cur, props])[None, :]  # (1, gamma+1)
         vlogits, cache = extend_step(params, cache, pos, chunk, cfg)
-        t_all = jnp.argmax(vlogits[0], axis=-1).astype(jnp.int32)  # (gamma+1,)
 
-        # longest accepted prefix: props[j] must equal the target's own
-        # next token t_all[j]; a in [0, gamma] by construction
-        matches = (props == t_all[:gamma]).astype(jnp.int32)
-        a = jnp.sum(jnp.cumprod(matches))
-        nxt = t_all[a]  # the target's token at the first disagreement
+        if greedy:
+            # exact temperature->0 limit: accept while the proposal IS
+            # the target argmax; replace the first mismatch with it
+            t_all = jnp.argmax(vlogits[0], axis=-1).astype(jnp.int32)
+            matches = (props == t_all[:gamma]).astype(jnp.int32)
+            a = jnp.sum(jnp.cumprod(matches))
+            nxt = t_all[a]
+        else:
+            key, sub = jax.random.split(key)
+            a, nxt = _accept_resample(
+                sub, props, jnp.stack(qs),
+                _warp(vlogits[0], temperature, top_k),
+            )
         # emitted this round: props[:a] then nxt (positions > a are
         # filler, overwritten by the next round's slice)
         props_padded = jnp.concatenate([props, props[-1:]])
         emit = jnp.where(jnp.arange(gamma + 1) < a, props_padded, nxt)
-        return cache, dc, pos + a + 1, nxt[None], n_out + a + 1, emit
+        return cache, dc, pos + a + 1, nxt[None], n_out + a + 1, key, emit
 
     def body(state_out):
         state, out = state_out
         n_out = state[4]
-        cache, dc, pos2, cur2, n_out2, emit = iteration(state)
+        cache, dc, pos2, cur2, n_out2, key2, emit = iteration(state)
         out = lax.dynamic_update_slice(out, emit, (n_out,))
-        return (cache, dc, pos2, cur2, n_out2), out
+        return (cache, dc, pos2, cur2, n_out2, key2), out
 
-    state = (cache, dcache, jnp.int32(T), first, jnp.int32(1))
+    state = (cache, dcache, jnp.int32(T), first, jnp.int32(1), key)
     (state, out) = lax.while_loop(
         lambda so: cond(so[0]),
         body,
@@ -124,12 +199,28 @@ def _validate(cfg, draft_cfg, prompt_len, new_tokens, gamma):
         )
 
 
+def _sampling_args(cfg, temperature, top_k, key):
+    """Shared sampling-argument guards (mirrors decode.generate)."""
+    if temperature > 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if not 0 <= top_k <= cfg.vocab:
+        raise ValueError(f"top_k {top_k} outside [0, vocab]")
+    greedy = temperature <= 0.0
+    return (key, greedy, int(top_k),
+            jnp.float32(max(temperature, 1e-6)))
+
+
 def speculative_generate(params, cfg: TransformerConfig, draft_params,
                          draft_cfg: TransformerConfig, prompt,
-                         new_tokens: int, *, gamma: int = 4):
-    """Greedy continuation (1, new_tokens) int32, token-identical to
-    ``greedy_generate(params, prompt, cfg, new_tokens)`` — the draft
-    only changes HOW FAST tokens come, never which tokens.
+                         new_tokens: int, *, gamma: int = 4, key=None,
+                         temperature: float = 0.0, top_k: int = 0):
+    """Continuation (1, new_tokens) int32. Greedy by default —
+    token-identical to ``greedy_generate(params, prompt, cfg,
+    new_tokens)``: the draft only changes HOW FAST tokens come, never
+    which tokens. With ``temperature > 0`` (``key`` required), the
+    rejection-sampling verify makes the output distributed exactly as
+    ``generate(..., temperature, top_k)`` — same law, not same draws
+    (the two consume randomness differently).
 
     ``prompt``: (1, T); ``gamma``: proposals per round (the draft/target
     cost ratio picks it — more acceptance, longer verified chunks).
@@ -141,27 +232,41 @@ def speculative_generate(params, cfg: TransformerConfig, draft_params,
             "lengths diverge per row; vmap over sequences instead"
         )
     _validate(cfg, draft_cfg, prompt.shape[1], new_tokens, gamma)
+    key, greedy, top_k, temperature = _sampling_args(
+        cfg, temperature, top_k, key
+    )
     return _speculative_jit(params, cfg, draft_params, draft_cfg, prompt,
-                            new_tokens, gamma)
+                            new_tokens, gamma, key, greedy, top_k,
+                            temperature)
 
 
 def speculative_generate_batched(params, cfg: TransformerConfig,
                                  draft_params,
                                  draft_cfg: TransformerConfig, prompts,
-                                 new_tokens: int, *, gamma: int = 4):
+                                 new_tokens: int, *, gamma: int = 4,
+                                 key=None, temperature: float = 0.0,
+                                 top_k: int = 0):
     """Batched speculative decoding via ``jax.vmap`` over sequences:
     each row runs its own acceptance loop (vmap lifts the while_loop to
     run until every row finishes — rows that finish early mask). Output
     (B, new_tokens), row-wise token-identical to
-    :func:`speculative_generate` (oracle-tested). Wall-clock note: the
-    batch advances at the SLOWEST row's acceptance rate; per-sequence
-    calls win when acceptance varies wildly."""
+    :func:`speculative_generate` (oracle-tested; sampling rows each
+    consume their own fold of ``key``). Wall-clock note: the batch
+    advances at the SLOWEST row's acceptance rate; per-sequence calls
+    win when acceptance varies wildly."""
     if prompts.ndim != 2:
         raise ValueError(f"prompts must be (B, T), got {prompts.shape}")
     _validate(cfg, draft_cfg, prompts.shape[1], new_tokens, gamma)
+    key, greedy, top_k, temperature = _sampling_args(
+        cfg, temperature, top_k, key
+    )
+    keys = (jax.random.split(key, prompts.shape[0])
+            if key is not None
+            else jnp.zeros((prompts.shape[0], 2), jnp.uint32))
 
-    def one(row):
+    def one(row, k):
         return _speculative_jit(params, cfg, draft_params, draft_cfg,
-                                row[None, :], new_tokens, gamma)[0]
+                                row[None, :], new_tokens, gamma, k,
+                                greedy, top_k, temperature)[0]
 
-    return jax.vmap(one)(prompts)
+    return jax.vmap(one)(prompts, keys)

@@ -145,6 +145,50 @@ class TestShardedServing:
         ))
         np.testing.assert_array_equal(got, want)
 
+    def test_tp_flash_kernel_decodes_exactly(self, mesh_dp_sp_tp):
+        # the flash decode/prefill kernels under tp: shard_map manual
+        # partition over whole kv-head blocks (round-4 route) — tokens
+        # must match the unsharded flash decode exactly, so tp serving
+        # keeps the kernel's position-proportional cache traffic
+        from hpc_patterns_tpu.models.sharding import shard_params
+
+        cfg, params, prompt = _setup(n_heads=4, n_kv_heads=2)
+        want = np.asarray(greedy_generate(params, prompt, cfg, 6))
+        p_sh = shard_params(params, mesh_dp_sp_tp, cfg)
+        got = np.asarray(jax.device_get(
+            greedy_generate(p_sh, prompt, cfg, 6, mesh=mesh_dp_sp_tp)
+        ))
+        np.testing.assert_array_equal(got, want)
+
+    def test_tp_flash_int8_cache_decodes_exactly(self, mesh_dp_sp_tp):
+        # int8 KV cache composes with the tp shard_map route (the
+        # per-row scales shard with their kv heads)
+        from hpc_patterns_tpu.models.sharding import shard_params
+
+        cfg, params, prompt = _setup(n_heads=4, n_kv_heads=2,
+                                     kv_cache_dtype="int8")
+        want = np.asarray(greedy_generate(params, prompt, cfg, 6))
+        p_sh = shard_params(params, mesh_dp_sp_tp, cfg)
+        got = np.asarray(jax.device_get(
+            greedy_generate(p_sh, prompt, cfg, 6, mesh=mesh_dp_sp_tp)
+        ))
+        np.testing.assert_array_equal(got, want)
+
+    def test_tp_not_dividing_kv_heads_warns_and_falls_back(
+            self, mesh_dp_sp_tp):
+        # tp=2 cannot split kv_heads=1: the flash request must warn and
+        # serve on the gather path, still token-exact
+        from hpc_patterns_tpu.models.sharding import shard_params
+
+        cfg, params, prompt = _setup(n_heads=4, n_kv_heads=1)
+        want = np.asarray(greedy_generate(params, prompt, cfg, 6))
+        p_sh = shard_params(params, mesh_dp_sp_tp, cfg)
+        with pytest.warns(UserWarning, match="falls back to the gather"):
+            got = np.asarray(jax.device_get(
+                greedy_generate(p_sh, prompt, cfg, 6, mesh=mesh_dp_sp_tp)
+            ))
+        np.testing.assert_array_equal(got, want)
+
 
 class TestSampling:
     def test_top_k_1_is_greedy(self):
@@ -279,6 +323,107 @@ class TestSpeculative:
         with pytest.raises(ValueError, match="vocab"):
             speculative_generate(params1, cfg1, init_params(
                 jax.random.PRNGKey(1), bad), bad, prompt1, 4)
+        with pytest.raises(ValueError, match="PRNG key"):
+            speculative_generate(params1, cfg1, params1, cfg1, prompt1, 4,
+                                 temperature=0.8)
+
+
+class TestSpeculativeSampling:
+    """Rejection-sampling speculative decoding: the emitted tokens must
+    be distributed EXACTLY as target-only sampling at the same
+    temperature/top_k (Leviathan-style accept/resample). The primitive
+    is pinned against the analytic law; the end-to-end path against its
+    deterministic (top_k=1) limit."""
+
+    def test_accept_resample_marginal_is_target(self):
+        # fixed synthetic q (draft) and p (target) rows: over many
+        # rounds, the FIRST emitted token (props[0] if accepted, else
+        # the residual draw) must have marginal law exactly p_0 — the
+        # defining property of the accept/resample rule
+        from hpc_patterns_tpu.models.speculative import _accept_resample
+
+        V, gamma, M = 6, 2, 20000
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.dirichlet(np.ones(V), size=gamma),
+                        jnp.float32)
+        p = jnp.asarray(rng.dirichlet(np.ones(V), size=gamma + 1),
+                        jnp.float32)
+
+        def draw(key):
+            kq, kr = jax.random.split(key)
+            props = jax.vmap(
+                lambda k, row: jax.random.categorical(k, jnp.log(row))
+            )(jax.random.split(kq, gamma), q).astype(jnp.int32)
+            a, nxt = _accept_resample(kr, props, q, p)
+            return jnp.where(a >= 1, props[0], nxt)
+
+        keys = jax.random.split(jax.random.PRNGKey(1), M)
+        firsts = np.asarray(jax.jit(jax.vmap(draw))(keys))
+        emp = np.bincount(firsts, minlength=V) / M
+        tv = 0.5 * np.abs(emp - np.asarray(p[0])).sum()
+        assert tv < 0.02, (tv, emp, np.asarray(p[0]))
+
+    def test_accept_resample_bonus_row_when_draft_matches(self):
+        # q == p rows: every proposal accepts (ratio 1), the residual is
+        # empty, and the closing token must fall back to a draw from the
+        # bonus row p_gamma
+        from hpc_patterns_tpu.models.speculative import _accept_resample
+
+        V, gamma, M = 6, 2, 20000
+        rng = np.random.default_rng(2)
+        p = jnp.asarray(rng.dirichlet(np.ones(V), size=gamma + 1),
+                        jnp.float32)
+        q = p[:gamma]
+
+        def draw(key):
+            kq, kr = jax.random.split(key)
+            props = jax.vmap(
+                lambda k, row: jax.random.categorical(k, jnp.log(row))
+            )(jax.random.split(kq, gamma), q).astype(jnp.int32)
+            a, nxt = _accept_resample(kr, props, q, p)
+            return a, nxt
+
+        keys = jax.random.split(jax.random.PRNGKey(3), M)
+        a, nxt = jax.jit(jax.vmap(draw))(keys)
+        assert int(np.asarray(a).min()) == gamma  # all accepted, always
+        emp = np.bincount(np.asarray(nxt), minlength=V) / M
+        tv = 0.5 * np.abs(emp - np.asarray(p[gamma])).sum()
+        assert tv < 0.02, tv
+
+    def test_top_k_1_sampling_equals_greedy(self):
+        # top_k=1 collapses both warped distributions to the argmax
+        # point mass: the sampling path must emit exactly the greedy
+        # speculative (= greedy target) tokens, end to end
+        from hpc_patterns_tpu.models.speculative import speculative_generate
+
+        cfg, params, prompt = _setup(batch=1)
+        dcfg = TransformerConfig(**{**BASE, "d_model": 16, "d_ff": 32,
+                                    "n_layers": 1, "n_heads": 2})
+        dparams = init_params(jax.random.PRNGKey(42), dcfg)
+        want = np.asarray(greedy_generate(params, prompt, cfg, 10))
+        got = np.asarray(speculative_generate(
+            params, cfg, dparams, dcfg, prompt, 10, gamma=3,
+            key=jax.random.PRNGKey(7), temperature=0.9, top_k=1,
+        ))
+        np.testing.assert_array_equal(got, want)
+
+    def test_batched_sampling_rows_run_independently(self):
+        # B=2 sampled rows: finite tokens in range, and each row equals
+        # its per-sequence call with the same per-row key fold
+        from hpc_patterns_tpu.models.speculative import (
+            speculative_generate_batched,
+        )
+
+        cfg, params, prompt = _setup(batch=2)
+        dcfg = TransformerConfig(**{**BASE, "d_model": 16, "d_ff": 32,
+                                    "n_layers": 1, "n_heads": 2})
+        dparams = init_params(jax.random.PRNGKey(42), dcfg)
+        got = np.asarray(speculative_generate_batched(
+            params, cfg, dparams, dcfg, prompt, 8, gamma=2,
+            key=jax.random.PRNGKey(5), temperature=0.8, top_k=4,
+        ))
+        assert got.shape == (2, 8)
+        assert got.min() >= 0 and got.max() < cfg.vocab
 
 
 class TestExtendStep:
