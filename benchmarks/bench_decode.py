@@ -67,6 +67,50 @@ def main():
 
     t_step = {}
     for impl in impls:
+        if impl == "paged":
+            # block-table cache: the pool allocates prompt+slack pages,
+            # NOT the declared maximum — the capacity row runs where the
+            # equivalent linear allocation would not fit
+            from hpc_patterns_tpu.models.decode import (
+                init_paged_cache,
+                paged_decode_step,
+                paged_prefill,
+            )
+
+            page = arg("page", 512 if on_tpu else 16)
+            pages = -(-(prompt_len + slack) // page)
+            pcache = init_paged_cache(cfg0, batch, pages, page)
+            _, pcache = jax.jit(
+                lambda p, t, c: paged_prefill(p, t, cfg0, c, page)
+            )(params, prompt, pcache)
+            jax.block_until_ready(pcache)
+
+            @functools.partial(jax.jit, static_argnums=(3,))
+            def run_paged(params, cache, tok, n):
+                def body(_, carry):
+                    cache, pos, tok = carry
+                    logits, cache = paged_decode_step(
+                        params, cache, pos, tok, cfg0,
+                        identity_layout=True,
+                    )
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    return cache, pos + 1, nxt
+
+                _, _, tok = lax.fori_loop(
+                    0, n, body, (cache, jnp.int32(prompt_len), tok)
+                )
+                return tok
+
+            t = amortized_seconds(
+                lambda n: run_paged(params, pcache, first, n),
+                iters=iters, repetitions=3, base_iters=iters // 2,
+            )
+            t_step[impl] = t
+            pool_tok = pages * page
+            print(f"impl=paged   pool={batch}x{pool_tok} (page {page}) "
+                  f"B={batch} kv={cfg0.kv_heads}: {t * 1e3:6.3f} "
+                  f"ms/token-step ({batch / t:,.0f} tok/s)")
+            continue
         cfg = TransformerConfig(**base, decode_attn=impl)
 
         @functools.partial(jax.jit, static_argnums=(3,))

@@ -1,0 +1,89 @@
+"""Speculative decoding gamma sweep vs plain decode, greedy and
+sampling verify, on the real chip.
+
+Protocol: per-token time by generation differencing — each
+configuration generates N and N/2 tokens in ONE jitted call each
+(prefill + the whole decode/verify loop live inside), both
+completion-forced; the difference divided by N/2 cancels prefill,
+compile, and dispatch/readback latency. Tunnel-noise caveat from
+round 3 applies (single-token steps are floor-bound ~1 ms on this
+chip); min-of-reps and adjacent measurement are the mitigations.
+
+Usage: python benchmarks/bench_speculative.py [--n=256] [--temp=0.8]
+"""
+
+import sys
+
+import jax
+
+from hpc_patterns_tpu.harness.timing import measure_forced
+from hpc_patterns_tpu.models import TransformerConfig
+from hpc_patterns_tpu.models.decode import generate
+from hpc_patterns_tpu.models.speculative import speculative_generate
+from hpc_patterns_tpu.models.transformer import init_params
+
+
+def arg(name, default, cast=int):
+    for a in sys.argv[1:]:
+        if a.startswith(f"--{name}="):
+            return cast(a.split("=", 1)[1])
+    return default
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    n = arg("n", 256 if on_tpu else 16)
+    temp = arg("temp", 0.8, float)
+    top_k = arg("topk", 40)
+    base = dict(
+        vocab=32768 if on_tpu else 256,
+        d_model=1024 if on_tpu else 64,
+        n_heads=8 if on_tpu else 4,
+        n_layers=8 if on_tpu else 2,
+        d_ff=4096 if on_tpu else 128,
+        dtype="bfloat16" if on_tpu else "float32",
+        n_kv_heads=2 if on_tpu else 0,
+        pos_embed="rope",
+    )
+    gammas = (2, 4, 8)
+    max_len = 128 + n + max(gammas) + 1
+    cfg = TransformerConfig(**base, max_seq=max_len)
+    dcfg = TransformerConfig(**{
+        **base,
+        "d_model": 256 if on_tpu else 32,
+        "n_layers": 2 if on_tpu else 1,
+        "d_ff": 1024 if on_tpu else 64,
+        "n_heads": 4 if on_tpu else 2,
+        "n_kv_heads": 2 if on_tpu else 0,
+    }, max_seq=max_len)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    dparams = init_params(jax.random.PRNGKey(1), dcfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 128), 0,
+                                cfg.vocab, "int32")
+    key = jax.random.PRNGKey(3)
+
+    def per_token(fn):
+        t_full = measure_forced(lambda: fn(n), repetitions=3).min_s
+        t_half = measure_forced(lambda: fn(n // 2), repetitions=3).min_s
+        return max(t_full - t_half, 0.0) / (n - n // 2)
+
+    for label, kwargs in (("greedy", {}),
+                          (f"temp={temp}/top{top_k}",
+                           {"key": key, "temperature": temp,
+                            "top_k": top_k})):
+        t_plain = per_token(
+            lambda m: generate(params, prompt, cfg, m, **kwargs)
+        )
+        print(f"plain {label}: {t_plain * 1e3:.3f} ms/token", flush=True)
+        for gamma in gammas:
+            t = per_token(
+                lambda m: speculative_generate(
+                    params, cfg, dparams, dcfg, prompt, m, gamma=gamma,
+                    **kwargs)
+            )
+            print(f"spec  {label} gamma={gamma}: {t * 1e3:.3f} ms/token "
+                  f"({t_plain / t:.2f}x)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

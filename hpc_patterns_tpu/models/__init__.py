@@ -32,6 +32,8 @@ from hpc_patterns_tpu.models.decode import (  # noqa: F401
     generate,
     greedy_generate,
     init_cache,
+    init_paged_cache,
+    paged_generate,
     prefill,
 )
 from hpc_patterns_tpu.models.speculative import (  # noqa: F401

@@ -266,6 +266,48 @@ def prefill(params, prompt, cfg: TransformerConfig, max_len: int,
     return logits.astype(jnp.float32), cache
 
 
+def _token_step(params, pos, tokens, cfg: TransformerConfig,
+                layer_states, attend_update):
+    """Shared single-token transformer skeleton: embed, the UNROLLED
+    layer loop (static per-layer param slices fuse; a lax.scan would
+    stack the updated caches into a fresh (L, ...) block — a full
+    cache rewrite per token), final norm, lm_head. Per layer it runs
+    norm → qkv → rope (the CURRENT global position; cached keys are
+    already post-rope from prefill) and then delegates to
+    ``attend_update(q, k_new, v_new, state) -> (o, new_state)`` — the
+    cache write + attention, the ONLY part that differs between the
+    linear cache (flash/gather/int8/tp routes, :func:`decode_step`)
+    and the paged cache (:func:`paged_decode_step`). One skeleton, so
+    the two cannot drift."""
+    dt = jnp.dtype(cfg.dtype)
+    B = tokens.shape[0]
+    x = params["embed"].astype(dt)[tokens]  # (B, D)
+    if cfg.pos_embed == "learned":
+        x = x + lax.dynamic_slice_in_dim(
+            params["pos_embed"].astype(dt), pos, 1, axis=0
+        )
+    new_states = []
+    for l in range(cfg.n_layers):
+        lp = jax.tree.map(lambda a: a[l], params["layers"])
+        hn = _rmsnorm(x, lp["ln1_scale"])
+        q, k_new, v_new = project_qkv(hn, lp, cfg)  # (B, H/Hkv, Dh)
+        if cfg.pos_embed == "rope":
+            q = apply_rope(q, pos, cfg)
+            k_new = apply_rope(k_new, pos, cfg)
+        # GQA grouped attention against the UNEXPANDED cache: q head
+        # k*g+j (project_qkv's order) reads kv head k directly — no
+        # materialized n_heads-wide repeat, so per-step HBM traffic is
+        # the kv_heads-narrow cache read (the saving GQA exists for)
+        o, st = attend_update(q, k_new, v_new, layer_states[l])
+        o = jnp.dot(o.reshape(B, cfg.d_model).astype(dt),
+                    lp["wo"].astype(dt))
+        x = _mlp(x + o, lp, cfg)
+        new_states.append(st)
+    x = _rmsnorm(x, params["ln_f_scale"])
+    logits = jnp.dot(x, params["lm_head"].astype(dt))
+    return logits.astype(jnp.float32), new_states
+
+
 def decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
                 mesh=None):
     """One token for every sequence in the batch: ``tokens`` (B,) int32
@@ -283,24 +325,12 @@ def decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
     B = tokens.shape[0]
     scale = 1.0 / (cfg.head_dim ** 0.5)
     use_flash, flash_sharded = _flash_route(mesh, cfg)
-    x = params["embed"].astype(dt)[tokens]  # (B, D)
-    if cfg.pos_embed == "learned":
-        x = x + lax.dynamic_slice_in_dim(
-            params["pos_embed"].astype(dt), pos, 1, axis=0
-        )
 
     Hkv, g, Dh = cfg.kv_heads, cfg.n_heads // cfg.kv_heads, cfg.head_dim
     int8_cache = cfg.kv_cache_dtype == "int8"
 
-    def body(h, lp, k_cache, v_cache, k_scale=None, v_scale=None):
-        hn = _rmsnorm(h, lp["ln1_scale"])
-        q, k_new, v_new = project_qkv(hn, lp, cfg)  # (B, H/Hkv, Dh)
-        if cfg.pos_embed == "rope":
-            # rotate at the CURRENT global position (scalar pos
-            # broadcasts over the batch); cached keys are already
-            # post-rope (see prefill)
-            q = apply_rope(q, pos, cfg)
-            k_new = apply_rope(k_new, pos, cfg)
+    def attend_update(q, k_new, v_new, state):
+        k_cache, v_cache, k_scale, v_scale = state
         if int8_cache:
             k_q, k_s = _quantize_rows(k_new)
             v_q, v_s = _quantize_rows(v_new)
@@ -323,11 +353,6 @@ def decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
             v_cache = lax.dynamic_update_slice(
                 v_cache, v_new[:, :, None].astype(dt), (0, 0, pos, 0)
             )
-        # GQA grouped attention against the UNEXPANDED cache: q head
-        # k*g+j (project_qkv's order) reads kv head k directly — no
-        # materialized n_heads-wide repeat of the cache, so the per-step
-        # HBM traffic is the kv_heads-narrow cache read, which is the
-        # saving GQA exists for
         if use_flash:
             from hpc_patterns_tpu.ops.flash_decode import (
                 flash_decode_attention,
@@ -388,33 +413,22 @@ def decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
             p = jax.nn.softmax(s, axis=-1)
             o = jnp.einsum("bkgs,bksd->bkgd", p, vd,
                            precision=lax.Precision.HIGHEST)
-        o = jnp.dot(o.reshape(B, cfg.d_model).astype(dt),
-                    lp["wo"].astype(dt))
-        h = _mlp(h + o, lp, cfg)
-        return h, (k_cache, v_cache, k_scale, v_scale)
+        return o, (k_cache, v_cache, k_scale, v_scale)
 
-    # UNROLLED layer loop (static per-layer param slices fuse; a lax.scan
-    # here would stack the updated caches into a fresh (L, ...) block —
-    # a full cache rewrite per token): each layer's cache buffer aliases
-    # through the generation scan's carry, so the update is in place
-    ks, vs, kss, vss = [], [], [], []
-    for l in range(cfg.n_layers):
-        lp = jax.tree.map(lambda a: a[l], params["layers"])
-        scales = ({"k_scale": cache["k_scale"][l],
-                   "v_scale": cache["v_scale"][l]} if int8_cache else {})
-        x, (k_l, v_l, ks_l, vs_l) = body(x, lp, cache["k"][l],
-                                         cache["v"][l], **scales)
-        ks.append(k_l)
-        vs.append(v_l)
-        kss.append(ks_l)
-        vss.append(vs_l)
-    x = _rmsnorm(x, params["ln_f_scale"])
-    logits = jnp.dot(x, params["lm_head"].astype(dt))
-    new_cache = {"k": tuple(ks), "v": tuple(vs)}
+    states = [
+        (cache["k"][l], cache["v"][l],
+         cache["k_scale"][l] if int8_cache else None,
+         cache["v_scale"][l] if int8_cache else None)
+        for l in range(cfg.n_layers)
+    ]
+    logits, new_states = _token_step(params, pos, tokens, cfg,
+                                     states, attend_update)
+    new_cache = {"k": tuple(s[0] for s in new_states),
+                 "v": tuple(s[1] for s in new_states)}
     if int8_cache:
-        new_cache["k_scale"] = tuple(kss)
-        new_cache["v_scale"] = tuple(vss)
-    return logits.astype(jnp.float32), new_cache
+        new_cache["k_scale"] = tuple(s[2] for s in new_states)
+        new_cache["v_scale"] = tuple(s[3] for s in new_states)
+    return logits, new_cache
 
 
 def extend_step(params, cache, pos, tokens, cfg: TransformerConfig):
@@ -519,31 +533,42 @@ def _pick(logits, key, temperature, greedy: bool, top_k: int):
     )
 
 
+def _generation_scan(step_fn, logits, cache, start_pos, new_tokens, key,
+                     temperature, greedy, top_k):
+    """The shared generation loop: pick the first token from the
+    prefill logits, then scan ``step_fn(cache, pos, tok) -> (logits,
+    cache)`` for the rest — ONE copy of the pick/scan/emit machinery
+    for the linear and paged caches (a sampling change lands in both
+    or neither)."""
+    key, sub = jax.random.split(key)
+    first = _pick(logits, sub, temperature, greedy, top_k)
+    if new_tokens == 1:
+        return first[:, None]
+
+    def step(carry, _):
+        cache, pos, tok, key = carry
+        logits, cache = step_fn(cache, pos, tok)
+        key, sub = jax.random.split(key)
+        nxt = _pick(logits, sub, temperature, greedy, top_k)
+        return (cache, pos + 1, nxt, key), tok
+
+    (_, _, last, _), toks = lax.scan(
+        step, (cache, jnp.int32(start_pos), first, key), None,
+        length=new_tokens - 1,
+    )
+    return jnp.concatenate([toks.T, last[:, None]], axis=1)
+
+
 @partial(jax.jit, static_argnums=(2, 3, 6, 7, 8))
 def _generate_jit(params, prompt, cfg, new_tokens, key, temperature,
                   greedy, top_k, mesh=None):
     B, T = prompt.shape
     max_len = T + new_tokens
     logits, cache = prefill(params, prompt, cfg, max_len, mesh=mesh)
-    key, sub = jax.random.split(key)
-    first = _pick(logits, sub, temperature, greedy, top_k)
-
-    if new_tokens == 1:
-        return first[:, None]
-
-    def step(carry, _):
-        cache, pos, tok, key = carry
-        logits, cache = decode_step(params, cache, pos, tok, cfg,
-                                    mesh=mesh)
-        key, sub = jax.random.split(key)
-        nxt = _pick(logits, sub, temperature, greedy, top_k)
-        return (cache, pos + 1, nxt, key), tok
-
-    (_, _, last, _), toks = lax.scan(
-        step, (cache, jnp.int32(T), first, key), None,
-        length=new_tokens - 1,
+    return _generation_scan(
+        lambda c, p, t: decode_step(params, c, p, t, cfg, mesh=mesh),
+        logits, cache, T, new_tokens, key, temperature, greedy, top_k,
     )
-    return jnp.concatenate([toks.T, last[:, None]], axis=1)
 
 
 def generate(params, prompt, cfg: TransformerConfig, new_tokens: int, *,
@@ -579,3 +604,209 @@ def greedy_generate(params, prompt, cfg: TransformerConfig,
     equivalence (identical to re-running forward() on the growing
     sequence each step) is the decode test's invariant."""
     return generate(params, prompt, cfg, new_tokens, mesh=mesh)
+
+
+# ---------------------------------------------------------------------------
+# Paged KV cache (block-table serving)
+# ---------------------------------------------------------------------------
+
+def init_paged_cache(cfg: TransformerConfig, batch: int,
+                     pages_per_seq: int, page_size: int,
+                     pool_pages: int | None = None, table=None):
+    """Paged KV cache: per-layer page POOLS plus one page table.
+
+    The capacity lever the linear cache cannot offer: a linear cache
+    allocates ``batch x max_len`` rows up front (the declared maximum),
+    a paged cache allocates ``pool_pages x page_size`` rows — sized to
+    the tokens that will actually exist. Layout per layer:
+    (pool_pages, kv_heads, page_size, head_dim), the page-major form
+    ops/flash_decode.flash_decode_paged streams; ``table``:
+    (batch, pages_per_seq) int32 page ids (default: the identity
+    layout; any permutation is equally valid — the kernel indirects
+    through the table, which is what makes future dynamic allocation
+    policies free). Compute-dtype pages only (the int8 lever composes
+    with the LINEAR cache; quantized pages are future work)."""
+    if cfg.kv_cache_dtype != "compute":
+        raise ValueError("paged cache supports kv_cache_dtype='compute'")
+    if pool_pages is None:
+        pool_pages = batch * pages_per_seq
+    if table is None:
+        if pool_pages < batch * pages_per_seq:
+            # a default table over an undersized pool would silently
+            # ALIAS pages across sequences (each clobbering the others'
+            # K/V); page sharing is an eviction policy, not a default —
+            # callers wanting it must pass an explicit table
+            raise ValueError(
+                f"pool_pages {pool_pages} < batch*pages_per_seq "
+                f"{batch * pages_per_seq}: the default identity table "
+                "needs a page per (sequence, slot); pass an explicit "
+                "table to share pages deliberately"
+            )
+        table = jnp.arange(batch * pages_per_seq, dtype=jnp.int32)
+        table = table.reshape(batch, pages_per_seq)
+    dt = jnp.dtype(cfg.dtype)
+    shape = (pool_pages, cfg.kv_heads, page_size, cfg.head_dim)
+    fresh = lambda: tuple(jnp.zeros(shape, dt)
+                          for _ in range(cfg.n_layers))
+    return {"k": fresh(), "v": fresh(),
+            "table": jnp.asarray(table, jnp.int32)}
+
+
+def paged_prefill(params, prompt, cfg: TransformerConfig, cache,
+                  page_size: int):
+    """Prompt pass writing into the paged cache: the ordinary prefill
+    captures K/V for the prompt (a transient sized to the PROMPT, not
+    the serving maximum), then each layer's pages scatter into the pool
+    through the table. Returns (last_logits, cache)."""
+    B, T = prompt.shape
+    P = page_size
+    t_pad = -(-T // P) * P
+    n_used = t_pad // P
+    table = cache["table"]
+    if n_used > table.shape[1]:
+        raise ValueError(
+            f"prompt needs {n_used} pages; table has {table.shape[1]}"
+        )
+    # capture at the PROMPT length (always legal), pad to the page
+    # boundary afterwards — asking prefill for t_pad would spuriously
+    # trip its max_len <= cfg.max_seq guard for prompts within a page
+    # of the model maximum
+    logits, lin = prefill(params, prompt, cfg, T)
+    if t_pad > T:
+        pad = [(0, 0), (0, 0), (0, t_pad - T), (0, 0)]
+        lin = jax.tree.map(lambda a: jnp.pad(a, pad), lin)
+    k_pool = list(cache["k"])
+    v_pool = list(cache["v"])
+    idx = table[:, :n_used]  # (B, n_used)
+    for l in range(cfg.n_layers):
+        for pool, lin_l in ((k_pool, lin["k"][l]), (v_pool, lin["v"][l])):
+            # (B, Hkv, t_pad, D) -> (B, n_used, Hkv, P, D) page blocks
+            pages = jnp.einsum(
+                "bhpsd->bphsd",
+                lin_l.reshape(B, cfg.kv_heads, n_used, P, cfg.head_dim),
+            )
+            pool[l] = pool[l].at[idx].set(pages.astype(pool[l].dtype))
+    return logits, {"k": tuple(k_pool), "v": tuple(v_pool),
+                    "table": table}
+
+
+def _pool_write(pool, page_ids, page, offset, rows, pages: int,
+                identity: bool):
+    """Write one (B, Hkv, D) K/V row into its page slot. The general
+    form is a scatter (pages anywhere in the pool) — correct for ANY
+    table but XLA materializes a pool copy per step. With the default
+    identity layout (page j of sequence b at pool row b·pages + j,
+    ``pages`` = the TABLE's pages_per_seq) AND an exact-size pool, the
+    write is a pure ``dynamic_update_slice`` on a (B, pages, ...) view
+    — aliased in place through the generation scan, the same
+    no-rematerialization property the linear cache's DUS has. An
+    OVERSIZED pool makes the view layout disagree with the table's row
+    numbering, so it falls through to the scatter."""
+    B = rows.shape[0]
+    if identity and pool.shape[0] == B * pages:
+        n_pool, Hkv, P, D = pool.shape
+        v = pool.reshape(B, pages, Hkv, P, D)
+        v = lax.dynamic_update_slice(
+            v, rows[:, None, :, None, :].astype(pool.dtype),
+            (0, page, 0, offset, 0),
+        )
+        return v.reshape(pool.shape)
+    return pool.at[page_ids, :, offset, :].set(rows.astype(pool.dtype))
+
+
+def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
+                      identity_layout: bool = False):
+    """One token per sequence against the paged cache: the new K/V row
+    scatters into page ``table[:, pos // P]`` at offset ``pos % P``,
+    and attention streams the live pages through
+    ops/flash_decode.flash_decode_paged. Single shared position cursor
+    (like decode_step); single-device (a pallas_call under GSPMD needs
+    the shard_map route — compose later if paged tp serving matters).
+    ``identity_layout`` (static): promise that the table is the default
+    identity layout, enabling the in-place DUS write (see
+    :func:`_pool_write`).
+
+    CONTRACT: ``pos < pages_per_seq * page_size`` — the caller owns the
+    capacity check (:func:`paged_generate` guards it). ``pos`` is a
+    traced scalar so this function cannot raise on it; past-capacity
+    steps clamp to the LAST page (``jnp.take``'s mode) and silently
+    corrupt its history."""
+    P = cache["k"][0].shape[2]
+    table = cache["table"]
+    scale = 1.0 / (cfg.head_dim ** 0.5)
+
+    from hpc_patterns_tpu.ops.flash_decode import flash_decode_paged
+
+    page = pos // P
+    page_ids = jnp.take(table, page, axis=1)  # (B,)
+    offset = pos % P
+
+    def attend_update(q, k_new, v_new, state):
+        k_pool, v_pool = state
+        k_pool = _pool_write(k_pool, page_ids, page, offset, k_new,
+                             table.shape[1], identity_layout)
+        v_pool = _pool_write(v_pool, page_ids, page, offset, v_new,
+                             table.shape[1], identity_layout)
+        o = flash_decode_paged(q, k_pool, v_pool, table, pos, scale=scale)
+        return o, (k_pool, v_pool)
+
+    states = [(cache["k"][l], cache["v"][l])
+              for l in range(cfg.n_layers)]
+    logits, new_states = _token_step(params, pos, tokens, cfg,
+                                     states, attend_update)
+    return logits, {
+        "k": tuple(s[0] for s in new_states),
+        "v": tuple(s[1] for s in new_states),
+        "table": table,
+    }
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4, 5, 8, 9))
+def _paged_generate_jit(params, prompt, cfg, new_tokens, page_size,
+                        pages_per_seq, key, temperature, greedy, top_k):
+    B, T = prompt.shape
+    cache = init_paged_cache(cfg, B, pages_per_seq, page_size)
+    logits, cache = paged_prefill(params, prompt, cfg, cache, page_size)
+    # the jit built its own default (identity) table above, so the
+    # in-place DUS write path is sound
+    return _generation_scan(
+        lambda c, p, t: paged_decode_step(params, c, p, t, cfg,
+                                          identity_layout=True),
+        logits, cache, T, new_tokens, key, temperature, greedy, top_k,
+    )
+
+
+def paged_generate(params, prompt, cfg: TransformerConfig,
+                   new_tokens: int, *, page_size: int = 512,
+                   pages_per_seq: int | None = None, key=None,
+                   temperature: float = 0.0, top_k: int = 0):
+    """Continuation (B, new_tokens) int32 served from the paged cache —
+    token-identical to :func:`generate` (the paged kernel reproduces
+    the linear kernel's f32 math exactly; oracle-tested). The cache
+    footprint is ``pages_per_seq * page_size`` tokens per sequence
+    (default: just enough pages for prompt + new_tokens) instead of the
+    linear cache's ``max_len`` — THE serving-capacity lever when the
+    declared maximum is far above typical generation length."""
+    if new_tokens < 1:
+        raise ValueError(f"new_tokens must be >= 1, got {new_tokens}")
+    B, T = prompt.shape
+    need = T + new_tokens
+    if need > cfg.max_seq:
+        raise ValueError(
+            f"prompt {T} + new {new_tokens} exceeds max_seq {cfg.max_seq}"
+        )
+    if pages_per_seq is None:
+        pages_per_seq = -(-need // page_size)
+    if pages_per_seq * page_size < need:
+        raise ValueError(
+            f"{pages_per_seq} pages of {page_size} < {need} tokens"
+        )
+    if temperature > 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    return _paged_generate_jit(
+        params, prompt, cfg, new_tokens, page_size, pages_per_seq, key,
+        jnp.float32(max(temperature, 1e-6)), temperature <= 0.0,
+        int(top_k),
+    )

@@ -195,3 +195,93 @@ def flash_decode_attention(
         interpret=interpret,
     )(*operands)
     return out.reshape(B, H, D)
+
+
+def _decode_kernel_paged(pos_ref, table_ref, q_ref, k_ref, v_ref, *rest,
+                         scale: float):
+    # same online-softmax body; the table ref is consumed by the index
+    # maps only (the logical position math needs just pos and si)
+    del table_ref
+    _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, scale=scale,
+                   quantized=False)
+
+
+def flash_decode_paged(
+    q,
+    k_pool,
+    v_pool,
+    table,
+    pos,
+    *,
+    scale: float | None = None,
+    interpret: bool | None = None,
+):
+    """Single-query attention against a PAGED KV cache.
+
+    The block-table serving layout (vLLM-style, TPU-shaped): K/V live
+    in a shared pool of fixed-size pages and each sequence owns an
+    ordered page list — allocation follows ACTUAL generation length,
+    not the declared maximum (the linear cache's
+    allocate-for-the-longest waste is the round-3 capacity ceiling).
+    The kernel is the linear ``flash_decode_attention`` body unchanged;
+    only the index map differs — the page id for grid step ``si`` is
+    read from the scalar-prefetched table, so the indirection costs
+    nothing per block and pages can live ANYWHERE in the pool.
+
+    ``q``: (B, n_heads, head_dim); ``k_pool``/``v_pool``:
+    (pool_pages, kv_heads, page_size, head_dim) in the compute dtype;
+    ``table``: (B, pages_per_seq) int32 page ids (entries past the live
+    prefix may be any valid id — the clamped index map never fetches
+    them); ``pos``: traced int32 scalar, the batch-uniform position
+    being decoded. Returns (B, n_heads, head_dim) f32, numerically
+    identical to the linear kernel on the equivalent cache.
+    """
+    B, H, D = q.shape
+    n_pool, Hkv, P, Dp = k_pool.shape
+    pages = table.shape[1]
+    if H % Hkv or v_pool.shape != k_pool.shape or Dp != D:
+        raise ValueError(
+            f"shape mismatch: q {q.shape}, pools {k_pool.shape}/"
+            f"{v_pool.shape}"
+        )
+    if table.shape[0] != B:
+        raise ValueError(f"table rows {table.shape[0]} != batch {B}")
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    g = H // Hkv
+
+    qr = q.reshape(B * Hkv, g, D)
+    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+    table_flat = table.reshape(-1).astype(jnp.int32)
+
+    def page_idx(r, si, pos_ref, table_ref):
+        # clamp to the last live page (same fetch-elision as the linear
+        # kernel), then indirect through this sequence's page list
+        b = r // Hkv
+        live = jnp.minimum(si, pos_ref[0] // P)
+        return table_ref[b * pages + live], r % Hkv, 0, 0
+
+    row = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel_paged, scale=float(scale)),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B * Hkv, pages),
+            in_specs=[
+                row((None, g, D), lambda r, si, pos, tab: (r, 0, 0)),
+                row((None, None, P, D), page_idx),
+                row((None, None, P, D), page_idx),
+            ],
+            out_specs=row((None, g, D), lambda r, si, pos, tab: (r, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((g, 1), jnp.float32),
+                pltpu.VMEM((g, 1), jnp.float32),
+                pltpu.VMEM((g, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * Hkv, g, D), jnp.float32),
+        interpret=interpret,
+    )(pos_arr, table_flat, qr, k_pool, v_pool)
+    return out.reshape(B, H, D)

@@ -472,3 +472,165 @@ class TestSpeculativeBatched:
             params, cfg, dparams, dcfg, prompts, 10, gamma=3
         ))
         np.testing.assert_array_equal(got, want)
+
+
+class TestPagedCache:
+    """Block-table (paged) KV serving: the paged kernel must reproduce
+    the linear kernel exactly through ANY page permutation, and
+    paged_generate must be token-identical to generate — the capacity
+    lever changes allocation, never tokens."""
+
+    def test_paged_kernel_matches_linear_permuted_table(self):
+        from hpc_patterns_tpu.ops.flash_decode import (
+            flash_decode_attention,
+            flash_decode_paged,
+        )
+
+        B, H, Hkv, D, P, pages = 2, 4, 2, 8, 16, 4
+        S = P * pages
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (B, H, D), jnp.float32)
+        kc = jax.random.normal(ks[1], (B, Hkv, S, D), jnp.float32)
+        vc = jax.random.normal(ks[2], (B, Hkv, S, D), jnp.float32)
+        pos = jnp.int32(37)  # mid-page, pages beyond never fetched
+        want = flash_decode_attention(q, kc, vc, pos)
+
+        perm = np.random.default_rng(0).permutation(B * pages)
+        table = jnp.asarray(perm.reshape(B, pages), jnp.int32)
+        pool_k = jnp.zeros((B * pages, Hkv, P, D), jnp.float32)
+        pool_v = jnp.zeros_like(pool_k)
+        for b in range(B):
+            for j in range(pages):
+                pool_k = pool_k.at[perm[b * pages + j]].set(
+                    kc[b, :, j * P:(j + 1) * P])
+                pool_v = pool_v.at[perm[b * pages + j]].set(
+                    vc[b, :, j * P:(j + 1) * P])
+        got = flash_decode_paged(q, pool_k, pool_v, table, pos)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-6)
+
+    @pytest.mark.parametrize("over", [
+        {},
+        {"pos_embed": "rope", "n_kv_heads": 2},  # flagship serving
+    ])
+    def test_paged_generate_token_exact(self, over):
+        from hpc_patterns_tpu.models.decode import paged_generate
+
+        cfg, params, prompt = _setup(**over)
+        want = np.asarray(greedy_generate(params, prompt, cfg, 8))
+        got = np.asarray(paged_generate(params, prompt, cfg, 8,
+                                        page_size=8))
+        np.testing.assert_array_equal(got, want)
+
+    def test_paged_sampling_same_draws(self):
+        # same key, same warp, bitwise-identical attention: the paged
+        # path must emit the SAME sampled tokens as the linear path
+        from hpc_patterns_tpu.models.decode import generate, paged_generate
+
+        cfg, params, prompt = _setup()
+        key = jax.random.PRNGKey(11)
+        want = np.asarray(generate(params, prompt, cfg, 8, key=key,
+                                   temperature=0.9, top_k=8))
+        got = np.asarray(paged_generate(params, prompt, cfg, 8,
+                                        page_size=8, key=key,
+                                        temperature=0.9, top_k=8))
+        np.testing.assert_array_equal(got, want)
+
+    def test_allocation_tracks_need_not_max(self):
+        # the capacity contract: pages allocate for prompt+new_tokens,
+        # not cfg.max_seq — at max_seq=32 and 16 needed tokens the pool
+        # is half the linear cache
+        from hpc_patterns_tpu.models.decode import init_paged_cache
+
+        cfg, params, prompt = _setup()  # max_seq 32
+        cache = init_paged_cache(cfg, 2, pages_per_seq=2, page_size=8)
+        pool_tokens = cache["k"][0].shape[0] * cache["k"][0].shape[2]
+        assert pool_tokens == 2 * 2 * 8  # B * pages * page_size
+        assert pool_tokens < 2 * cfg.max_seq
+
+    def test_guards(self):
+        from hpc_patterns_tpu.models.decode import (
+            init_paged_cache,
+            paged_generate,
+        )
+
+        cfg, params, prompt = _setup()
+        with pytest.raises(ValueError, match="pages"):
+            paged_generate(params, prompt, cfg, 8, page_size=8,
+                           pages_per_seq=1)
+        qcfg = TransformerConfig(**{**BASE, "kv_cache_dtype": "int8"})
+        with pytest.raises(ValueError, match="compute"):
+            init_paged_cache(qcfg, 2, 2, 8)
+
+    def test_identity_write_path_matches_scatter(self):
+        # the in-place DUS fast path (identity table) must produce the
+        # same logits/cache as the general scatter write
+        from hpc_patterns_tpu.models.decode import (
+            init_paged_cache,
+            paged_decode_step,
+            paged_prefill,
+        )
+
+        cfg, params, prompt = _setup()
+        cache = init_paged_cache(cfg, 2, pages_per_seq=3, page_size=8)
+        _, cache = paged_prefill(params, prompt, cfg, cache, 8)
+        tok = jnp.array([1, 2], jnp.int32)
+        l_scatter, c_scatter = paged_decode_step(
+            params, cache, jnp.int32(8), tok, cfg)
+        l_dus, c_dus = paged_decode_step(
+            params, cache, jnp.int32(8), tok, cfg, identity_layout=True)
+        np.testing.assert_allclose(np.asarray(l_scatter),
+                                   np.asarray(l_dus), atol=1e-6)
+        for a, b in zip(jax.tree.leaves(c_scatter),
+                        jax.tree.leaves(c_dus)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_undersized_pool_default_table_rejected(self):
+        # a default table over an undersized pool would alias pages
+        # across sequences (silent K/V clobbering): must raise
+        from hpc_patterns_tpu.models.decode import init_paged_cache
+
+        cfg, _, _ = _setup()
+        with pytest.raises(ValueError, match="pool_pages"):
+            init_paged_cache(cfg, 2, pages_per_seq=2, page_size=8,
+                             pool_pages=2)
+
+    def test_prompt_within_a_page_of_max_seq(self):
+        # page padding must not trip prefill's max_len <= max_seq guard:
+        # prompt 17 + 3 new at max_seq 20 fits, though t_pad = 32 > 20
+        from hpc_patterns_tpu.models.decode import paged_generate
+
+        cfg = TransformerConfig(**{**BASE, "max_seq": 20})
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0,
+                                    cfg.vocab, jnp.int32)
+        want = np.asarray(greedy_generate(params, prompt, cfg, 3))
+        got = np.asarray(paged_generate(params, prompt, cfg, 3,
+                                        page_size=16))
+        np.testing.assert_array_equal(got, want)
+
+    def test_oversized_pool_identity_falls_back_to_scatter(self):
+        # pool_pages > batch*pages_per_seq with an explicit identity
+        # table: the DUS view layout would disagree with the table's
+        # row numbering, so the fast path must fall through to the
+        # scatter and stay token-exact
+        from hpc_patterns_tpu.models.decode import (
+            init_paged_cache,
+            paged_decode_step,
+            paged_prefill,
+        )
+
+        cfg, params, prompt = _setup()
+        ident = jnp.arange(4, dtype=jnp.int32).reshape(2, 2)
+        big = init_paged_cache(cfg, 2, pages_per_seq=2, page_size=8,
+                               pool_pages=6, table=ident)
+        exact = init_paged_cache(cfg, 2, pages_per_seq=2, page_size=8)
+        _, big = paged_prefill(params, prompt, cfg, big, 8)
+        _, exact = paged_prefill(params, prompt, cfg, exact, 8)
+        tok = jnp.array([1, 2], jnp.int32)
+        l_big, _ = paged_decode_step(params, big, jnp.int32(8), tok, cfg,
+                                     identity_layout=True)
+        l_exact, _ = paged_decode_step(params, exact, jnp.int32(8), tok,
+                                       cfg, identity_layout=True)
+        np.testing.assert_allclose(np.asarray(l_big), np.asarray(l_exact),
+                                   atol=1e-6)
