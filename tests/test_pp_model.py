@@ -140,6 +140,27 @@ class TestPPModel:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-5)
 
+    def test_fused_mlp_pp_matches_oracle(self):
+        # the Pallas fused MLP inside pipeline stages (mesh=None stage
+        # math, interpret mode on CPU) must reproduce the dense oracle
+        cfg = TransformerConfig(**{**CFG, "mlp_impl": "fused"})
+        dense = TransformerConfig(**CFG)
+        params = init_params(jax.random.PRNGKey(0), dense)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0, 32,
+                                    "int32")
+        want_loss, want_g = jax.value_and_grad(
+            lambda p: loss_fn(p, tokens, dense)
+        )(params)
+        mesh = topology.make_mesh({"pp": 2}, jax.devices()[:2])
+        loss, grads = pplib.pp_loss_and_grads(
+            params, tokens, cfg, mesh, microbatches=2
+        )
+        np.testing.assert_allclose(float(loss), float(want_loss),
+                                   rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(grads), jax.tree.leaves(want_g)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4)
+
     def test_rope_pp_matches_oracle(self):
         # rope params have no pos_embed entry; the pp grads dict must
         # mirror that and still match the end-to-end oracle
