@@ -102,14 +102,15 @@ def _accept_resample(key, props, q_probs, p_probs):
     return a, nxt.astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnums=(1, 3, 5, 6, 8, 9))
+@partial(jax.jit, static_argnums=(1, 3, 5, 6, 8, 9, 11))
 def _speculative_jit(params, cfg, draft_params, draft_cfg, prompt,
                      new_tokens, gamma, key=None, greedy=True, top_k=0,
-                     temperature=1.0):
+                     temperature=1.0, mesh=None):
     B, T = prompt.shape
     max_len = T + new_tokens + gamma + 1  # slack for the final round
-    logits, cache = prefill(params, prompt, cfg, max_len)
-    _, dcache = prefill(draft_params, prompt, draft_cfg, max_len)
+    logits, cache = prefill(params, prompt, cfg, max_len, mesh=mesh)
+    _, dcache = prefill(draft_params, prompt, draft_cfg, max_len,
+                        mesh=mesh)
     if key is None:
         key = jax.random.PRNGKey(0)  # unused in greedy mode
     key, sub = jax.random.split(key)
@@ -132,7 +133,7 @@ def _speculative_jit(params, cfg, draft_params, draft_cfg, prompt,
         dc = dcache
         for j in range(gamma + 1):
             dlogits, dc = decode_step(draft_params, dc, pos + j, tok,
-                                      draft_cfg)
+                                      draft_cfg, mesh=mesh)
             key, sub = jax.random.split(key)
             tok = _pick(dlogits, sub, temperature, greedy, top_k)
             if j < gamma:
@@ -213,7 +214,8 @@ def _sampling_args(cfg, temperature, top_k, key):
 def speculative_generate(params, cfg: TransformerConfig, draft_params,
                          draft_cfg: TransformerConfig, prompt,
                          new_tokens: int, *, gamma: int = 4, key=None,
-                         temperature: float = 0.0, top_k: int = 0):
+                         temperature: float = 0.0, top_k: int = 0,
+                         mesh=None):
     """Continuation (1, new_tokens) int32. Greedy by default —
     token-identical to ``greedy_generate(params, prompt, cfg,
     new_tokens)``: the draft only changes HOW FAST tokens come, never
@@ -225,6 +227,9 @@ def speculative_generate(params, cfg: TransformerConfig, draft_params,
     ``prompt``: (1, T); ``gamma``: proposals per round (the draft/target
     cost ratio picks it — more acceptance, longer verified chunks).
     Both configs must share the vocabulary; compute-dtype caches.
+    ``mesh``: tp-sharded serving — the prefills and the draft's decode
+    steps take the shard_map flash route (decode.generate's contract);
+    the verification extend is GSPMD-partitioned einsum math already.
     """
     if prompt.shape[0] != 1:
         raise ValueError(
@@ -237,7 +242,7 @@ def speculative_generate(params, cfg: TransformerConfig, draft_params,
     )
     return _speculative_jit(params, cfg, draft_params, draft_cfg, prompt,
                             new_tokens, gamma, key, greedy, top_k,
-                            temperature)
+                            temperature, mesh)
 
 
 def speculative_generate_batched(params, cfg: TransformerConfig,
@@ -253,7 +258,9 @@ def speculative_generate_batched(params, cfg: TransformerConfig,
     :func:`speculative_generate` (oracle-tested; sampling rows each
     consume their own fold of ``key``). Wall-clock note: the batch
     advances at the SLOWEST row's acceptance rate; per-sequence calls
-    win when acceptance varies wildly."""
+    win when acceptance varies wildly. Single-device only (vmap over
+    the tp shard_map route is not supported; use per-sequence
+    ``speculative_generate(..., mesh=...)`` for sharded serving)."""
     if prompts.ndim != 2:
         raise ValueError(f"prompts must be (B, T), got {prompts.shape}")
     _validate(cfg, draft_cfg, prompts.shape[1], new_tokens, gamma)

@@ -634,3 +634,26 @@ class TestPagedCache:
                                        cfg, identity_layout=True)
         np.testing.assert_allclose(np.asarray(l_big), np.asarray(l_exact),
                                    atol=1e-6)
+
+
+class TestSpeculativeSharded:
+    def test_tp_speculative_greedy_token_exact(self, mesh_dp_sp_tp):
+        # speculative decoding under tp: prefills and draft steps ride
+        # the shard_map flash route, the verify extend rides GSPMD —
+        # tokens must equal the unsharded speculative (= plain greedy)
+        from hpc_patterns_tpu.models.sharding import shard_params
+        from hpc_patterns_tpu.models.speculative import speculative_generate
+
+        cfg, params, prompt = _setup(batch=1, n_heads=4, n_kv_heads=2)
+        dcfg = TransformerConfig(**{**BASE, "d_model": 16, "d_ff": 32,
+                                    "n_layers": 1, "n_heads": 2,
+                                    "n_kv_heads": 2})
+        dparams = init_params(jax.random.PRNGKey(42), dcfg)
+        want = np.asarray(greedy_generate(params, prompt, cfg, 8))
+        p_sh = shard_params(params, mesh_dp_sp_tp, cfg)
+        dp_sh = shard_params(dparams, mesh_dp_sp_tp, dcfg)
+        got = np.asarray(jax.device_get(speculative_generate(
+            p_sh, cfg, dp_sh, dcfg, prompt, 8, gamma=3,
+            mesh=mesh_dp_sp_tp,
+        )))
+        np.testing.assert_array_equal(got, want)

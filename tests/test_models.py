@@ -408,3 +408,22 @@ class TestFusedMLPModel:
             lambda p, t: loss_fn(p, t, cfg_f, mesh_dp_sp_tp)
         )(p_sh, tokens))
         np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_fsdp_mesh_matches_dense(self):
+        # fused MLP under ZeRO-3: w1/w2 stored fsdp-sharded, gathered by
+        # GSPMD at the shard_map boundary — loss equals the dense oracle
+        from hpc_patterns_tpu import topology
+        from hpc_patterns_tpu.models.sharding import shard_params
+
+        cfg_f = TransformerConfig(**{**TINY, "mlp_impl": "fused",
+                                     "fsdp": True})
+        cfg_d = TransformerConfig(**TINY)
+        mesh = topology.make_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+        params = init_params(jax.random.PRNGKey(0), cfg_d)
+        tokens = _tokens(jax.random.PRNGKey(1))
+        want = float(loss_fn(params, tokens, cfg_d))
+        p_sh = shard_params(params, mesh, cfg_f)
+        got = float(jax.jit(
+            lambda p, t: loss_fn(p, t, cfg_f, mesh)
+        )(p_sh, tokens))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
