@@ -283,9 +283,12 @@ def _token_step(params, pos, tokens, cfg: TransformerConfig,
     B = tokens.shape[0]
     x = params["embed"].astype(dt)[tokens]  # (B, D)
     if cfg.pos_embed == "learned":
-        x = x + lax.dynamic_slice_in_dim(
-            params["pos_embed"].astype(dt), pos, 1, axis=0
-        )
+        pe = params["pos_embed"].astype(dt)
+        # scalar pos: one shared row (DUS slice); ragged (B,) pos:
+        # per-row gather. rope needs no branch — apply_rope broadcasts
+        # either shape over the heads
+        x = x + (pe[pos] if jnp.ndim(pos)
+                 else lax.dynamic_slice_in_dim(pe, pos, 1, axis=0))
     new_states = []
     for l in range(cfg.n_layers):
         lp = jax.tree.map(lambda a: a[l], params["layers"])
@@ -719,34 +722,46 @@ def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
     """One token per sequence against the paged cache: the new K/V row
     scatters into page ``table[:, pos // P]`` at offset ``pos % P``,
     and attention streams the live pages through
-    ops/flash_decode.flash_decode_paged. Single shared position cursor
-    (like decode_step); single-device (a pallas_call under GSPMD needs
-    the shard_map route — compose later if paged tp serving matters).
-    ``identity_layout`` (static): promise that the table is the default
-    identity layout, enabling the in-place DUS write (see
-    :func:`_pool_write`).
+    ops/flash_decode.flash_decode_paged. ``pos``: a shared scalar
+    cursor (like decode_step) OR a (B,) vector of per-sequence
+    positions — RAGGED serving, every sequence at its own length (the
+    kernel masks and clamps per row; rope/learned embeddings gather
+    per row; the cache write scatters per-row offsets). Single-device
+    (a pallas_call under GSPMD needs the shard_map route — compose
+    later if paged tp serving matters). ``identity_layout`` (static):
+    promise that the table is the default identity layout, enabling
+    the in-place DUS write for the scalar-cursor case (ragged writes
+    always scatter; see :func:`_pool_write`).
 
-    CONTRACT: ``pos < pages_per_seq * page_size`` — the caller owns the
-    capacity check (:func:`paged_generate` guards it). ``pos`` is a
-    traced scalar so this function cannot raise on it; past-capacity
-    steps clamp to the LAST page (``jnp.take``'s mode) and silently
-    corrupt its history."""
+    CONTRACT: every position < pages_per_seq * page_size — the caller
+    owns the capacity check (:func:`paged_generate` guards it). ``pos``
+    is traced so this function cannot raise on it; past-capacity steps
+    clamp to the LAST page (``jnp.take``'s mode) and silently corrupt
+    its history."""
     P = cache["k"][0].shape[2]
     table = cache["table"]
     scale = 1.0 / (cfg.head_dim ** 0.5)
+    ragged = jnp.ndim(pos) == 1
 
     from hpc_patterns_tpu.ops.flash_decode import flash_decode_paged
 
-    page = pos // P
-    page_ids = jnp.take(table, page, axis=1)  # (B,)
+    page = pos // P  # scalar, or (B,) per-sequence page index
+    if ragged:
+        page_ids = jnp.take_along_axis(
+            table, page[:, None], axis=1
+        )[:, 0]  # (B,) — each row its own column
+    else:
+        page_ids = jnp.take(table, page, axis=1)  # (B,)
     offset = pos % P
 
     def attend_update(q, k_new, v_new, state):
         k_pool, v_pool = state
         k_pool = _pool_write(k_pool, page_ids, page, offset, k_new,
-                             table.shape[1], identity_layout)
+                             table.shape[1],
+                             identity_layout and not ragged)
         v_pool = _pool_write(v_pool, page_ids, page, offset, v_new,
-                             table.shape[1], identity_layout)
+                             table.shape[1],
+                             identity_layout and not ragged)
         o = flash_decode_paged(q, k_pool, v_pool, table, pos, scale=scale)
         return o, (k_pool, v_pool)
 

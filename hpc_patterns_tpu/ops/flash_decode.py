@@ -42,12 +42,14 @@ _NEG_INF = -1e30
 
 
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, scale: float,
-                   quantized: bool):
+                   quantized: bool, hkv_per_row: int = 0):
     # grid (B·Hkv, n_s): one kv-cache block per step, grouped-query
     # online softmax carried in scratch over the S axis. ``quantized``:
     # the cache blocks are int8 with per-row scales (two extra refs) —
     # dequantized in VMEM, so HBM streams HALF the bytes of bf16 (the
-    # whole cost of a decode step on a read-bound path).
+    # whole cost of a decode step on a read-bound path). ``hkv_per_row``
+    # > 0: RAGGED positions — pos_ref holds one fill position per
+    # sequence and grid row r belongs to sequence r // hkv_per_row.
     if quantized:
         ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
     else:
@@ -56,7 +58,8 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, scale: float,
     block_s = k_ref.shape[0]
     si = pl.program_id(1)
     n_s = pl.num_programs(1)
-    pos = pos_ref[0]
+    pos = (pos_ref[pl.program_id(0) // hkv_per_row] if hkv_per_row
+           else pos_ref[0])
 
     @pl.when(si == 0)
     def _():
@@ -198,12 +201,12 @@ def flash_decode_attention(
 
 
 def _decode_kernel_paged(pos_ref, table_ref, q_ref, k_ref, v_ref, *rest,
-                         scale: float):
+                         scale: float, hkv_per_row: int = 0):
     # same online-softmax body; the table ref is consumed by the index
     # maps only (the logical position math needs just pos and si)
     del table_ref
     _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, scale=scale,
-                   quantized=False)
+                   quantized=False, hkv_per_row=hkv_per_row)
 
 
 def flash_decode_paged(
@@ -232,8 +235,11 @@ def flash_decode_paged(
     (pool_pages, kv_heads, page_size, head_dim) in the compute dtype;
     ``table``: (B, pages_per_seq) int32 page ids (entries past the live
     prefix may be any valid id — the clamped index map never fetches
-    them); ``pos``: traced int32 scalar, the batch-uniform position
-    being decoded. Returns (B, n_heads, head_dim) f32, numerically
+    them); ``pos``: traced int32 — a scalar (batch-uniform position)
+    or a (B,) vector of PER-SEQUENCE positions (ragged serving: every
+    sequence at its own length; each grid row masks and clamps by its
+    own sequence's fill position, so per-row HBM traffic follows
+    per-row length). Returns (B, n_heads, head_dim) f32, numerically
     identical to the linear kernel on the equivalent cache.
     """
     B, H, D = q.shape
@@ -253,19 +259,25 @@ def flash_decode_paged(
     g = H // Hkv
 
     qr = q.reshape(B * Hkv, g, D)
-    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+    ragged = jnp.ndim(pos) == 1
+    if ragged and jnp.shape(pos)[0] != B:
+        raise ValueError(
+            f"ragged pos has {jnp.shape(pos)[0]} entries for batch {B}"
+        )
+    pos_arr = jnp.asarray(pos, jnp.int32).reshape(B if ragged else 1)
     table_flat = table.reshape(-1).astype(jnp.int32)
 
     def page_idx(r, si, pos_ref, table_ref):
         # clamp to the last live page (same fetch-elision as the linear
         # kernel), then indirect through this sequence's page list
         b = r // Hkv
-        live = jnp.minimum(si, pos_ref[0] // P)
+        live = jnp.minimum(si, pos_ref[b if ragged else 0] // P)
         return table_ref[b * pages + live], r % Hkv, 0, 0
 
     row = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
     out = pl.pallas_call(
-        functools.partial(_decode_kernel_paged, scale=float(scale)),
+        functools.partial(_decode_kernel_paged, scale=float(scale),
+                          hkv_per_row=Hkv if ragged else 0),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(B * Hkv, pages),

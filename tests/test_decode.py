@@ -657,3 +657,61 @@ class TestSpeculativeSharded:
             mesh=mesh_dp_sp_tp,
         )))
         np.testing.assert_array_equal(got, want)
+
+
+class TestRaggedPaged:
+    @pytest.mark.parametrize("over", [
+        {},
+        {"pos_embed": "rope", "n_kv_heads": 2},  # flagship serving:
+        # per-row rope rotation + the GQA grid-row mapping
+        # (r // hkv_per_row) both ride the ragged path
+    ])
+    def test_ragged_positions_per_row_oracle(self, over):
+        # RAGGED serving: two sequences at different live lengths decode
+        # in ONE paged step with a (B,) position vector; each row's
+        # logits must equal its own single-sequence linear-flash decode
+        from hpc_patterns_tpu.models.decode import (
+            init_paged_cache,
+            paged_decode_step,
+        )
+
+        cfg, params, _ = _setup(**over)
+        P, pages = 8, 3
+        Hkv, Dh = cfg.kv_heads, cfg.head_dim
+        lens = (6, 11)
+        prompts = [
+            jax.random.randint(jax.random.PRNGKey(10 + i), (1, n), 0,
+                               cfg.vocab, jnp.int32)
+            for i, n in enumerate(lens)
+        ]
+        tok = jnp.array([3, 5], jnp.int32)
+
+        want = []
+        lins = []
+        for i, p in enumerate(prompts):
+            _, lin = prefill(params, p, cfg, pages * P)
+            lins.append(lin)
+            logits, _ = decode_step(params, lin, jnp.int32(lens[i]),
+                                    tok[i:i + 1], cfg)
+            want.append(np.asarray(logits[0]))
+
+        # shared pool: each row's prefix pages placed at the identity
+        # rows (b * pages + j)
+        cache = init_paged_cache(cfg, 2, pages, P)
+        k_pool = list(cache["k"])
+        v_pool = list(cache["v"])
+        for l in range(cfg.n_layers):
+            for b in range(2):
+                for key_name, pool in (("k", k_pool), ("v", v_pool)):
+                    chunks = lins[b][key_name][l].reshape(
+                        Hkv, pages, P, Dh).transpose(1, 0, 2, 3)
+                    pool[l] = pool[l].at[
+                        b * pages:(b + 1) * pages].set(chunks)
+        cache = {"k": tuple(k_pool), "v": tuple(v_pool),
+                 "table": cache["table"]}
+
+        pos = jnp.asarray(lens, jnp.int32)
+        got, _ = paged_decode_step(params, cache, pos, tok, cfg)
+        for b in range(2):
+            np.testing.assert_allclose(np.asarray(got[b]), want[b],
+                                       atol=1e-5, err_msg=f"row {b}")
