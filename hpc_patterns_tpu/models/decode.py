@@ -627,10 +627,11 @@ def init_paged_cache(cfg: TransformerConfig, batch: int,
     (batch, pages_per_seq) int32 page ids (default: the identity
     layout; any permutation is equally valid — the kernel indirects
     through the table, which is what makes future dynamic allocation
-    policies free). Compute-dtype pages only (the int8 lever composes
-    with the LINEAR cache; quantized pages are future work)."""
-    if cfg.kv_cache_dtype != "compute":
-        raise ValueError("paged cache supports kv_cache_dtype='compute'")
+    policies free). With ``cfg.kv_cache_dtype == "int8"`` the pools are
+    int8 plus per-row f32 scale pools (kernel-lane layout
+    (pool_pages, kv_heads, 1, page_size)) — the two CAPACITY levers
+    stack: int8 halves page bytes, paging frees the
+    allocate-for-longest waste."""
     if pool_pages is None:
         pool_pages = batch * pages_per_seq
     if table is None:
@@ -647,12 +648,18 @@ def init_paged_cache(cfg: TransformerConfig, batch: int,
             )
         table = jnp.arange(batch * pages_per_seq, dtype=jnp.int32)
         table = table.reshape(batch, pages_per_seq)
-    dt = jnp.dtype(cfg.dtype)
+    int8 = cfg.kv_cache_dtype == "int8"
+    dt = jnp.int8 if int8 else jnp.dtype(cfg.dtype)
     shape = (pool_pages, cfg.kv_heads, page_size, cfg.head_dim)
-    fresh = lambda: tuple(jnp.zeros(shape, dt)
-                          for _ in range(cfg.n_layers))
-    return {"k": fresh(), "v": fresh(),
-            "table": jnp.asarray(table, jnp.int32)}
+    fresh = lambda sh, d: tuple(jnp.zeros(sh, d)
+                                for _ in range(cfg.n_layers))
+    cache = {"k": fresh(shape, dt), "v": fresh(shape, dt),
+             "table": jnp.asarray(table, jnp.int32)}
+    if int8:
+        sshape = (pool_pages, cfg.kv_heads, 1, page_size)
+        cache["k_scale"] = fresh(sshape, jnp.float32)
+        cache["v_scale"] = fresh(sshape, jnp.float32)
+    return cache
 
 
 def paged_prefill(params, prompt, cfg: TransformerConfig, cache,
@@ -676,21 +683,39 @@ def paged_prefill(params, prompt, cfg: TransformerConfig, cache,
     # of the model maximum
     logits, lin = prefill(params, prompt, cfg, T)
     if t_pad > T:
-        pad = [(0, 0), (0, 0), (0, t_pad - T), (0, 0)]
-        lin = jax.tree.map(lambda a: jnp.pad(a, pad), lin)
-    k_pool = list(cache["k"])
-    v_pool = list(cache["v"])
+        # pad the sequence axis of every leaf (values are 4-D, int8
+        # scales 3-D)
+        lin = jax.tree.map(
+            lambda a: jnp.pad(
+                a, [(0, 0)] * 2 + [(0, t_pad - T)] + [(0, 0)] * (a.ndim - 3)
+            ),
+            lin,
+        )
     idx = table[:, :n_used]  # (B, n_used)
-    for l in range(cfg.n_layers):
-        for pool, lin_l in ((k_pool, lin["k"][l]), (v_pool, lin["v"][l])):
+    out = {"table": table}
+    for name in ("k", "v"):
+        pool = list(cache[name])
+        for l in range(cfg.n_layers):
             # (B, Hkv, t_pad, D) -> (B, n_used, Hkv, P, D) page blocks
             pages = jnp.einsum(
                 "bhpsd->bphsd",
-                lin_l.reshape(B, cfg.kv_heads, n_used, P, cfg.head_dim),
+                lin[name][l].reshape(B, cfg.kv_heads, n_used, P,
+                                     cfg.head_dim),
             )
             pool[l] = pool[l].at[idx].set(pages.astype(pool[l].dtype))
-    return logits, {"k": tuple(k_pool), "v": tuple(v_pool),
-                    "table": table}
+        out[name] = tuple(pool)
+    if cfg.kv_cache_dtype == "int8":
+        for name in ("k_scale", "v_scale"):
+            pool = list(cache[name])
+            for l in range(cfg.n_layers):
+                # (B, Hkv, t_pad) -> (B, n_used, Hkv, 1, P) lane-major
+                pages = jnp.einsum(
+                    "bhps->bphs",
+                    lin[name][l].reshape(B, cfg.kv_heads, n_used, P),
+                )[:, :, :, None, :]
+                pool[l] = pool[l].at[idx].set(pages)
+            out[name] = tuple(pool)
+    return logits, out
 
 
 def _pool_write(pool, page_ids, page, offset, rows, pages: int,
@@ -715,6 +740,22 @@ def _pool_write(pool, page_ids, page, offset, rows, pages: int,
         )
         return v.reshape(pool.shape)
     return pool.at[page_ids, :, offset, :].set(rows.astype(pool.dtype))
+
+
+def _scale_write(pool, page_ids, page, offset, rows, pages: int,
+                 identity: bool):
+    """int8 companion of :func:`_pool_write` for the (pool_pages,
+    kv_heads, 1, page_size) lane-major scale pools: one (B, kv_heads)
+    scale row lands at lane ``offset`` of its page."""
+    B = rows.shape[0]
+    if identity and pool.shape[0] == B * pages:
+        v = pool.reshape(B, pages, *pool.shape[1:])
+        v = lax.dynamic_update_slice(
+            v, rows[:, None, :, None, None].astype(pool.dtype),
+            (0, page, 0, 0, offset),
+        )
+        return v.reshape(pool.shape)
+    return pool.at[page_ids, :, 0, offset].set(rows.astype(pool.dtype))
 
 
 def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
@@ -754,26 +795,45 @@ def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
         page_ids = jnp.take(table, page, axis=1)  # (B,)
     offset = pos % P
 
-    def attend_update(q, k_new, v_new, state):
-        k_pool, v_pool = state
-        k_pool = _pool_write(k_pool, page_ids, page, offset, k_new,
-                             table.shape[1],
-                             identity_layout and not ragged)
-        v_pool = _pool_write(v_pool, page_ids, page, offset, v_new,
-                             table.shape[1],
-                             identity_layout and not ragged)
-        o = flash_decode_paged(q, k_pool, v_pool, table, pos, scale=scale)
-        return o, (k_pool, v_pool)
+    int8 = cfg.kv_cache_dtype == "int8"
+    ident = identity_layout and not ragged
+    pages = table.shape[1]
 
-    states = [(cache["k"][l], cache["v"][l])
-              for l in range(cfg.n_layers)]
+    def attend_update(q, k_new, v_new, state):
+        k_pool, v_pool, ks_pool, vs_pool = state
+        if int8:
+            k_new, k_s = _quantize_rows(k_new)
+            v_new, v_s = _quantize_rows(v_new)
+            ks_pool = _scale_write(ks_pool, page_ids, page, offset, k_s,
+                                   pages, ident)
+            vs_pool = _scale_write(vs_pool, page_ids, page, offset, v_s,
+                                   pages, ident)
+        k_pool = _pool_write(k_pool, page_ids, page, offset, k_new,
+                             pages, ident)
+        v_pool = _pool_write(v_pool, page_ids, page, offset, v_new,
+                             pages, ident)
+        o = flash_decode_paged(q, k_pool, v_pool, table, pos,
+                               k_scale_pool=ks_pool, v_scale_pool=vs_pool,
+                               scale=scale)
+        return o, (k_pool, v_pool, ks_pool, vs_pool)
+
+    states = [
+        (cache["k"][l], cache["v"][l],
+         cache["k_scale"][l] if int8 else None,
+         cache["v_scale"][l] if int8 else None)
+        for l in range(cfg.n_layers)
+    ]
     logits, new_states = _token_step(params, pos, tokens, cfg,
                                      states, attend_update)
-    return logits, {
+    out = {
         "k": tuple(s[0] for s in new_states),
         "v": tuple(s[1] for s in new_states),
         "table": table,
     }
+    if int8:
+        out["k_scale"] = tuple(s[2] for s in new_states)
+        out["v_scale"] = tuple(s[3] for s in new_states)
+    return logits, out
 
 
 @partial(jax.jit, static_argnums=(2, 3, 4, 5, 8, 9))

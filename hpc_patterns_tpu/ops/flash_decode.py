@@ -201,12 +201,13 @@ def flash_decode_attention(
 
 
 def _decode_kernel_paged(pos_ref, table_ref, q_ref, k_ref, v_ref, *rest,
-                         scale: float, hkv_per_row: int = 0):
+                         scale: float, quantized: bool = False,
+                         hkv_per_row: int = 0):
     # same online-softmax body; the table ref is consumed by the index
     # maps only (the logical position math needs just pos and si)
     del table_ref
     _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, scale=scale,
-                   quantized=False, hkv_per_row=hkv_per_row)
+                   quantized=quantized, hkv_per_row=hkv_per_row)
 
 
 def flash_decode_paged(
@@ -216,6 +217,8 @@ def flash_decode_paged(
     table,
     pos,
     *,
+    k_scale_pool=None,
+    v_scale_pool=None,
     scale: float | None = None,
     interpret: bool | None = None,
 ):
@@ -241,6 +244,12 @@ def flash_decode_paged(
     own sequence's fill position, so per-row HBM traffic follows
     per-row length). Returns (B, n_heads, head_dim) f32, numerically
     identical to the linear kernel on the equivalent cache.
+
+    ``k_scale_pool``/``v_scale_pool``: (pool_pages, kv_heads, 1,
+    page_size) f32 per-row dequant scales for int8 pools — the linear
+    kernel's half-the-HBM-bytes lever composed with the block table
+    (the CAPACITY levers stack: int8 halves page bytes, paging frees
+    the allocate-for-longest waste).
     """
     B, H, D = q.shape
     n_pool, Hkv, P, Dp = k_pool.shape
@@ -258,6 +267,7 @@ def flash_decode_paged(
         interpret = jax.default_backend() != "tpu"
     g = H // Hkv
 
+    quantized = k_scale_pool is not None
     qr = q.reshape(B * Hkv, g, D)
     ragged = jnp.ndim(pos) == 1
     if ragged and jnp.shape(pos)[0] != B:
@@ -275,17 +285,30 @@ def flash_decode_paged(
         return table_ref[b * pages + live], r % Hkv, 0, 0
 
     row = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    in_specs = [
+        row((None, g, D), lambda r, si, pos, tab: (r, 0, 0)),
+        row((None, None, P, D), page_idx),
+        row((None, None, P, D), page_idx),
+    ]
+    operands = [pos_arr, table_flat, qr, k_pool, v_pool]
+    if quantized:
+        # scales ride lane-major (1, page) rows, page-indirected like
+        # the value blocks (see the linear kernel's layout note)
+        def scale_idx(r, si, pos_ref, table_ref):
+            p, h, _, _ = page_idx(r, si, pos_ref, table_ref)
+            return p, h, 0, 0
+
+        in_specs += [row((None, None, 1, P), scale_idx),
+                     row((None, None, 1, P), scale_idx)]
+        operands += [k_scale_pool, v_scale_pool]
     out = pl.pallas_call(
         functools.partial(_decode_kernel_paged, scale=float(scale),
+                          quantized=quantized,
                           hkv_per_row=Hkv if ragged else 0),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(B * Hkv, pages),
-            in_specs=[
-                row((None, g, D), lambda r, si, pos, tab: (r, 0, 0)),
-                row((None, None, P, D), page_idx),
-                row((None, None, P, D), page_idx),
-            ],
+            in_specs=in_specs,
             out_specs=row((None, g, D), lambda r, si, pos, tab: (r, 0, 0)),
             scratch_shapes=[
                 pltpu.VMEM((g, 1), jnp.float32),
@@ -295,5 +318,5 @@ def flash_decode_paged(
         ),
         out_shape=jax.ShapeDtypeStruct((B * Hkv, g, D), jnp.float32),
         interpret=interpret,
-    )(pos_arr, table_flat, qr, k_pool, v_pool)
+    )(*operands)
     return out.reshape(B, H, D)

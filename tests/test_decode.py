@@ -558,20 +558,30 @@ class TestPagedCache:
         with pytest.raises(ValueError, match="pages"):
             paged_generate(params, prompt, cfg, 8, page_size=8,
                            pages_per_seq=1)
-        qcfg = TransformerConfig(**{**BASE, "kv_cache_dtype": "int8"})
-        with pytest.raises(ValueError, match="compute"):
-            init_paged_cache(qcfg, 2, 2, 8)
+        with pytest.raises(ValueError, match="entries"):
+            from hpc_patterns_tpu.ops.flash_decode import (
+                flash_decode_paged,
+            )
 
-    def test_identity_write_path_matches_scatter(self):
+            flash_decode_paged(
+                jnp.zeros((2, 4, 8)), jnp.zeros((4, 4, 8, 8)),
+                jnp.zeros((4, 4, 8, 8)),
+                jnp.zeros((2, 2), jnp.int32),
+                jnp.zeros((3,), jnp.int32),  # ragged pos != batch
+            )
+
+    @pytest.mark.parametrize("over", [{}, {"kv_cache_dtype": "int8"}])
+    def test_identity_write_path_matches_scatter(self, over):
         # the in-place DUS fast path (identity table) must produce the
-        # same logits/cache as the general scatter write
+        # same logits/cache as the general scatter write — for bf16 AND
+        # int8 pools (the scale-pool writes have both branches too)
         from hpc_patterns_tpu.models.decode import (
             init_paged_cache,
             paged_decode_step,
             paged_prefill,
         )
 
-        cfg, params, prompt = _setup()
+        cfg, params, prompt = _setup(**over)
         cache = init_paged_cache(cfg, 2, pages_per_seq=3, page_size=8)
         _, cache = paged_prefill(params, prompt, cfg, cache, 8)
         tok = jnp.array([1, 2], jnp.int32)
@@ -584,6 +594,19 @@ class TestPagedCache:
         for a, b in zip(jax.tree.leaves(c_scatter),
                         jax.tree.leaves(c_dus)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_int8_pages_match_int8_linear(self):
+        # int8 pools + scale pools: the paged path must reproduce the
+        # int8 LINEAR flash path exactly (same per-row quantization,
+        # same lane-folded dequant math, page indirection on both the
+        # values and the scales)
+        from hpc_patterns_tpu.models.decode import generate, paged_generate
+
+        cfg, params, prompt = _setup(kv_cache_dtype="int8")
+        want = np.asarray(generate(params, prompt, cfg, 8))
+        got = np.asarray(paged_generate(params, prompt, cfg, 8,
+                                        page_size=8))
+        np.testing.assert_array_equal(got, want)
 
     def test_undersized_pool_default_table_rejected(self):
         # a default table over an undersized pool would alias pages
@@ -665,6 +688,8 @@ class TestRaggedPaged:
         {"pos_embed": "rope", "n_kv_heads": 2},  # flagship serving:
         # per-row rope rotation + the GQA grid-row mapping
         # (r // hkv_per_row) both ride the ragged path
+        {"kv_cache_dtype": "int8"},  # quantized + ragged: per-row
+        # positions through the scale-indirected kernel path
     ])
     def test_ragged_positions_per_row_oracle(self, over):
         # RAGGED serving: two sequences at different live lengths decode
@@ -698,16 +723,20 @@ class TestRaggedPaged:
         # shared pool: each row's prefix pages placed at the identity
         # rows (b * pages + j)
         cache = init_paged_cache(cfg, 2, pages, P)
-        k_pool = list(cache["k"])
-        v_pool = list(cache["v"])
+        pools = {n: list(cache[n]) for n in cache if n != "table"}
         for l in range(cfg.n_layers):
             for b in range(2):
-                for key_name, pool in (("k", k_pool), ("v", v_pool)):
-                    chunks = lins[b][key_name][l].reshape(
-                        Hkv, pages, P, Dh).transpose(1, 0, 2, 3)
+                for name, pool in pools.items():
+                    lin_l = lins[b][name][l]
+                    if lin_l.ndim == 4:  # values (1, Hkv, S, D)
+                        chunks = lin_l.reshape(
+                            Hkv, pages, P, Dh).transpose(1, 0, 2, 3)
+                    else:  # int8 scales (1, Hkv, S) -> (pages, Hkv, 1, P)
+                        chunks = lin_l.reshape(
+                            Hkv, pages, P).transpose(1, 0, 2)[:, :, None, :]
                     pool[l] = pool[l].at[
                         b * pages:(b + 1) * pages].set(chunks)
-        cache = {"k": tuple(k_pool), "v": tuple(v_pool),
+        cache = {**{n: tuple(p) for n, p in pools.items()},
                  "table": cache["table"]}
 
         pos = jnp.asarray(lens, jnp.int32)
