@@ -22,11 +22,25 @@ vs_baseline >= 1.0 means the overlap beats the reference's own PASS bar.
 """
 
 import json
+import os
+import select
+import signal
+import subprocess
 import sys
+import time
 
-import jax
+# jax + the pipeline module are imported inside the measurement child
+# under a watchdog: the axon TPU plugin registers at jax-import time,
+# and a dead tunnel HANGS that import in C code (observed, not
+# hypothetical) — an import at module top would hang before any guard
+# can run, and a Python-level SIGALRM handler never fires while the
+# interpreter is blocked inside the plugin's C connect loop. So the
+# DEFAULT entry is a supervisor that runs the measurement in a child
+# process and enforces the timeouts from outside.
+jax = None
+pipeline = None
 
-from hpc_patterns_tpu.concurrency import pipeline
+_UP_SENTINEL = "HPCPAT_BENCH_UP"
 
 # 16 x (2048, 128) f32 = 16 MiB working set. Fewer, larger chunks than
 # the DMA-granularity minimum: the ~0.3 us/chunk loop+semaphore cost is
@@ -60,49 +74,213 @@ def per_pass_seconds(x, mode, tripcount, cal_passes=CAL_PASSES):
                                      cal_passes=cal_passes)
 
 
+def _emit_unavailable(err: BaseException) -> int:
+    """Degenerate capture for a backend that won't even initialize.
+
+    The reference's binaries emit a machine-readable verdict in every
+    failure mode (concurency/sycl_con.cpp:279-296); BENCH_r04 died rc=1
+    with a traceback because the round-4 chip session degraded until
+    `jax.default_backend()` itself raised. This path makes that failure
+    a self-describing artifact: value 0.0, never a pass, backend
+    "unavailable", the error preserved in detail.
+    """
+    print(
+        json.dumps(
+            {
+                "metric": "onchip_overlap_speedup",
+                "value": 0.0,
+                "unit": "x",
+                "vs_baseline": 0.0,
+                "detail": {
+                    "degenerate": True,
+                    "backend": "unavailable",
+                    "error": f"{type(err).__name__}: {err}",
+                },
+            }
+        )
+    )
+    return 0
+
+
+def _supervise() -> int:
+    """Run the measurement in a child process, enforcing timeouts from
+    outside — the only guard that works when jax-import/backend-attach
+    blocks inside the plugin's C code. ``HPCPAT_BENCH_INIT_TIMEOUT``
+    (default 600 s) bounds import+attach; ``HPCPAT_BENCH_TOTAL_TIMEOUT``
+    (default 3600 s) bounds the whole capture — round 4's session died
+    MID-measurement, so both phases need a deadline. 0 disables either.
+    """
+    init_t = int(os.environ.get("HPCPAT_BENCH_INIT_TIMEOUT", "600"))
+    total_t = int(os.environ.get("HPCPAT_BENCH_TOTAL_TIMEOUT", "3600"))
+    env = dict(os.environ, HPCPAT_BENCH_CHILD="1")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        stdout=subprocess.PIPE, env=env,
+    )
+    # Raw-fd reads with our own line buffer: select() on the fd plus a
+    # buffered readline() can block while a complete line already sits
+    # in the text-layer buffer.
+    fd = proc.stdout.fileno()
+    start = time.monotonic()
+    got_up = False
+    json_line = None
+    buf = b""
+    timed_out = None
+
+    def _consume(chunk):
+        nonlocal buf, got_up, json_line
+        buf += chunk
+        while b"\n" in buf:
+            line, buf = buf.split(b"\n", 1)
+            line = line.strip().decode("utf-8", "replace")
+            if line == _UP_SENTINEL:
+                got_up = True
+            elif line:
+                try:  # only a parseable verdict counts as the capture
+                    json.loads(line)
+                except ValueError:
+                    continue
+                json_line = line
+
+    try:
+        while True:
+            deadlines = []
+            if total_t > 0:
+                deadlines.append(start + total_t)
+            if not got_up and init_t > 0:
+                deadlines.append(start + init_t)
+            timeout = (max(0.0, min(deadlines) - time.monotonic())
+                       if deadlines else None)
+            r, _, _ = select.select([fd], [], [], timeout)
+            if not r:
+                phase = ("jax import / backend init" if not got_up
+                         else "measurement")
+                limit = init_t if not got_up else total_t
+                timed_out = TimeoutError(
+                    f"{phase} exceeded {limit}s (chip session "
+                    "unresponsive)")
+                break
+            chunk = os.read(fd, 65536)
+            if not chunk:
+                break  # child EOF
+            _consume(chunk)
+            if json_line is not None:
+                # verdict in hand — don't wait out a teardown hang
+                break
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    proc.wait()
+    # drain anything the child managed to write before dying/being
+    # killed — a capture that finished just before a teardown hang must
+    # win over the timeout verdict. Non-blocking: a plugin helper
+    # process inheriting the pipe's write end could otherwise hold this
+    # read open forever.
+    try:
+        os.set_blocking(fd, False)
+        while True:
+            chunk = os.read(fd, 65536)
+            if not chunk:
+                break
+            _consume(chunk)
+    except (BlockingIOError, OSError, ValueError):
+        pass
+    if json_line is not None:
+        print(json_line)
+        return 0
+    if timed_out is not None:
+        return _emit_unavailable(timed_out)
+    return _emit_unavailable(
+        RuntimeError(f"measurement child exited rc={proc.returncode} "
+                     "with no capture"))
+
+
 def main() -> int:
-    on_tpu = jax.default_backend() == "tpu"
+    # Supervised by default; HPCPAT_BENCH_CHILD marks the measurement
+    # child, HPCPAT_BENCH_SUPERVISE=0 opts out (e.g. under a debugger).
+    if (os.environ.get("HPCPAT_BENCH_CHILD") != "1"
+            and os.environ.get("HPCPAT_BENCH_SUPERVISE", "1") != "0"):
+        return _supervise()
+
+    # Belt-and-braces in-process watchdog for raise-style failures and
+    # pure-Python hangs (covers the unsupervised mode too).
+    global jax, pipeline
+    init_timeout = int(os.environ.get("HPCPAT_BENCH_INIT_TIMEOUT", "600"))
+
+    def _alarm(signum, frame):
+        raise TimeoutError(
+            f"jax import / backend init exceeded {init_timeout}s "
+            "(tunnel unresponsive)"
+        )
+
+    try:
+        if init_timeout > 0 and hasattr(signal, "SIGALRM"):
+            signal.signal(signal.SIGALRM, _alarm)
+            signal.alarm(init_timeout)
+        import jax
+        from hpc_patterns_tpu.concurrency import pipeline
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception as err:  # init failure or hang — emit, don't crash
+        return _emit_unavailable(err)
+    finally:
+        if init_timeout > 0 and hasattr(signal, "SIGALRM"):
+            signal.alarm(0)
+    # tell the supervisor the init phase is over — only when one is
+    # listening (unsupervised stdout must stay a single JSON line)
+    if os.environ.get("HPCPAT_BENCH_CHILD") == "1":
+        print(_UP_SENTINEL, flush=True)
     # CPU fallback (no real DMA engine): tiny shapes through the
     # interpreter so the protocol still runs end-to-end.
     num_chunks, chunk_rows = (NUM_CHUNKS, CHUNK_ROWS) if on_tpu else (4, 8)
     cal = CAL_PASSES if on_tpu else 2
 
-    x = jax.block_until_ready(pipeline.make_hbm_array(num_chunks, chunk_rows))
-
-    t_dma = per_pass_seconds(x, "dma", PROBE_TRIPS, cal)
-    t_comp_probe = per_pass_seconds(x, "compute", PROBE_TRIPS, cal)
+    measure_error = None
+    try:
+        x = jax.block_until_ready(
+            pipeline.make_hbm_array(num_chunks, chunk_rows))
+        t_dma = per_pass_seconds(x, "dma", PROBE_TRIPS, cal)
+        t_comp_probe = per_pass_seconds(x, "compute", PROBE_TRIPS, cal)
+    except Exception as err:  # session died mid-measurement
+        measure_error = err
+        t_dma = t_comp_probe = 0.0
+        x = None
     if t_dma <= 0 or t_comp_probe <= 0:
         # probe measured nothing usable — don't autotune into a
         # pathological tripcount; fall through to the degenerate emitter
         trips, t_comp, t_serial, t_overlap = 0, 0.0, 0.0, 0.0
         raw_pairs = []
     else:
-        # balance compute to DMA (the shared C12 balance step)
-        trips = min(max(1, int(PROBE_TRIPS * t_dma / t_comp_probe)),
-                    MAX_TRIPS)
-        trips, t_comp = pipeline.balance_tripcount(
-            lambda m, t: per_pass_seconds(x, m, t, cal), t_dma, "compute",
-            trips, max_trips=MAX_TRIPS,
-        )
+        try:
+            # balance compute to DMA (the shared C12 balance step)
+            trips = min(max(1, int(PROBE_TRIPS * t_dma / t_comp_probe)),
+                        MAX_TRIPS)
+            trips, t_comp = pipeline.balance_tripcount(
+                lambda m, t: per_pass_seconds(x, m, t, cal), t_dma,
+                "compute", trips, max_trips=MAX_TRIPS,
+            )
 
-        # five (serial, overlap) pairs measured back to back, MEDIAN
-        # ratio wins: chip/tunnel conditions drift run to run, so the
-        # two legs of a ratio must be temporally adjacent or the
-        # speedup wobbles by several percent — and the median (unlike a
-        # max-of-ratios) cannot be inflated by a lucky noise draw
-        pairs = [
-            p for p in (
-                (per_pass_seconds(x, "serial", trips, cal),
-                 per_pass_seconds(x, "overlap", trips, cal))
-                for _ in range(5)
-            ) if min(p) > 0
-        ]
-        raw_pairs = list(pairs)
-        if pairs:
-            pairs = sorted(pairs, key=lambda p: p[0] / p[1])
-            t_serial, t_overlap = pairs[len(pairs) // 2]
-        else:
-            t_serial = t_overlap = 0.0
+            # five (serial, overlap) pairs measured back to back, MEDIAN
+            # ratio wins: chip/tunnel conditions drift run to run, so the
+            # two legs of a ratio must be temporally adjacent or the
+            # speedup wobbles by several percent — and the median (unlike
+            # a max-of-ratios) cannot be inflated by a lucky noise draw
+            pairs = [
+                p for p in (
+                    (per_pass_seconds(x, "serial", trips, cal),
+                     per_pass_seconds(x, "overlap", trips, cal))
+                    for _ in range(5)
+                ) if min(p) > 0
+            ]
+            raw_pairs = list(pairs)
+            if pairs:
+                pairs = sorted(pairs, key=lambda p: p[0] / p[1])
+                t_serial, t_overlap = pairs[len(pairs) // 2]
+            else:
+                t_serial = t_overlap = 0.0
+        except Exception as err:  # session died mid-measurement
+            measure_error = err
+            trips, t_comp, t_serial, t_overlap = 0, 0.0, 0.0, 0.0
+            raw_pairs = []
 
     # any clamped-to-zero component means the run measured nothing usable
     degenerate = min(t_overlap, t_serial, t_dma, t_comp) <= 0
@@ -113,7 +291,7 @@ def main() -> int:
         speedup = t_serial / t_overlap
         theoretical = (t_dma + t_comp) / max(t_dma, t_comp, 1e-12)
         vs_baseline = speedup / (theoretical / 1.3) if theoretical > 0 else 0.0
-    nbytes = x.size * 4
+    nbytes = x.size * 4 if x is not None else 0
     print(
         json.dumps(
             {
@@ -130,6 +308,9 @@ def main() -> int:
                     "theoretical_max_speedup": round(theoretical, 4),
                     "tripcount": trips,
                     "degenerate": degenerate,
+                    "error": (f"{type(measure_error).__name__}: "
+                              f"{measure_error}")
+                    if measure_error is not None else None,
                     "backend": jax.default_backend(),
                     # the five raw (serial, overlap) pairs, measurement
                     # order — the distribution behind the median

@@ -39,10 +39,12 @@ Megatron-sharded params, GSPMD partitions these einsums the same way
 from __future__ import annotations
 
 import warnings
+import weakref
 from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -718,6 +720,14 @@ def paged_prefill(params, prompt, cfg: TransformerConfig, cache,
     return logits, out
 
 
+# tables already verified as identity layout, keyed by id() (jax arrays
+# compare elementwise, so set membership is unusable); WeakValue so a
+# collected table's id can never alias a new object
+_identity_verified: "weakref.WeakValueDictionary[int, object]" = (
+    weakref.WeakValueDictionary()
+)
+
+
 def _pool_write(pool, page_ids, page, offset, rows, pages: int,
                 identity: bool):
     """Write one (B, Hkv, D) K/V row into its page slot. The general
@@ -783,6 +793,40 @@ def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
     table = cache["table"]
     scale = 1.0 / (cfg.head_dim ** 0.5)
     ragged = jnp.ndim(pos) == 1
+
+    # identity_layout is a static promise the tracer cannot check — but
+    # when the caller hands a CONCRETE table (direct API use outside
+    # jit), verify it eagerly before trusting the DUS fast path: a
+    # permuted table plus an exact-size pool would write to the wrong
+    # pool rows and silently corrupt other sequences' K/V. (The internal
+    # _paged_generate_jit caller builds the identity table itself.)
+    # Ragged steps always scatter (ident below), so the promise is
+    # inert there; the check memoizes per table OBJECT so an eager
+    # serving loop reusing one table pays the host compare once, not
+    # per token.
+    if (identity_layout and not ragged
+            and not isinstance(table, jax.core.Tracer)
+            and cache["k"][0].shape[0] == table.shape[0] * table.shape[1]
+            and _identity_verified.get(id(table)) is not table):
+        expect = np.arange(table.size, dtype=np.int32).reshape(table.shape)
+        if not np.array_equal(np.asarray(table), expect):
+            raise ValueError(
+                "identity_layout=True but cache['table'] is not the "
+                "identity layout over an exact-size pool — the in-place "
+                "DUS write would corrupt other sequences' K/V; drop the "
+                "flag (scatter path) or use the default table"
+            )
+        _identity_verified[id(table)] = table
+    # pos is usually traced (the caller owns the capacity check, see
+    # the contract below) — but an eager/concrete pos CAN be checked,
+    # and ragged direct callers are exactly who hits this
+    if not isinstance(pos, jax.core.Tracer):
+        if np.any(np.asarray(pos) >= table.shape[1] * P):
+            raise ValueError(
+                f"position(s) {np.asarray(pos).max()} past cache "
+                f"capacity {table.shape[1] * P} tokens: past-capacity "
+                "writes clamp to the last page and corrupt its history"
+            )
 
     from hpc_patterns_tpu.ops.flash_decode import flash_decode_paged
 

@@ -267,9 +267,13 @@ def speculative_generate_batched(params, cfg: TransformerConfig,
     key, greedy, top_k, temperature = _sampling_args(
         cfg, temperature, top_k, key
     )
-    keys = (jax.random.split(key, prompts.shape[0])
-            if key is not None
-            else jnp.zeros((prompts.shape[0], 2), jnp.uint32))
+    # greedy mode still threads per-row keys through vmap (unused by the
+    # accept path); split a fixed root so the dummies share the REAL
+    # keys' dtype/format — raw uint32 zeros relied on the deprecated
+    # legacy-key acceptance and break under typed keys
+    keys = jax.random.split(
+        key if key is not None else jax.random.PRNGKey(0),
+        prompts.shape[0])
 
     def one(row, k):
         return _speculative_jit(params, cfg, draft_params, draft_cfg,

@@ -1,0 +1,33 @@
+"""Guard the slow-tier selection logic against pytest private-API drift.
+
+conftest._markexpr_selects_slow leans on pytest's private
+``_pytest.mark.expression.Expression``; if a pytest upgrade changes that
+API, the function silently falls back to a substring check that gives
+DIFFERENT answers for several expressions CI actually uses. The cases
+below include discriminators ("not slow" → False, "slowish" → False)
+where the fallback would answer True — so an API drift fails here
+loudly instead of silently flipping which tier runs.
+"""
+
+from conftest import _markexpr_selects_slow
+
+
+def test_expressions_that_select_slow():
+    assert _markexpr_selects_slow("slow")
+    assert _markexpr_selects_slow("slow and tpu")
+    assert _markexpr_selects_slow("slow or tpu")
+    assert _markexpr_selects_slow("(slow)")
+
+
+def test_expressions_that_do_not_select_slow():
+    # discriminators: the substring fallback would return True for
+    # every one of these — if any fails, the private API drifted
+    assert not _markexpr_selects_slow("not slow")
+    assert not _markexpr_selects_slow("not (slow)")
+    assert not _markexpr_selects_slow("not  slow")
+    assert not _markexpr_selects_slow("slowish")
+
+
+def test_empty_and_unrelated():
+    assert not _markexpr_selects_slow("")
+    assert not _markexpr_selects_slow("tpu")

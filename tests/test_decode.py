@@ -658,6 +658,42 @@ class TestPagedCache:
         np.testing.assert_allclose(np.asarray(l_big), np.asarray(l_exact),
                                    atol=1e-6)
 
+    def test_identity_promise_verified_for_concrete_table(self):
+        # identity_layout=True with a PERMUTED concrete table over an
+        # exact-size pool must raise — taking the DUS path there would
+        # write to the wrong pool rows and corrupt other sequences' K/V
+        from hpc_patterns_tpu.models.decode import (
+            init_paged_cache,
+            paged_decode_step,
+        )
+
+        cfg, params, _ = _setup()
+        perm = jnp.array([[1, 0], [3, 2]], jnp.int32)
+        cache = init_paged_cache(cfg, 2, pages_per_seq=2, page_size=8,
+                                 table=perm)
+        tok = jnp.array([1, 2], jnp.int32)
+        with pytest.raises(ValueError, match="identity"):
+            paged_decode_step(params, cache, jnp.int32(0), tok, cfg,
+                              identity_layout=True)
+
+    def test_past_capacity_concrete_pos_rejected(self):
+        # direct (eager) callers with a concrete position past
+        # pages_per_seq*page_size get the capacity guard paged_generate
+        # provides — scalar and ragged forms both
+        from hpc_patterns_tpu.models.decode import (
+            init_paged_cache,
+            paged_decode_step,
+        )
+
+        cfg, params, _ = _setup()
+        cache = init_paged_cache(cfg, 2, pages_per_seq=2, page_size=8)
+        tok = jnp.array([1, 2], jnp.int32)
+        with pytest.raises(ValueError, match="capacity"):
+            paged_decode_step(params, cache, jnp.int32(16), tok, cfg)
+        with pytest.raises(ValueError, match="capacity"):
+            paged_decode_step(params, cache,
+                              jnp.array([3, 16], jnp.int32), tok, cfg)
+
 
 class TestSpeculativeSharded:
     def test_tp_speculative_greedy_token_exact(self, mesh_dp_sp_tp):
