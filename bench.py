@@ -97,7 +97,8 @@ def _emit_unavailable(err: BaseException) -> int:
                     "error": f"{type(err).__name__}: {err}",
                 },
             }
-        )
+        ),
+        flush=True,  # must reach the pipe before any teardown hang
     )
     return 0
 
@@ -330,7 +331,10 @@ def main() -> int:
                     },
                 },
             }
-        )
+        ),
+        # the supervisor's drain only sees what reached the pipe: an
+        # unflushed verdict dies with the child on a teardown hang
+        flush=True,
     )
     return 0
 

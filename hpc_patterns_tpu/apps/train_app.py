@@ -321,18 +321,26 @@ def _train_loop(args, log, cfg, mesh, params, opt_state, step_fn, *,
 def _run_pp(args, log, cfg) -> int:
     """--pp path: 1F1B pipeline training (models/pp.py), optionally
     data-parallel (--dp, incl. --dcn-dp across slices), ZeRO-3 stage
-    params (--fsdp), host-offloaded optimizer state (--offload-opt),
-    and/or MoE (aux loss threaded through the schedule); stage-local
-    math only (no sp/tp/ep axes inside stages)."""
+    params (--fsdp), Megatron tp inside stages (--tp; dense MLP only),
+    host-offloaded optimizer state (--offload-opt), and/or MoE (aux
+    loss threaded through the schedule; no sp/ep axes inside stages)."""
     from hpc_patterns_tpu.models import pp as pplib
 
-    if args.sp > 1 or args.tp > 1 or args.ep > 1:
-        log.print("ERROR: --pp composes with --dp/--fsdp/--dcn-dp/"
-                  "--offload-opt and --n-experts only (stage-local "
-                  "math; no sp/tp/ep axes inside pipeline stages — MoE "
-                  "experts route densely per stage)")
+    if args.sp > 1 or args.ep > 1:
+        log.print("ERROR: --pp composes with --dp/--tp/--fsdp/--dcn-dp/"
+                  "--offload-opt and --n-experts only (no sp/ep axes "
+                  "inside pipeline stages — MoE experts route densely "
+                  "per stage)")
         log.print("FAILURE")
         return 1
+    tp = args.tp if args.tp > 1 else 1
+    if tp > 1:
+        try:
+            pplib.check_tp(cfg, tp)
+        except ValueError as e:
+            log.print(f"ERROR: --pp --tp: {e}")
+            log.print("FAILURE")
+            return 1
     if args.attention not in ("full", "flash"):
         log.print("ERROR: --pp needs a stage-local attention "
                   "(--attention full or flash)")
@@ -366,8 +374,10 @@ def _run_pp(args, log, cfg) -> int:
             log.print("FAILURE")
             return 1
         ici = ({"fsdp": fs} if fs > 1 else {}) | {"pp": args.pp}
+        if tp > 1:
+            ici["tp"] = tp  # innermost: tp rides nearest ICI neighbors
         picked = [d for s in sorted(groups)
-                  for d in groups[s][:fs * args.pp]]
+                  for d in groups[s][:fs * args.pp * tp]]
         try:
             mesh = topology.make_hybrid_mesh({"dp": dp}, ici, picked)
         except topology.TopologyError as e:
@@ -382,7 +392,10 @@ def _run_pp(args, log, cfg) -> int:
         if fs > 1:
             axes["fsdp"] = fs
         axes["pp"] = args.pp
-        mesh = topology.make_mesh(axes, devices[:max(dp, 1) * fs * args.pp])
+        if tp > 1:
+            axes["tp"] = tp  # innermost: tp rides nearest ICI neighbors
+        mesh = topology.make_mesh(
+            axes, devices[:max(dp, 1) * fs * args.pp * tp])
     if args.batch % (args.microbatches * max(dp, 1) * fs):
         log.print(f"ERROR: --batch {args.batch} must divide by "
                   f"--microbatches*--dp*--fsdp = "
@@ -413,9 +426,12 @@ def _run_pp(args, log, cfg) -> int:
     step_fn = pplib.make_pp_train_step(
         cfg, mesh, microbatches=args.microbatches,
         axis_dp="dp" if dp > 1 else None, axis_fsdp=axis_fsdp,
+        axis_tp="tp" if tp > 1 else None,
         optimizer=optimizer, offload_opt_example=offload_example,
     )
     label = f"pp={args.pp} 1f1b"
+    if tp > 1:
+        label += f" tp={tp}"
     if fs > 1:
         label += f" fsdp={fs}"
     if args.dcn_dp:

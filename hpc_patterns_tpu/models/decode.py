@@ -785,10 +785,11 @@ def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
     always scatter; see :func:`_pool_write`).
 
     CONTRACT: every position < pages_per_seq * page_size — the caller
-    owns the capacity check (:func:`paged_generate` guards it). ``pos``
-    is traced so this function cannot raise on it; past-capacity steps
-    clamp to the LAST page (``jnp.take``'s mode) and silently corrupt
-    its history."""
+    owns the capacity check (:func:`paged_generate` guards it). A
+    CONCRETE ``pos`` (eager call) is checked here and raises past
+    capacity; a traced ``pos`` (inside jit) cannot be — past-capacity
+    steps then clamp to the LAST page (``jnp.take``'s mode) and
+    silently corrupt its history."""
     P = cache["k"][0].shape[2]
     table = cache["table"]
     scale = 1.0 / (cfg.head_dim ** 0.5)

@@ -39,6 +39,16 @@ shards over (dp, fsdp) together, like the non-pp fsdp path. The
 embedding/head stay replicated (they are not stage params; shard them
 over fsdp via the vocab dim if they ever dominate).
 
+Composes with Megatron tensor parallelism over an ``tp`` mesh axis
+INSIDE each stage (the canonical large-model layout: tp innermost over
+ICI neighbors, pp across): stage weights column/row-split per
+models/sharding.py's rule table, rank-local attention on local
+q/kv-head shards, the f/g conjugate pair at region boundaries (explicit
+custom_vjps — see the Megatron block below), two psums per layer.
+Dense MLP stages only (MoE + tp rejected); the packed qkv weight is
+column-permuted on the way in so contiguous tp splits align with the
+q/k/v sections (public layout unchanged).
+
 Composes with MoE: stages return their load-balance aux loss alongside
 the activation and the 1F1B schedule threads it through
 (``stage_aux_weight``) — the aux gradient rides the normal backward,
@@ -60,8 +70,10 @@ import optax
 
 from hpc_patterns_tpu.models.transformer import (
     TransformerConfig,
+    _attention,
     _layer,
     _rmsnorm,
+    apply_rope,
     chunked_masked_causal_nll,
     init_params,
     masked_causal_nll,
@@ -98,6 +110,160 @@ def _stage_fn(layers_shard, h, cfg):
     return h
 
 
+# ---------------------------------------------------------------------------
+# Megatron TP inside pipeline stages
+# ---------------------------------------------------------------------------
+#
+# Stage math runs rank-local inside the pipeline shard_map, so tensor
+# parallelism here is the MANUAL Megatron form: column-parallel
+# qkv/up-projections, row-parallel out/down-projections, and the f/g
+# conjugate operators at the region boundaries. f and g are explicit
+# custom_vjps (identity-fwd/psum-bwd and psum-fwd/identity-bwd) rather
+# than relying on lax.psum's transpose under check_vma=False — psum
+# transposing to psum would double-count the replicated residual
+# cotangent by a factor of tp (the documented shard_map AD footgun).
+# This is the building-block composition SURVEY.md §2.2 calls for: the
+# row-parallel reduction IS the reference's allreduce
+# (allreduce-mpi-sycl.cpp:61-67) riding inside a pipeline stage.
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _tp_f(x, axis):
+    """Megatron's f: identity forward; backward psums the cotangent
+    over ``axis`` (the input is replicated over tp, and each rank only
+    computes its own column-shard's contribution)."""
+    return x
+
+
+def _tp_f_fwd(x, axis):
+    return x, None
+
+
+def _tp_f_bwd(axis, _, ct):
+    return (lax.psum(ct, axis),)
+
+
+_tp_f.defvjp(_tp_f_fwd, _tp_f_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _tp_g(x, axis):
+    """Megatron's g: psum forward (the row-parallel reduction);
+    backward passes the replicated cotangent straight through to every
+    rank's partial sum."""
+    return lax.psum(x, axis)
+
+
+def _tp_g_fwd(x, axis):
+    return lax.psum(x, axis), None
+
+
+def _tp_g_bwd(axis, _, ct):
+    return (ct,)
+
+
+_tp_g.defvjp(_tp_g_fwd, _tp_g_bwd)
+
+
+def tp_permute_wqkv(wqkv, cfg: TransformerConfig, tp: int):
+    """Reorder the packed-qkv columns ``[q | k | v]`` into per-rank
+    blocks ``[q_0|k_0|v_0 | q_1|k_1|v_1 | ...]`` so a contiguous
+    last-dim split over tp hands each rank its own q/k/v sections (a
+    naive contiguous split of the packed layout would cut across the
+    sections). Pure column gather — applied once per step on the way
+    into the pipeline shard_map; the public param layout stays
+    standard."""
+    D = cfg.d_model
+    S = cfg.kv_heads * cfg.head_dim
+    q, k, v = jnp.split(wqkv, [D, D + S], axis=-1)
+    qs = jnp.split(q, tp, axis=-1)
+    ks = jnp.split(k, tp, axis=-1)
+    vs = jnp.split(v, tp, axis=-1)
+    return jnp.concatenate(
+        [jnp.concatenate([qs[r], ks[r], vs[r]], axis=-1)
+         for r in range(tp)],
+        axis=-1,
+    )
+
+
+def tp_unpermute_wqkv(wqkv_p, cfg: TransformerConfig, tp: int):
+    """Inverse of :func:`tp_permute_wqkv` (applied to the wqkv gradient
+    on the way out, so optimizer/checkpoint/oracle all see the standard
+    packed layout)."""
+    Dl = cfg.d_model // tp
+    Sl = cfg.kv_heads * cfg.head_dim // tp
+    qs, ks, vs = [], [], []
+    for blk in jnp.split(wqkv_p, tp, axis=-1):
+        qb, kb, vb = jnp.split(blk, [Dl, Dl + Sl], axis=-1)
+        qs.append(qb)
+        ks.append(kb)
+        vs.append(vb)
+    return jnp.concatenate(qs + ks + vs, axis=-1)
+
+
+def _tp_layer(x, lp, cfg: TransformerConfig, axis_tp: str, tp: int):
+    """One pre-norm block with Megatron TP over ``axis_tp``: local
+    q/kv heads (column split), rank-local attention (heads are
+    embarrassingly parallel; GQA stays narrow — tp must divide
+    kv_heads), row-parallel wo and w2 closed by g. Activations x are
+    replicated over tp; exactly two psums per layer."""
+    B, T, D = x.shape
+    dt = x.dtype
+    Hl, Hkvl, Dh = cfg.n_heads // tp, cfg.kv_heads // tp, cfg.head_dim
+    Dl = D // tp
+
+    a = _tp_f(x, axis_tp)
+    h = _rmsnorm(a, lp["ln1_scale"])
+    qkv = jnp.dot(h, lp["wqkv"].astype(dt))  # local [q_r|k_r|v_r]
+    q, k, v = jnp.split(qkv, [Dl, Dl + Hkvl * Dh], axis=-1)
+    q = q.reshape(B, T, Hl, Dh)
+    k = k.reshape(B, T, Hkvl, Dh)
+    v = v.reshape(B, T, Hkvl, Dh)
+    if cfg.pos_embed == "rope":
+        pos = lax.broadcasted_iota(jnp.int32, (T,), 0)
+        q = apply_rope(q, pos, cfg)
+        k = apply_rope(k, pos, cfg)
+    o = _attention(q, k, v, cfg, None).reshape(B, T, Dl)
+    x = x + _tp_g(jnp.dot(o, lp["wo"].astype(dt)), axis_tp)
+
+    b = _tp_f(x, axis_tp)
+    h2 = _rmsnorm(b, lp["ln2_scale"])
+    if cfg.mlp_impl == "fused":
+        from hpc_patterns_tpu.ops.fused_mlp import fused_mlp
+
+        y = fused_mlp(h2, lp["w1"].astype(dt), lp["w2"].astype(dt))
+    else:
+        y = jnp.dot(jax.nn.gelu(jnp.dot(h2, lp["w1"].astype(dt))),
+                    lp["w2"].astype(dt))
+    return x + _tp_g(y, axis_tp)
+
+
+def _tp_stage_fn(layers_shard, h, cfg, axis_tp, tp):
+    """TP counterpart of :func:`_stage_fn` (dense MLP only — pp x tp
+    with MoE stages is rejected upstream)."""
+    def body(x, lp):
+        return _tp_layer(x, lp, cfg, axis_tp, tp), None
+
+    h, _ = lax.scan(body, h, layers_shard)
+    return h
+
+
+def check_tp(cfg: TransformerConfig, tp: int):
+    if cfg.n_experts:
+        raise ValueError(
+            "pp x tp with MoE stages is unsupported: experts route "
+            "densely per stage (use ep outside pp, or tp without "
+            "experts)"
+        )
+    for name, val in (("d_model", cfg.d_model), ("n_heads", cfg.n_heads),
+                      ("kv_heads", cfg.kv_heads), ("d_ff", cfg.d_ff)):
+        if val % tp:
+            raise ValueError(
+                f"{name} {val} must divide by tp={tp} for Megatron "
+                "stage sharding"
+            )
+
+
 def _loss_head(lp, y, target_tokens, *, loss_chunk: int = 0):
     """Final-norm + LM head + the shared masked causal NLL
     (transformer.masked_causal_nll — identical loss semantics to
@@ -117,24 +283,26 @@ def _loss_head(lp, y, target_tokens, *, loss_chunk: int = 0):
 
 
 def _pp_layer_specs(cfg: TransformerConfig, axis_pp: str,
-                    axis_fsdp: str | None):
+                    axis_fsdp: str | None, axis_tp: str | None = None):
     """Per-leaf PartitionSpecs for the stacked layer params inside the
     pipeline: leading ``n_layers`` axis over pp, and (with
-    ``axis_fsdp``) the same per-weight feature dim models/
-    sharding.param_specs shards under fsdp — one rule table, two
-    parallelism schemes. tp/ep axes are dropped (no such axes inside
-    pipeline stages)."""
+    ``axis_fsdp``/``axis_tp``) the same per-weight feature dims models/
+    sharding.param_specs shards under fsdp and Megatron tp — one rule
+    table, three parallelism schemes. ep axes are dropped (no expert
+    axis inside pipeline stages); tp is dropped unless requested."""
     import dataclasses
 
     from hpc_patterns_tpu.models import sharding as shardlib
 
     base = shardlib.param_specs(
         dataclasses.replace(cfg, fsdp=bool(axis_fsdp),
-                            axis_fsdp=axis_fsdp or "fsdp")
+                            axis_fsdp=axis_fsdp or "fsdp",
+                            axis_tp=axis_tp or "tp")
     )["layers"]
+    keep = {ax for ax in (axis_fsdp, axis_tp) if ax}
 
     def fix(spec):
-        rest = [ax if ax == axis_fsdp else None for ax in spec[1:]]
+        rest = [ax if ax in keep else None for ax in spec[1:]]
         return P(axis_pp, *rest)
 
     return jax.tree.map(fix, base, is_leaf=lambda x: isinstance(x, P))
@@ -152,10 +320,12 @@ def _fsdp_dim(spec, axis_fsdp):
 def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
                       *, microbatches: int, axis_pp: str = "pp",
                       axis_dp: str | None = None,
-                      axis_fsdp: str | None = None):
+                      axis_fsdp: str | None = None,
+                      axis_tp: str | None = None):
     """Mean causal-LM loss and full-parameter gradients via a 1F1B
-    pipeline over ``axis_pp`` (optionally data-parallel over ``axis_dp``
-    and/or ZeRO-3-sharded over ``axis_fsdp`` — see module docstring).
+    pipeline over ``axis_pp`` (optionally data-parallel over ``axis_dp``,
+    ZeRO-3-sharded over ``axis_fsdp``, and/or Megatron tensor-parallel
+    INSIDE each stage over ``axis_tp`` — see module docstring).
 
     ``params``: the standard init_params pytree (layers stacked on
     n_layers, which must divide by the pp axis size); with
@@ -165,6 +335,13 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
     Loss, embedding, and head gradients are replicated on return;
     layer gradients return fsdp-sharded when ``axis_fsdp`` is set
     (matching the param storage, what the optimizer update consumes).
+
+    ``axis_tp``: the canonical large-model layout — tp innermost (ICI
+    neighbors), stage weights column/row-split per models/sharding.py's
+    rule table, activations replicated over tp, two psums per layer
+    (see the Megatron block above). The loss head runs replicated per
+    tp rank (vocab stays unsharded inside the pipeline); tokens are
+    shared across tp. MoE stages reject tp.
     """
     M = microbatches
     pp = mesh.shape[axis_pp]
@@ -174,11 +351,16 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
     B = tokens.shape[0]
     dp = mesh.shape[axis_dp] if axis_dp else 1
     fs = mesh.shape[axis_fsdp] if axis_fsdp else 1
+    tp = mesh.shape[axis_tp] if axis_tp else 1
+    if tp == 1:
+        axis_tp = None  # size-1 tp axis: plain stage math
+    else:
+        check_tp(cfg, tp)
     if B % (M * dp * fs):
         raise ValueError(
             f"batch {B} must divide by microbatches*dp*fsdp={M * dp * fs}"
         )
-    layer_specs = _pp_layer_specs(cfg, axis_pp, axis_fsdp)
+    layer_specs = _pp_layer_specs(cfg, axis_pp, axis_fsdp, axis_tp)
     if axis_fsdp:
         for name, spec in layer_specs.items():
             d = _fsdp_dim(spec, axis_fsdp)
@@ -215,8 +397,10 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
         else:
             layers_full = layers_shard
 
+        stage = (partial(_tp_stage_fn, cfg=cfg, axis_tp=axis_tp, tp=tp)
+                 if axis_tp else partial(_stage_fn, cfg=cfg))
         loss, layer_grads, extras = pipeline_train_1f1b(
-            partial(_stage_fn, cfg=cfg),
+            stage,
             layers_full,
             x_mb,
             toks,
@@ -252,6 +436,15 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
             ),
             outer_grads,
         )
+        if axis_tp:
+            # tp-replicated stage leaves (the norm scales): each rank
+            # only computed its own column-shard's contribution through
+            # the f region, so the true grad is the sum over tp
+            layer_grads = {
+                k: (lax.psum(g, axis_tp)
+                    if axis_tp not in layer_specs[k] else g)
+                for k, g in layer_grads.items()
+            }
         if axis_fsdp:
             # ZeRO-3 reduce-scatter: each rank keeps the grad tile of
             # the shard it stores; /fs makes it the MEAN over the fsdp
@@ -282,6 +475,13 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
         # microbatch mean, so divide by M for the mean-loss gradient
         return loss[None], *jax.tree.map(lambda g: g / M, grads_all)
 
+    layers_in = params["layers"]
+    if axis_tp:
+        # per-rank packed-qkv blocks so the contiguous tp split lands
+        # each rank its own q/k/v sections; grads unpermute below
+        layers_in = dict(layers_in)
+        layers_in["wqkv"] = tp_permute_wqkv(layers_in["wqkv"], cfg, tp)
+
     batch_axes = tuple(a for a in (axis_dp, axis_fsdp) if a)
     tok_spec = P(batch_axes) if batch_axes else P()
     loss_spec = (P((*batch_axes, axis_pp)) if batch_axes else P(axis_pp))
@@ -291,7 +491,10 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
         in_specs=(P(), layer_specs, P(), tok_spec),
         out_specs=(loss_spec, P(), layer_specs, P()),
         check_vma=False,  # validity masks + psum-broadcasts aren't VMA-provable
-    )(outer, params["layers"], head, tokens)
+    )(outer, layers_in, head, tokens)
+    if axis_tp:
+        layer_g = dict(layer_g)
+        layer_g["wqkv"] = tp_unpermute_wqkv(layer_g["wqkv"], cfg, tp)
 
     loss = loss_r[0]
     grads = {
@@ -307,7 +510,8 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
 
 def make_pp_train_step(cfg: TransformerConfig, mesh, *, microbatches: int,
                        axis_pp: str = "pp", axis_dp: str | None = None,
-                       axis_fsdp: str | None = None, optimizer=None,
+                       axis_fsdp: str | None = None,
+                       axis_tp: str | None = None, optimizer=None,
                        offload_opt_example=None):
     """Jitted ``step(params, opt_state, tokens) -> (loss, params,
     opt_state)`` training the full model through the 1F1B pipeline.
@@ -335,6 +539,7 @@ def make_pp_train_step(cfg: TransformerConfig, mesh, *, microbatches: int,
         loss, grads = pp_loss_and_grads(
             params, tokens, cfg, mesh, microbatches=microbatches,
             axis_pp=axis_pp, axis_dp=axis_dp, axis_fsdp=axis_fsdp,
+            axis_tp=axis_tp,
         )
         grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
         updates, opt_state = optimizer.update(grads, opt_state, params)

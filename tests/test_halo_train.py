@@ -200,13 +200,35 @@ class TestTrainApp:
         out = capsys.readouterr().out
         assert "slice count" in out
 
-    def test_pp_rejects_tp(self, capsys):
+    def test_pp_rejects_sp_and_tp_moe(self, capsys):
+        # --pp composes with --tp since round 5; sp/ep inside stages
+        # and tp with MoE stages still reject
         from hpc_patterns_tpu.apps import train_app
 
-        code = train_app.main(["--pp", "2", "--tp", "2"])
+        code = train_app.main(["--pp", "2", "--sp", "2"])
         out = capsys.readouterr().out
         assert code == 1
-        assert "no sp/tp/ep axes inside pipeline stages" in out
+        assert "no sp/ep axes inside pipeline stages" in out
+        code = train_app.main(["--pp", "2", "--tp", "2", "--n-experts",
+                               "2"])
+        out = capsys.readouterr().out
+        assert code == 1
+        assert "MoE" in out
+
+    def test_pp_tp_trains(self, capsys):
+        # Megatron tp inside pipeline stages through the CLI: loss
+        # falls, SUCCESS verdict, tp in the run label
+        from hpc_patterns_tpu.apps import train_app
+
+        code = train_app.main(
+            ["--backend", "cpu", "--pp", "2", "--tp", "2", "--steps", "3",
+             "--batch", "4", "--seq", "16", "--d-model", "32",
+             "--n-heads", "4", "--n-layers", "4", "--vocab", "64",
+             "--microbatches", "2"]
+        )
+        out = capsys.readouterr().out
+        assert code == 0, out
+        assert "tp=2" in out and "SUCCESS" in out
 
     def test_mesh_run_with_resume(self, capsys, tmp_path):
         from hpc_patterns_tpu.apps import train_app

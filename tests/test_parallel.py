@@ -412,3 +412,40 @@ class TestPipeline1F1B:
                                    float(want_loss), rtol=1e-5)
         got_g = np.asarray(grads).reshape(8, F, F)
         np.testing.assert_allclose(got_g, np.asarray(want_g), atol=1e-4)
+
+
+class TestPPTPPermute:
+    # fast-tier coverage of the pp x tp packed-qkv column permutation
+    # (the slow-tier pp x tp oracles in test_pp_model.py exercise it in
+    # situ): permute -> contiguous tp split must hand each rank its own
+    # [q_r|k_r|v_r] sections, and unpermute must invert exactly
+    def test_roundtrip_and_block_layout(self):
+        from hpc_patterns_tpu.models import TransformerConfig
+        from hpc_patterns_tpu.models.pp import (
+            tp_permute_wqkv,
+            tp_unpermute_wqkv,
+        )
+
+        cfg = TransformerConfig(vocab=32, d_model=8, n_heads=4,
+                                n_kv_heads=2, n_layers=2, d_ff=16,
+                                max_seq=8, dtype="float32")
+        tp = 2
+        L, D = cfg.n_layers, cfg.d_model
+        S = cfg.kv_heads * cfg.head_dim
+        w = jnp.arange(L * D * (D + 2 * S), dtype=jnp.float32).reshape(
+            L, D, D + 2 * S)
+        perm = tp_permute_wqkv(w, cfg, tp)
+        assert perm.shape == w.shape
+        np.testing.assert_array_equal(
+            np.asarray(tp_unpermute_wqkv(perm, cfg, tp)), np.asarray(w))
+        # rank r's contiguous block == [q_r | k_r | v_r]
+        q, k, v = np.split(np.asarray(w), [D, D + S], axis=-1)
+        Dl, Sl = D // tp, S // tp
+        for r, blk in enumerate(np.split(np.asarray(perm), tp, axis=-1)):
+            np.testing.assert_array_equal(
+                blk,
+                np.concatenate(
+                    [q[..., r * Dl:(r + 1) * Dl],
+                     k[..., r * Sl:(r + 1) * Sl],
+                     v[..., r * Sl:(r + 1) * Sl]], axis=-1),
+            )
