@@ -665,13 +665,16 @@ def init_paged_cache(cfg: TransformerConfig, batch: int,
 
 
 def paged_prefill(params, prompt, cfg: TransformerConfig, cache,
-                  page_size: int):
+                  page_size: int, mesh=None):
     """Prompt pass writing into the paged cache: the ordinary prefill
     captures K/V for the prompt (a transient sized to the PROMPT, not
     the serving maximum), then each layer's pages scatter into the pool
-    through the table. Returns (last_logits, cache)."""
+    through the table. Returns (last_logits, cache). ``mesh``:
+    tp-sharded serving — the prefill kernel runs shard_mapped and the
+    page POOLS are constrained kv-head-sharded over tp (the layout
+    :func:`paged_decode_step`'s sharded route consumes in place)."""
     B, T = prompt.shape
-    P = page_size
+    P = page_size  # shadows the PartitionSpec alias in this scope
     t_pad = -(-T // P) * P
     n_used = t_pad // P
     table = cache["table"]
@@ -683,7 +686,7 @@ def paged_prefill(params, prompt, cfg: TransformerConfig, cache,
     # boundary afterwards — asking prefill for t_pad would spuriously
     # trip its max_len <= cfg.max_seq guard for prompts within a page
     # of the model maximum
-    logits, lin = prefill(params, prompt, cfg, T)
+    logits, lin = prefill(params, prompt, cfg, T, mesh=mesh)
     if t_pad > T:
         # pad the sequence axis of every leaf (values are 4-D, int8
         # scales 3-D)
@@ -717,6 +720,20 @@ def paged_prefill(params, prompt, cfg: TransformerConfig, cache,
                 )[:, :, :, None, :]
                 pool[l] = pool[l].at[idx].set(pages)
             out[name] = tuple(pool)
+    if mesh is not None and _tp_size(mesh, cfg) > 1:
+        # pin every pool kv-head-sharded over tp (all pool leaves are
+        # 4-D with kv_heads on dim 1, scale pools included) so the
+        # per-step writes and the sharded kernel stay rank-local
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sh = NamedSharding(
+            mesh, resolve_spec(PartitionSpec(None, cfg.axis_tp, None,
+                                             None), mesh, cfg.mesh_axes))
+        out = {
+            k: (v if k == "table"
+                else tuple(lax.with_sharding_constraint(a, sh) for a in v))
+            for k, v in out.items()
+        }
     return logits, out
 
 
@@ -769,7 +786,7 @@ def _scale_write(pool, page_ids, page, offset, rows, pages: int,
 
 
 def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
-                      identity_layout: bool = False):
+                      identity_layout: bool = False, mesh=None):
     """One token per sequence against the paged cache: the new K/V row
     scatters into page ``table[:, pos // P]`` at offset ``pos % P``,
     and attention streams the live pages through
@@ -777,9 +794,12 @@ def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
     cursor (like decode_step) OR a (B,) vector of per-sequence
     positions — RAGGED serving, every sequence at its own length (the
     kernel masks and clamps per row; rope/learned embeddings gather
-    per row; the cache write scatters per-row offsets). Single-device
-    (a pallas_call under GSPMD needs the shard_map route — compose
-    later if paged tp serving matters). ``identity_layout`` (static):
+    per row; the cache write scatters per-row offsets). ``mesh``:
+    tp-sharded paged serving — the paged kernel runs under a shard_map
+    manual partition over ``cfg.axis_tp`` (whole kv-head blocks per
+    rank, like the linear route; tp must divide kv_heads), pools enter
+    kv-head-sharded (``paged_prefill(..., mesh=...)``'s layout) and
+    the pool writes partition via GSPMD. ``identity_layout`` (static):
     promise that the table is the default identity layout, enabling
     the in-place DUS write for the scalar-cursor case (ragged writes
     always scatter; see :func:`_pool_write`).
@@ -843,6 +863,14 @@ def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
     int8 = cfg.kv_cache_dtype == "int8"
     ident = identity_layout and not ragged
     pages = table.shape[1]
+    tp = _tp_size(mesh, cfg)
+    if tp > 1 and cfg.kv_heads % tp:
+        raise ValueError(
+            f"paged tp serving needs tp {tp} to divide kv_heads "
+            f"{cfg.kv_heads} (whole kv-head blocks per rank; the paged "
+            "kernel has no gather fallback)"
+        )
+    paged_sharded = tp > 1
 
     def attend_update(q, k_new, v_new, state):
         k_pool, v_pool, ks_pool, vs_pool = state
@@ -857,9 +885,41 @@ def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
                              pages, ident)
         v_pool = _pool_write(v_pool, page_ids, page, offset, v_new,
                              pages, ident)
-        o = flash_decode_paged(q, k_pool, v_pool, table, pos,
-                               k_scale_pool=ks_pool, v_scale_pool=vs_pool,
-                               scale=scale)
+        if paged_sharded:
+            # manual partition over tp, mirroring decode_step's linear
+            # route: q heads block-shard with their kv heads, pools
+            # shard on the kv_heads dim, table/pos ride replicated.
+            # (PS, not the module alias P — the page size shadows it
+            # in this scope.)
+            from jax.sharding import PartitionSpec as PS
+
+            tp_ax = cfg.axis_tp
+            rs = lambda s: resolve_spec(s, mesh, cfg.mesh_axes)
+            spec_q = rs(PS(None, tp_ax, None))
+            spec_pool = rs(PS(None, tp_ax, None, None))
+            pos_arr = (pos if ragged
+                       else jnp.asarray(pos, jnp.int32).reshape(1))
+            args = [q, k_pool, v_pool, table, pos_arr]
+            specs = [spec_q, spec_pool, spec_pool, PS(), PS()]
+            if int8:
+                args += [ks_pool, vs_pool]
+                specs += [spec_pool, spec_pool]
+
+            def local_attn(q, kp, vp, tbl, p, ksp=None, vsp=None):
+                return flash_decode_paged(
+                    q, kp, vp, tbl, p if ragged else p[0],
+                    k_scale_pool=ksp, v_scale_pool=vsp, scale=scale,
+                )
+
+            o = jax.shard_map(
+                local_attn, mesh=mesh, in_specs=tuple(specs),
+                out_specs=spec_q,
+                check_vma=False,  # pallas_call can't declare vma
+            )(*args)
+        else:
+            o = flash_decode_paged(q, k_pool, v_pool, table, pos,
+                                   k_scale_pool=ks_pool,
+                                   v_scale_pool=vs_pool, scale=scale)
         return o, (k_pool, v_pool, ks_pool, vs_pool)
 
     states = [
@@ -881,17 +941,20 @@ def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
     return logits, out
 
 
-@partial(jax.jit, static_argnums=(2, 3, 4, 5, 8, 9))
+@partial(jax.jit, static_argnums=(2, 3, 4, 5, 8, 9, 10))
 def _paged_generate_jit(params, prompt, cfg, new_tokens, page_size,
-                        pages_per_seq, key, temperature, greedy, top_k):
+                        pages_per_seq, key, temperature, greedy, top_k,
+                        mesh=None):
     B, T = prompt.shape
     cache = init_paged_cache(cfg, B, pages_per_seq, page_size)
-    logits, cache = paged_prefill(params, prompt, cfg, cache, page_size)
+    logits, cache = paged_prefill(params, prompt, cfg, cache, page_size,
+                                  mesh=mesh)
     # the jit built its own default (identity) table above, so the
     # in-place DUS write path is sound
     return _generation_scan(
         lambda c, p, t: paged_decode_step(params, c, p, t, cfg,
-                                          identity_layout=True),
+                                          identity_layout=True,
+                                          mesh=mesh),
         logits, cache, T, new_tokens, key, temperature, greedy, top_k,
     )
 
@@ -899,14 +962,16 @@ def _paged_generate_jit(params, prompt, cfg, new_tokens, page_size,
 def paged_generate(params, prompt, cfg: TransformerConfig,
                    new_tokens: int, *, page_size: int = 512,
                    pages_per_seq: int | None = None, key=None,
-                   temperature: float = 0.0, top_k: int = 0):
+                   temperature: float = 0.0, top_k: int = 0, mesh=None):
     """Continuation (B, new_tokens) int32 served from the paged cache —
     token-identical to :func:`generate` (the paged kernel reproduces
     the linear kernel's f32 math exactly; oracle-tested). The cache
     footprint is ``pages_per_seq * page_size`` tokens per sequence
     (default: just enough pages for prompt + new_tokens) instead of the
     linear cache's ``max_len`` — THE serving-capacity lever when the
-    declared maximum is far above typical generation length."""
+    declared maximum is far above typical generation length. ``mesh``:
+    tp-sharded paged serving (the two serving levers compose — see
+    :func:`paged_decode_step`)."""
     if new_tokens < 1:
         raise ValueError(f"new_tokens must be >= 1, got {new_tokens}")
     B, T = prompt.shape
@@ -928,5 +993,5 @@ def paged_generate(params, prompt, cfg: TransformerConfig,
     return _paged_generate_jit(
         params, prompt, cfg, new_tokens, page_size, pages_per_seq, key,
         jnp.float32(max(temperature, 1e-6)), temperature <= 0.0,
-        int(top_k),
+        int(top_k), mesh,
     )

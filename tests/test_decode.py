@@ -174,6 +174,80 @@ class TestShardedServing:
         ))
         np.testing.assert_array_equal(got, want)
 
+    def test_tp_paged_generate_token_exact(self, mesh_dp_sp_tp):
+        # the round-4 serving wins compose: PAGED cache x tp shard_map
+        # — pools kv-head-sharded, the paged kernel manual-partitioned,
+        # tokens identical to the unsharded paged decode (= generate)
+        from hpc_patterns_tpu.models.decode import paged_generate
+        from hpc_patterns_tpu.models.sharding import shard_params
+
+        cfg, params, prompt = _setup(n_heads=4, n_kv_heads=2)
+        want = np.asarray(paged_generate(params, prompt, cfg, 6,
+                                         page_size=8))
+        np.testing.assert_array_equal(
+            want, np.asarray(greedy_generate(params, prompt, cfg, 6)))
+        p_sh = shard_params(params, mesh_dp_sp_tp, cfg)
+        got = np.asarray(jax.device_get(paged_generate(
+            p_sh, prompt, cfg, 6, page_size=8, mesh=mesh_dp_sp_tp)))
+        np.testing.assert_array_equal(got, want)
+
+    def test_tp_paged_int8_token_exact(self, mesh_dp_sp_tp):
+        # all three serving levers at once: paged pools + int8 pages +
+        # tp (scale pools shard with their kv heads)
+        from hpc_patterns_tpu.models.decode import paged_generate
+        from hpc_patterns_tpu.models.sharding import shard_params
+
+        cfg, params, prompt = _setup(n_heads=4, n_kv_heads=2,
+                                     kv_cache_dtype="int8")
+        want = np.asarray(paged_generate(params, prompt, cfg, 6,
+                                         page_size=8))
+        p_sh = shard_params(params, mesh_dp_sp_tp, cfg)
+        got = np.asarray(jax.device_get(paged_generate(
+            p_sh, prompt, cfg, 6, page_size=8, mesh=mesh_dp_sp_tp)))
+        np.testing.assert_array_equal(got, want)
+
+    def test_tp_paged_ragged_step_token_exact(self, mesh_dp_sp_tp):
+        # ragged per-sequence positions through the SHARDED paged step:
+        # logits must match the unsharded ragged step exactly
+        from hpc_patterns_tpu.models.decode import (
+            init_paged_cache,
+            paged_decode_step,
+            paged_prefill,
+        )
+        from hpc_patterns_tpu.models.sharding import shard_params
+
+        cfg, params, prompt = _setup(n_heads=4, n_kv_heads=2)
+        cache = init_paged_cache(cfg, 2, pages_per_seq=3, page_size=8)
+        _, cache = paged_prefill(params, prompt, cfg, cache, 8)
+        pos = jnp.array([8, 9], jnp.int32)
+        tok = jnp.array([1, 2], jnp.int32)
+        want, want_cache = paged_decode_step(params, cache, pos, tok, cfg)
+        p_sh = shard_params(params, mesh_dp_sp_tp, cfg)
+        sc = init_paged_cache(cfg, 2, pages_per_seq=3, page_size=8)
+        _, sc = paged_prefill(p_sh, prompt, cfg, sc, 8,
+                              mesh=mesh_dp_sp_tp)
+        got, got_cache = paged_decode_step(p_sh, sc, pos, tok, cfg,
+                                           mesh=mesh_dp_sp_tp)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
+        for a, b in zip(jax.tree.leaves(got_cache),
+                        jax.tree.leaves(want_cache)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
+
+    def test_tp_paged_rejects_indivisible_kv_heads(self, mesh_dp_sp_tp):
+        from hpc_patterns_tpu.models.decode import (
+            init_paged_cache,
+            paged_decode_step,
+        )
+
+        cfg, params, _ = _setup(n_heads=4, n_kv_heads=1)
+        cache = init_paged_cache(cfg, 2, pages_per_seq=2, page_size=8)
+        with pytest.raises(ValueError, match="kv_heads"):
+            paged_decode_step(params, cache, jnp.int32(0),
+                              jnp.array([1, 2], jnp.int32), cfg,
+                              mesh=mesh_dp_sp_tp)
+
     def test_tp_not_dividing_kv_heads_warns_and_falls_back(
             self, mesh_dp_sp_tp):
         # tp=2 cannot split kv_heads=1: the flash request must warn and
