@@ -88,6 +88,35 @@ def _flash_partition(mesh, cfg: TransformerConfig) -> bool:
     return True
 
 
+def _tp_serving_specs(mesh, cfg: TransformerConfig):
+    """The ONE definition of the tp manual-partition layout shared by
+    the linear and paged kernel routes: ``(row3, block4)`` — 3-D leaves
+    (q (B, H, Dh); linear scale rows (B, Hkv, len)) shard dim 1, 4-D
+    leaves (linear cache, page pools, scale pools) shard dim 1. Head
+    blocks are contiguous, so q head k·g+j stays with kv head k."""
+    from jax.sharding import PartitionSpec as PS
+
+    tp = cfg.axis_tp
+    return (resolve_spec(PS(None, tp, None), mesh, cfg.mesh_axes),
+            resolve_spec(PS(None, tp, None, None), mesh, cfg.mesh_axes))
+
+
+def _tp_pin_cache(cache, mesh, cfg: TransformerConfig):
+    """Constrain every cache/pool leaf kv-head-sharded over tp (dim 1;
+    3-D or 4-D leaves — the layout both sharded decode routes consume
+    in place). Non-array entries (the page table) pass through."""
+    from jax.sharding import NamedSharding
+
+    row3, block4 = _tp_serving_specs(mesh, cfg)
+    sh = {3: NamedSharding(mesh, row3), 4: NamedSharding(mesh, block4)}
+
+    def pin(a):
+        return (lax.with_sharding_constraint(a, sh[a.ndim])
+                if hasattr(a, "ndim") and a.ndim in sh else a)
+
+    return jax.tree.map(pin, cache)
+
+
 def _flash_route(mesh, cfg: TransformerConfig):
     """(use_flash, flash_sharded): the ONE flash/gather routing decision
     shared by prefill and decode_step — the prompt pass and the step
@@ -253,18 +282,7 @@ def prefill(params, prompt, cfg: TransformerConfig, max_len: int,
         # pin the cache kv-head-sharded over tp so the per-step
         # dynamic_update_slice and attention read stay rank-local (the
         # sharded decode step's shard_map consumes exactly this layout)
-        from jax.sharding import NamedSharding
-
-        tp = cfg.axis_tp
-        sh = {
-            4: NamedSharding(mesh, resolve_spec(P(None, tp, None, None),
-                                                mesh, cfg.mesh_axes)),
-            3: NamedSharding(mesh, resolve_spec(P(None, tp, None),
-                                                mesh, cfg.mesh_axes)),
-        }
-        cache = jax.tree.map(
-            lambda a: lax.with_sharding_constraint(a, sh[a.ndim]), cache
-        )
+        cache = _tp_pin_cache(cache, mesh, cfg)
     return logits.astype(jnp.float32), cache
 
 
@@ -368,16 +386,13 @@ def decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
                 # q heads [c·H/tp, ...) are exactly the g-groups of kv
                 # heads [c·Hkv/tp, ...), so each rank runs the kernel
                 # on its own whole (q-group, cache) rows
-                tp = cfg.axis_tp
-                rs = lambda spec: resolve_spec(spec, mesh, cfg.mesh_axes)
-                spec_q = rs(P(None, tp, None))
-                spec_c = rs(P(None, tp, None, None))
+                spec_q, spec_c = _tp_serving_specs(mesh, cfg)
                 args = [q, k_cache, v_cache,
                         jnp.asarray(pos, jnp.int32).reshape(1)]
                 specs = [spec_q, spec_c, spec_c, P()]
                 if int8_cache:
                     args += [k_scale, v_scale]
-                    specs += [rs(P(None, tp, None))] * 2
+                    specs += [spec_q] * 2  # scale rows are 3-D too
 
                 def local_attn(q, kc, vc, p, ks=None, vs=None):
                     return flash_decode_attention(
@@ -724,16 +739,8 @@ def paged_prefill(params, prompt, cfg: TransformerConfig, cache,
         # pin every pool kv-head-sharded over tp (all pool leaves are
         # 4-D with kv_heads on dim 1, scale pools included) so the
         # per-step writes and the sharded kernel stay rank-local
-        from jax.sharding import NamedSharding, PartitionSpec
-
-        sh = NamedSharding(
-            mesh, resolve_spec(PartitionSpec(None, cfg.axis_tp, None,
-                                             None), mesh, cfg.mesh_axes))
-        out = {
-            k: (v if k == "table"
-                else tuple(lax.with_sharding_constraint(a, sh) for a in v))
-            for k, v in out.items()
-        }
+        out = {k: (v if k == "table" else _tp_pin_cache(v, mesh, cfg))
+               for k, v in out.items()}
     return logits, out
 
 
@@ -893,10 +900,7 @@ def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
             # in this scope.)
             from jax.sharding import PartitionSpec as PS
 
-            tp_ax = cfg.axis_tp
-            rs = lambda s: resolve_spec(s, mesh, cfg.mesh_axes)
-            spec_q = rs(PS(None, tp_ax, None))
-            spec_pool = rs(PS(None, tp_ax, None, None))
+            spec_q, spec_pool = _tp_serving_specs(mesh, cfg)
             pos_arr = (pos if ragged
                        else jnp.asarray(pos, jnp.int32).reshape(1))
             args = [q, k_pool, v_pool, table, pos_arr]
