@@ -78,6 +78,10 @@ def main():
             )
 
             page = arg("page", 512 if on_tpu else 16)
+            # pages fetched per kernel grid step (0 = the kernel's
+            # auto: match the linear 2048-row block). --ppstep=1 is
+            # the round-4 one-page-per-step form for the gap sweep
+            ppstep = arg("ppstep", 0) or None
             pages = -(-(prompt_len + slack) // page)
             pcache = init_paged_cache(cfg0, batch, pages, page)
             _, pcache = jax.jit(
@@ -91,7 +95,7 @@ def main():
                     cache, pos, tok = carry
                     logits, cache = paged_decode_step(
                         params, cache, pos, tok, cfg0,
-                        identity_layout=True,
+                        identity_layout=True, pages_per_step=ppstep,
                     )
                     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                     return cache, pos + 1, nxt
@@ -107,7 +111,8 @@ def main():
             )
             t_step[impl] = t
             pool_tok = pages * page
-            print(f"impl=paged   pool={batch}x{pool_tok} (page {page}) "
+            print(f"impl=paged   pool={batch}x{pool_tok} (page {page}, "
+                  f"ppstep {ppstep or 'auto'}) "
                   f"B={batch} kv={cfg0.kv_heads}: {t * 1e3:6.3f} "
                   f"ms/token-step ({batch / t:,.0f} tok/s)")
             continue

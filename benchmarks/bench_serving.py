@@ -69,8 +69,8 @@ def main():
     reqs = []
     for _ in range(n):
         prompt = rng.randint(0, cfg.vocab, size=prompt_len).astype(np.int32)
-        budget = int(rng.choice([max_budget // 4, max_budget // 2,
-                                 max_budget]))
+        budget = int(rng.choice([max(1, max_budget // 4),
+                                 max(1, max_budget // 2), max_budget]))
         reqs.append((prompt, budget))
     pages_per_seq = -(-(prompt_len + max_budget) // page_size)
     total_tokens = sum(b for _, b in reqs)

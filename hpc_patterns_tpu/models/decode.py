@@ -793,7 +793,8 @@ def _scale_write(pool, page_ids, page, offset, rows, pages: int,
 
 
 def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
-                      identity_layout: bool = False, mesh=None):
+                      identity_layout: bool = False, mesh=None,
+                      pages_per_step: int | None = None):
     """One token per sequence against the paged cache: the new K/V row
     scatters into page ``table[:, pos // P]`` at offset ``pos % P``,
     and attention streams the live pages through
@@ -913,6 +914,7 @@ def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
                 return flash_decode_paged(
                     q, kp, vp, tbl, p if ragged else p[0],
                     k_scale_pool=ksp, v_scale_pool=vsp, scale=scale,
+                    pages_per_step=pages_per_step,
                 )
 
             o = jax.shard_map(
@@ -923,7 +925,8 @@ def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
         else:
             o = flash_decode_paged(q, k_pool, v_pool, table, pos,
                                    k_scale_pool=ks_pool,
-                                   v_scale_pool=vs_pool, scale=scale)
+                                   v_scale_pool=vs_pool, scale=scale,
+                                   pages_per_step=pages_per_step)
         return o, (k_pool, v_pool, ks_pool, vs_pool)
 
     states = [

@@ -179,6 +179,14 @@ class ContinuousBatcher:
                 f"max_seq {self.cfg.max_seq}"
             )
         sid = self._next_id if seq_id is None else seq_id
+        if (sid in self.finished
+                or any(r.seq_id == sid for r in self._queue)
+                or any(s.active and s.seq_id == sid
+                       for s in self._slots)):
+            raise ValueError(
+                f"seq_id {sid} already queued/active/finished — outputs "
+                "would silently merge under one key"
+            )
         self._next_id = max(self._next_id, sid) + 1
         self._queue.append(Request(prompt, max_new, sid))
         return sid

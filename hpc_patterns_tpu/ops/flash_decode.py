@@ -41,6 +41,46 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
+def _softmax_block(q_ref, k_ref, v_ref, ks_ref, vs_ref, m_ref, l_ref,
+                   acc_ref, block_start, pos, scale: float,
+                   quantized: bool):
+    """One online-softmax update over the cache block at logical rows
+    [block_start, block_start + block_s): THE streamed-attention math,
+    shared by the linear kernel (one block per grid step) and the paged
+    kernel (``pages_per_step`` page blocks per grid step).
+
+    f32 score/value math (unlike the training kernel's native-dtype
+    matmuls): a decode step is cache-READ-bound — the f32 compute is
+    free next to the bf16 stream, and it reproduces the gather path's
+    f32 einsum numerics so greedy tokens match. ``quantized``: per-row
+    dequant folded into the LANE axis of the score and probability
+    blocks — s_ij = (q·k8_j)·kscale_j and out = (p∘vscaleᵀ)·v8; the
+    (1, block_s) scale rows ride lane-major, and the (block_s, D)
+    tiles are never rescaled elementwise (a sublane-oriented
+    (block_s, 1) scale multiply measured ~3x slower than bf16)."""
+    q = q_ref[:].astype(jnp.float32)
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+                precision=lax.Precision.HIGHEST) * scale
+    if quantized:
+        s = s * ks_ref[:].astype(jnp.float32)
+    k_pos = block_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(k_pos <= pos, s, _NEG_INF)
+    m = m_ref[:]
+    m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    rescale = jnp.exp(m - m_new)
+    m_ref[:] = m_new
+    l_ref[:] = l_ref[:] * rescale + p.sum(axis=-1, keepdims=True)
+    if quantized:
+        p = p * vs_ref[:].astype(jnp.float32)
+    acc_ref[:] = acc_ref[:] * rescale + jnp.dot(
+        p, v, preferred_element_type=jnp.float32,
+        precision=lax.Precision.HIGHEST,
+    )
+
+
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, scale: float,
                    quantized: bool, hkv_per_row: int = 0):
     # grid (B·Hkv, n_s): one kv-cache block per step, grouped-query
@@ -53,6 +93,7 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, scale: float,
     if quantized:
         ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
     else:
+        ks_ref = vs_ref = None
         o_ref, m_ref, l_ref, acc_ref = rest
     g, d = q_ref.shape
     block_s = k_ref.shape[0]
@@ -71,37 +112,9 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, scale: float,
     # fetch was elided by the clamped index map, its compute is skipped
     @pl.when(si * block_s <= pos)
     def _():
-        # f32 score/value math (unlike the training kernel's native-
-        # dtype matmuls): a decode step is cache-READ-bound — the f32
-        # compute is free next to the bf16 stream, and it reproduces
-        # the gather path's f32 einsum numerics so greedy tokens match
-        q = q_ref[:].astype(jnp.float32)
-        k = k_ref[:].astype(jnp.float32)
-        v = v_ref[:].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
-                    precision=lax.Precision.HIGHEST) * scale
-        if quantized:
-            # per-row dequant folded into the LANE axis of the score
-            # and probability blocks: s_ij = (q·k8_j)·kscale_j and
-            # out = (p∘vscaleᵀ)·v8 — the (1, block_s) scale rows ride
-            # lane-major, and the (block_s, D) tiles are never
-            # rescaled elementwise (a sublane-oriented (block_s, 1)
-            # scale multiply measured ~3x slower than the bf16 path)
-            s = s * ks_ref[:].astype(jnp.float32)
-        k_pos = si * block_s + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(k_pos <= pos, s, _NEG_INF)
-        m = m_ref[:]
-        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        rescale = jnp.exp(m - m_new)
-        m_ref[:] = m_new
-        l_ref[:] = l_ref[:] * rescale + p.sum(axis=-1, keepdims=True)
-        if quantized:
-            p = p * vs_ref[:].astype(jnp.float32)
-        acc_ref[:] = acc_ref[:] * rescale + jnp.dot(
-            p, v, preferred_element_type=jnp.float32,
-            precision=lax.Precision.HIGHEST,
-        )
+        _softmax_block(q_ref, k_ref, v_ref, ks_ref, vs_ref, m_ref,
+                       l_ref, acc_ref, si * block_s, pos, scale,
+                       quantized)
 
     @pl.when(si == n_s - 1)
     def _():
@@ -200,14 +213,51 @@ def flash_decode_attention(
     return out.reshape(B, H, D)
 
 
-def _decode_kernel_paged(pos_ref, table_ref, q_ref, k_ref, v_ref, *rest,
-                         scale: float, quantized: bool = False,
-                         hkv_per_row: int = 0):
-    # same online-softmax body; the table ref is consumed by the index
-    # maps only (the logical position math needs just pos and si)
+def _decode_kernel_paged(pos_ref, table_ref, q_ref, *rest, scale: float,
+                         page_size: int, unroll: int,
+                         quantized: bool = False, hkv_per_row: int = 0):
+    # grid (B·Hkv, ceil(pages/unroll)): ``unroll`` page blocks arrive
+    # per grid step as separate refs (k_0..k_{U-1}, v_0..v_{U-1}
+    # [, ks_.., vs_..]) and the online softmax walks them in order —
+    # the round-4 page-hopping residue was one grid step (and one
+    # shallow DMA) per page; batching U pages per step restores the
+    # linear kernel's block depth (U·page ≈ its 2048-row block) while
+    # keeping page-granular allocation. The table ref is consumed by
+    # the index maps only.
     del table_ref
-    _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, scale=scale,
-                   quantized=quantized, hkv_per_row=hkv_per_row)
+    U = unroll
+    k_refs, rest = rest[:U], rest[U:]
+    v_refs, rest = rest[:U], rest[U:]
+    if quantized:
+        ks_refs, rest = rest[:U], rest[U:]
+        vs_refs, rest = rest[:U], rest[U:]
+    else:
+        ks_refs = vs_refs = (None,) * U
+    o_ref, m_ref, l_ref, acc_ref = rest
+    g, d = q_ref.shape
+    si = pl.program_id(1)
+    n_s = pl.num_programs(1)
+    pos = (pos_ref[pl.program_id(0) // hkv_per_row] if hkv_per_row
+           else pos_ref[0])
+
+    @pl.when(si == 0)
+    def _():
+        m_ref[:] = jnp.full((g, 1), _NEG_INF, jnp.float32)
+        l_ref[:] = jnp.zeros((g, 1), jnp.float32)
+        acc_ref[:] = jnp.zeros((g, d), jnp.float32)
+
+    for j in range(U):
+        start = (si * U + j) * page_size
+
+        @pl.when(start <= pos)
+        def _(j=j, start=start):
+            _softmax_block(q_ref, k_refs[j], v_refs[j], ks_refs[j],
+                           vs_refs[j], m_ref, l_ref, acc_ref, start,
+                           pos, scale, quantized)
+
+    @pl.when(si == n_s - 1)
+    def _():
+        o_ref[:] = acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
 
 
 def flash_decode_paged(
@@ -220,6 +270,7 @@ def flash_decode_paged(
     k_scale_pool=None,
     v_scale_pool=None,
     scale: float | None = None,
+    pages_per_step: int | None = None,
     interpret: bool | None = None,
 ):
     """Single-query attention against a PAGED KV cache.
@@ -250,6 +301,17 @@ def flash_decode_paged(
     kernel's half-the-HBM-bytes lever composed with the block table
     (the CAPACITY levers stack: int8 halves page bytes, paging frees
     the allocate-for-longest waste).
+
+    ``pages_per_step``: page blocks fetched per grid step (separate
+    refs walked by one online-softmax pass). Default: enough pages to
+    match the linear kernel's 2048-row streaming block — the round-4
+    measurement showed the paged kernel's 1.7x/token residue was the
+    per-page grid/DMA granularity, not the table indirection. Tradeoff:
+    a row whose live prefix is SHORTER than one step's U pages pays up
+    to U-1 one-time fetches of its clamped last page (each ref is a
+    distinct operand; cross-step elision still applies, cross-ref
+    doesn't) — negligible next to the long-row streaming this buys,
+    and ``pages_per_step=1`` restores the exact old behavior.
     """
     B, H, D = q.shape
     n_pool, Hkv, P, Dp = k_pool.shape
@@ -277,37 +339,44 @@ def flash_decode_paged(
     pos_arr = jnp.asarray(pos, jnp.int32).reshape(B if ragged else 1)
     table_flat = table.reshape(-1).astype(jnp.int32)
 
-    def page_idx(r, si, pos_ref, table_ref):
+    if pages_per_step is None:
+        # match the linear kernel's streaming block (block_s = 2048)
+        pages_per_step = max(1, 2048 // P)
+    U = max(1, min(int(pages_per_step), pages))
+    n_steps = -(-pages // U)
+
+    def page_idx(j):
         # clamp to the last live page (same fetch-elision as the linear
         # kernel), then indirect through this sequence's page list
-        b = r // Hkv
-        live = jnp.minimum(si, pos_ref[b if ragged else 0] // P)
-        return table_ref[b * pages + live], r % Hkv, 0, 0
+        def f(r, si, pos_ref, table_ref):
+            b = r // Hkv
+            live = jnp.minimum(si * U + j,
+                               pos_ref[b if ragged else 0] // P)
+            return table_ref[b * pages + live], r % Hkv, 0, 0
+
+        return f
 
     row = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
-    in_specs = [
-        row((None, g, D), lambda r, si, pos, tab: (r, 0, 0)),
-        row((None, None, P, D), page_idx),
-        row((None, None, P, D), page_idx),
-    ]
-    operands = [pos_arr, table_flat, qr, k_pool, v_pool]
+    in_specs = [row((None, g, D), lambda r, si, pos, tab: (r, 0, 0))]
+    in_specs += [row((None, None, P, D), page_idx(j)) for j in range(U)]
+    in_specs += [row((None, None, P, D), page_idx(j)) for j in range(U)]
+    operands = [pos_arr, table_flat, qr]
+    operands += [k_pool] * U + [v_pool] * U
     if quantized:
         # scales ride lane-major (1, page) rows, page-indirected like
         # the value blocks (see the linear kernel's layout note)
-        def scale_idx(r, si, pos_ref, table_ref):
-            p, h, _, _ = page_idx(r, si, pos_ref, table_ref)
-            return p, h, 0, 0
-
-        in_specs += [row((None, None, 1, P), scale_idx),
-                     row((None, None, 1, P), scale_idx)]
-        operands += [k_scale_pool, v_scale_pool]
+        in_specs += [row((None, None, 1, P), page_idx(j))
+                     for j in range(U)]
+        in_specs += [row((None, None, 1, P), page_idx(j))
+                     for j in range(U)]
+        operands += [k_scale_pool] * U + [v_scale_pool] * U
     out = pl.pallas_call(
         functools.partial(_decode_kernel_paged, scale=float(scale),
-                          quantized=quantized,
+                          page_size=P, unroll=U, quantized=quantized,
                           hkv_per_row=Hkv if ragged else 0),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(B * Hkv, pages),
+            grid=(B * Hkv, n_steps),
             in_specs=in_specs,
             out_specs=row((None, g, D), lambda r, si, pos, tab: (r, 0, 0)),
             scratch_shapes=[

@@ -582,6 +582,26 @@ class TestPagedCache:
         got = flash_decode_paged(q, pool_k, pool_v, table, pos)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=1e-6)
+        # every pages_per_step unroll (1 = the round-4 one-page-per-
+        # grid-step form; 3 = ragged last group; auto > pages clamps)
+        # walks the same permuted table to the same numbers — scalar
+        # and ragged positions both
+        rpos = jnp.array([37, 52], jnp.int32)
+        want_r = flash_decode_attention(q, kc, vc, jnp.int32(52))
+        for u in (1, 2, 3, None):
+            got_u = flash_decode_paged(q, pool_k, pool_v, table, pos,
+                                       pages_per_step=u)
+            np.testing.assert_allclose(np.asarray(got_u),
+                                       np.asarray(want), atol=1e-6,
+                                       err_msg=f"unroll={u}")
+            got_ur = flash_decode_paged(q, pool_k, pool_v, table, rpos,
+                                        pages_per_step=u)
+            np.testing.assert_allclose(np.asarray(got_ur[0]),
+                                       np.asarray(want[0]), atol=1e-6,
+                                       err_msg=f"ragged row0 unroll={u}")
+            np.testing.assert_allclose(np.asarray(got_ur[1]),
+                                       np.asarray(want_r[1]), atol=1e-6,
+                                       err_msg=f"ragged row1 unroll={u}")
 
     @pytest.mark.parametrize("over", [
         {},
