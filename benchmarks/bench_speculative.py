@@ -10,9 +10,19 @@ round 3 applies (single-token steps are floor-bound ~1 ms on this
 chip); min-of-reps and adjacent measurement are the mitigations.
 
 Usage: python benchmarks/bench_speculative.py [--n=256] [--temp=0.8]
+                                              [--pair=DIR]
+
+``--pair``: load an ALIGNED draft/target pair built by
+benchmarks/make_draft_pair.py instead of independent random weights —
+the honest envelope (random weights inflate greedy acceptance via
+repetition loops and deflate sampling acceptance via independence).
 """
 
+import json
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
@@ -47,19 +57,45 @@ def main():
     )
     gammas = (2, 4, 8)
     max_len = 128 + n + max(gammas) + 1
-    cfg = TransformerConfig(**base, max_seq=max_len)
-    dcfg = TransformerConfig(**{
-        **base,
-        "d_model": 256 if on_tpu else 32,
-        "n_layers": 2 if on_tpu else 1,
-        "d_ff": 1024 if on_tpu else 64,
-        "n_heads": 4 if on_tpu else 2,
-        "n_kv_heads": 2 if on_tpu else 0,
-    }, max_seq=max_len)
-    params = init_params(jax.random.PRNGKey(0), cfg)
-    dparams = init_params(jax.random.PRNGKey(1), dcfg)
-    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 128), 0,
-                                cfg.vocab, "int32")
+    pair = arg("pair", "", str)
+    if pair:
+        from hpc_patterns_tpu.utils.checkpoint import restore_params
+
+        with open(os.path.join(pair, "META.json")) as f:
+            meta = json.load(f)
+        cfg = TransformerConfig(**{**meta["target_cfg"],
+                                   "max_seq": max_len})
+        dcfg = TransformerConfig(**{**meta["draft_cfg"],
+                                    "max_seq": max_len})
+        params, _ = restore_params(os.path.join(pair, "target"))
+        dparams, _ = restore_params(os.path.join(pair, "draft"))
+        acc = meta.get("acceptance", {})
+        print(f"aligned pair from {pair}: greedy-agree "
+              f"{acc.get('aligned_greedy', float('nan')):.3f} "
+              f"E[min(p,q)] {acc.get('aligned_minpq', float('nan')):.3f} "
+              f"(random baseline {acc.get('random_greedy', float('nan')):.3f}"
+              f"/{acc.get('random_minpq', float('nan')):.3f})",
+              flush=True)
+        # prompt drawn from the SAME markov process the pair was
+        # trained on — acceptance on-distribution is the point
+        from make_draft_pair import markov_corpus
+
+        corpus = markov_corpus(cfg.vocab, 4096, seed=123)
+        prompt = jax.numpy.asarray(corpus[:128], "int32")[None, :]
+    else:
+        cfg = TransformerConfig(**base, max_seq=max_len)
+        dcfg = TransformerConfig(**{
+            **base,
+            "d_model": 256 if on_tpu else 32,
+            "n_layers": 2 if on_tpu else 1,
+            "d_ff": 1024 if on_tpu else 64,
+            "n_heads": 4 if on_tpu else 2,
+            "n_kv_heads": 2 if on_tpu else 0,
+        }, max_seq=max_len)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        dparams = init_params(jax.random.PRNGKey(1), dcfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 128), 0,
+                                    cfg.vocab, "int32")
     key = jax.random.PRNGKey(3)
 
     def per_token(fn):
