@@ -948,6 +948,101 @@ def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
     return logits, out
 
 
+def paged_extend_step(params, cache, pos, tokens, cfg: TransformerConfig):
+    """RAGGED multi-token cache extension against the paged cache: row
+    ``b``'s chunk ``tokens[b]`` occupies positions ``pos[b] ..
+    pos[b]+c-1`` — every row at its own length, the verification
+    primitive per-row-progress batched speculative decoding needs
+    (:mod:`~hpc_patterns_tpu.models.speculative`). ``pos``: (B,) int32.
+
+    The chunk K/V scatter into the pool at per-row page/offset pairs
+    (the ragged write generalized from one row to ``c``); attention is
+    the gather form over the table-linearized pools — a c-row query
+    block against the live prefix is MXU territory, exactly
+    :func:`extend_step`'s reasoning, with per-row causal masks
+    ``row <= pos[b]+i``. Compute-dtype pools only (like extend_step).
+    Returns (logits (B, c, vocab) f32, updated cache).
+
+    CONTRACT (same as :func:`paged_decode_step`): every touched
+    position < pages_per_seq * page_size; concrete ``pos`` is checked,
+    traced ``pos`` clamps silently past capacity.
+    """
+    if cfg.kv_cache_dtype != "compute":
+        raise ValueError(
+            "paged_extend_step supports compute-dtype pools only")
+    dt = jnp.dtype(cfg.dtype)
+    B, c = tokens.shape
+    if jnp.ndim(pos) != 1 or jnp.shape(pos)[0] != B:
+        raise ValueError(
+            f"pos must be (batch,)={B} per-row positions, got "
+            f"{jnp.shape(pos)}")
+    table = cache["table"]
+    Pg = cache["k"][0].shape[2]
+    pages = table.shape[1]
+    if not isinstance(pos, jax.core.Tracer):
+        if np.any(np.asarray(pos) + c > pages * Pg):
+            raise ValueError(
+                f"chunk end {int(np.asarray(pos).max()) + c} past cache "
+                f"capacity {pages * Pg} tokens")
+    scale = 1.0 / (cfg.head_dim ** 0.5)
+    Hkv, g, Dh = cfg.kv_heads, cfg.n_heads // cfg.kv_heads, cfg.head_dim
+
+    positions = pos[:, None] + jnp.arange(c, dtype=jnp.int32)  # (B, c)
+    x = params["embed"].astype(dt)[tokens]
+    if cfg.pos_embed == "learned":
+        x = x + params["pos_embed"].astype(dt)[positions]
+
+    page = positions // Pg
+    off = (positions % Pg).reshape(-1)  # (B*c,)
+    pids = jnp.take_along_axis(table, page, axis=1).reshape(-1)
+
+    def body(h, lp, k_pool, v_pool):
+        hn = _rmsnorm(h, lp["ln1_scale"])
+        q, k_new, v_new = project_qkv(hn, lp, cfg)  # (B, c, H/Hkv, Dh)
+        if cfg.pos_embed == "rope":
+            q = apply_rope(q, positions, cfg)
+            k_new = apply_rope(k_new, positions, cfg)
+        rows_k = k_new.reshape(B * c, Hkv, Dh).astype(k_pool.dtype)
+        rows_v = v_new.reshape(B * c, Hkv, Dh).astype(v_pool.dtype)
+        k_pool = k_pool.at[pids, :, off, :].set(rows_k)
+        v_pool = v_pool.at[pids, :, off, :].set(rows_v)
+        # table-linearized view: (B, Hkv, pages*Pg, D) — the extend
+        # reads the whole live prefix once, gather-form
+        k_lin = jnp.einsum("bphsd->bhpsd", k_pool[table]).reshape(
+            B, Hkv, pages * Pg, Dh)
+        v_lin = jnp.einsum("bphsd->bhpsd", v_pool[table]).reshape(
+            B, Hkv, pages * Pg, Dh)
+        qg = q.reshape(B, c, Hkv, g, Dh)
+        s = jnp.einsum(
+            "bckgd,bksd->bkgcs", qg.astype(jnp.float32),
+            k_lin.astype(jnp.float32),
+            precision=lax.Precision.HIGHEST,
+        ) * scale
+        row_pos = lax.broadcasted_iota(jnp.int32, s.shape, 4)
+        q_pos = positions[:, None, None, :, None]  # (B,1,1,c,1)
+        s = jnp.where(row_pos <= q_pos, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bkgcs,bksd->bckgd", p,
+                       v_lin.astype(jnp.float32),
+                       precision=lax.Precision.HIGHEST)
+        o = jnp.dot(o.reshape(B, c, cfg.d_model).astype(dt),
+                    lp["wo"].astype(dt))
+        h = _mlp(h + o, lp, cfg)
+        return h, (k_pool, v_pool)
+
+    ks, vs = [], []
+    for l in range(cfg.n_layers):
+        lp = jax.tree.map(lambda a: a[l], params["layers"])
+        x, (k_l, v_l) = body(x, lp, cache["k"][l], cache["v"][l])
+        ks.append(k_l)
+        vs.append(v_l)
+    x = _rmsnorm(x, params["ln_f_scale"])
+    logits = jnp.dot(x, params["lm_head"].astype(dt))
+    return logits.astype(jnp.float32), {
+        "k": tuple(ks), "v": tuple(vs), "table": table,
+    }
+
+
 @partial(jax.jit, static_argnums=(2, 3, 4, 5, 8, 9, 10))
 def _paged_generate_jit(params, prompt, cfg, new_tokens, page_size,
                         pages_per_seq, key, temperature, greedy, top_k,

@@ -53,6 +53,10 @@ from hpc_patterns_tpu.models.decode import (
     _topk_mask,
     decode_step,
     extend_step,
+    init_paged_cache,
+    paged_decode_step,
+    paged_extend_step,
+    paged_prefill,
     prefill,
 )
 from hpc_patterns_tpu.models.transformer import TransformerConfig
@@ -245,28 +249,146 @@ def speculative_generate(params, cfg: TransformerConfig, draft_params,
                             temperature, mesh)
 
 
+@partial(jax.jit, static_argnums=(1, 3, 5, 6, 8, 9))
+def _speculative_batched_ragged_jit(params, cfg, draft_params, draft_cfg,
+                                    prompts, new_tokens, gamma, key,
+                                    greedy, top_k, temperature):
+    """Per-row-progress batched speculative decoding on the ragged
+    paged machinery: ONE batched draft/verify round per iteration,
+    every row advancing at its OWN acceptance rate through per-row
+    position cursors (the serving building block), instead of vmap
+    lifting B independent single-row loops (whose per-row cache DUS
+    becomes a full-cache scatter per lane per step). Rows that reach
+    ``new_tokens`` freeze: their cursors stop, their (masked) writes
+    land inside pages they still own, and their emit slots re-write
+    the existing values."""
+    B, T = prompts.shape
+    # slack: the final active round can run gamma+1 past new_tokens
+    max_len = T + new_tokens + gamma + 1
+    page = 128 if max_len > 128 else 16
+    pages = -(-max_len // page)
+
+    cache = init_paged_cache(cfg, B, pages, page)
+    dcache = init_paged_cache(draft_cfg, B, pages, page)
+    logits, cache = paged_prefill(params, prompts, cfg, cache, page)
+    _, dcache = paged_prefill(draft_params, prompts, draft_cfg, dcache,
+                              page)
+    if key is None:
+        key = jax.random.PRNGKey(0)  # unused in greedy mode
+    key, sub = jax.random.split(key)
+    first = _pick(logits, sub, temperature, greedy, top_k)  # (B,)
+
+    out = jnp.zeros((B, new_tokens + gamma + 1), jnp.int32)
+    out = out.at[:, 0].set(first)
+    rows = jnp.arange(B)
+
+    def cond(state):
+        _, _, _, _, n_out, _, _ = state
+        return jnp.any(n_out < new_tokens)
+
+    def body(state):
+        cache, dcache, pos, cur, n_out, key, out = state
+        active = n_out < new_tokens
+        # frozen rows keep stepping (one batched kernel serves all
+        # rows) but at a CLAMPED position so they can never run past
+        # their page allocation; their garbage lands in pages they own
+        pos_eff = jnp.where(active, pos, 0)
+
+        # --- draft: gamma proposals per row (gamma+1 steps; the extra
+        # one writes the last proposal's K/V, the shared invariant)
+        props = []
+        qs = []
+        tok = cur
+        dc = dcache
+        for j in range(gamma + 1):
+            dlogits, dc = paged_decode_step(draft_params, dc,
+                                            pos_eff + j, tok, draft_cfg)
+            key, sub = jax.random.split(key)
+            tok = _pick(dlogits, sub, temperature, greedy, top_k)
+            if j < gamma:
+                props.append(tok)
+                if not greedy:
+                    qs.append(_warp(dlogits, temperature, top_k))
+        props = jnp.stack(props, axis=1)  # (B, gamma)
+
+        # --- target verifies [cur, props] in ONE ragged paged extend
+        chunk = jnp.concatenate([cur[:, None], props], axis=1)
+        vlogits, cache = paged_extend_step(params, cache, pos_eff,
+                                           chunk, cfg)
+
+        if greedy:
+            t_all = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+            matches = (props == t_all[:, :gamma]).astype(jnp.int32)
+            a = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)  # (B,)
+            nxt = t_all[rows, a]
+        else:
+            key, sub = jax.random.split(key)
+            a, nxt = jax.vmap(_accept_resample)(
+                jax.random.split(sub, B), props,
+                jnp.stack(qs, axis=1),
+                _warp(vlogits, temperature, top_k),
+            )
+        # emitted this round per row: props[:a], then nxt; frozen rows
+        # re-write their existing slots (gather-old / where / scatter)
+        props_padded = jnp.concatenate([props, props[:, -1:]], axis=1)
+        emit = jnp.where(jnp.arange(gamma + 1)[None, :] < a[:, None],
+                         props_padded, nxt[:, None])
+        idx = jnp.minimum(n_out[:, None] + jnp.arange(gamma + 1),
+                          out.shape[1] - 1)
+        old = out[rows[:, None], idx]
+        out = out.at[rows[:, None], idx].set(
+            jnp.where(active[:, None], emit, old))
+        adv = jnp.where(active, a + 1, 0)
+        return (cache, dc, pos + adv, jnp.where(active, nxt, cur),
+                n_out + adv, key, out)
+
+    state = (cache, dcache, jnp.full((B,), T, jnp.int32), first,
+             jnp.ones((B,), jnp.int32), key, out)
+    state = lax.while_loop(cond, body, state)
+    return state[6][:, :new_tokens]
+
+
 def speculative_generate_batched(params, cfg: TransformerConfig,
                                  draft_params,
                                  draft_cfg: TransformerConfig, prompts,
                                  new_tokens: int, *, gamma: int = 4,
                                  key=None, temperature: float = 0.0,
-                                 top_k: int = 0):
-    """Batched speculative decoding via ``jax.vmap`` over sequences:
-    each row runs its own acceptance loop (vmap lifts the while_loop to
-    run until every row finishes — rows that finish early mask). Output
-    (B, new_tokens), row-wise token-identical to
-    :func:`speculative_generate` (oracle-tested; sampling rows each
-    consume their own fold of ``key``). Wall-clock note: the batch
-    advances at the SLOWEST row's acceptance rate; per-sequence calls
-    win when acceptance varies wildly. Single-device only (vmap over
-    the tp shard_map route is not supported; use per-sequence
-    ``speculative_generate(..., mesh=...)`` for sharded serving)."""
+                                 top_k: int = 0, impl: str = "ragged"):
+    """Batched speculative decoding, (B, new_tokens) int32.
+
+    ``impl="ragged"`` (default): per-row-progress on the ragged paged
+    machinery — one batched draft/verify round per iteration with
+    per-row position cursors, each row advancing at its own acceptance
+    rate (greedy output row-wise token-identical to
+    :func:`speculative_generate`; sampling rows draw from the same law
+    but consume randomness differently than the vmap form).
+
+    ``impl="vmap"``: the round-3 form — ``jax.vmap`` over per-row
+    loops (each lane's cache update lifts to a full-cache scatter;
+    kept for comparison and for exact per-row key-fold reproducibility
+    with per-sequence sampling calls).
+
+    Wall-clock note (both impls): the CALL returns when the slowest
+    row finishes — that is batch semantics, not an impl property; for
+    throughput past it, serve via models/serving.py's continuous
+    batching. Single-device (for tp-sharded serving use per-sequence
+    ``speculative_generate(..., mesh=...)``)."""
     if prompts.ndim != 2:
         raise ValueError(f"prompts must be (B, T), got {prompts.shape}")
     _validate(cfg, draft_cfg, prompts.shape[1], new_tokens, gamma)
     key, greedy, top_k, temperature = _sampling_args(
         cfg, temperature, top_k, key
     )
+    if impl == "ragged":
+        if cfg.kv_cache_dtype != "compute":
+            raise ValueError(
+                "impl='ragged' needs compute-dtype caches (the paged "
+                "extend is compute-dtype; use impl='vmap' for int8)")
+        return _speculative_batched_ragged_jit(
+            params, cfg, draft_params, draft_cfg, prompts, new_tokens,
+            gamma, key, greedy, top_k, temperature)
+    if impl != "vmap":
+        raise ValueError(f"impl must be 'ragged' or 'vmap', got {impl!r}")
     # greedy mode still threads per-row keys through vmap (unused by the
     # accept path); split a fixed root so the dummies share the REAL
     # keys' dtype/format — raw uint32 zeros relied on the deprecated

@@ -547,6 +547,129 @@ class TestSpeculativeBatched:
         ))
         np.testing.assert_array_equal(got, want)
 
+    def test_batched_impls_agree_greedy(self):
+        # the per-row-progress ragged impl and the round-3 vmap impl
+        # must emit identical greedy tokens (both == target greedy) on
+        # heterogeneous rows whose acceptance rates differ — rows
+        # advancing at different per-round strides is the point
+        from hpc_patterns_tpu.models.speculative import (
+            speculative_generate_batched,
+        )
+
+        cfg, params, _ = _setup(batch=1)
+        dcfg = TransformerConfig(**{**BASE, "d_model": 16, "d_ff": 32,
+                                    "n_layers": 1, "n_heads": 2})
+        dparams = init_params(jax.random.PRNGKey(42), dcfg)
+        # one row is the target's own prompt style, one is constant,
+        # one adversarial — acceptance will differ row to row
+        prompts = jnp.stack([
+            jax.random.randint(jax.random.PRNGKey(9), (8,), 0,
+                               cfg.vocab, jnp.int32),
+            jnp.full((8,), 3, jnp.int32),
+            jnp.arange(8, dtype=jnp.int32) * 7 % cfg.vocab,
+        ])
+        want = np.asarray(greedy_generate(params, prompts, cfg, 12))
+        for impl in ("ragged", "vmap"):
+            got = np.asarray(speculative_generate_batched(
+                params, cfg, dparams, dcfg, prompts, 12, gamma=4,
+                impl=impl))
+            np.testing.assert_array_equal(got, want, err_msg=impl)
+
+    def test_batched_ragged_sampling_in_range(self):
+        from hpc_patterns_tpu.models.speculative import (
+            speculative_generate_batched,
+        )
+
+        cfg, params, prompt = _setup(batch=2)
+        dcfg = TransformerConfig(**{**BASE, "d_model": 16, "d_ff": 32,
+                                    "n_layers": 1, "n_heads": 2})
+        dparams = init_params(jax.random.PRNGKey(42), dcfg)
+        got = np.asarray(speculative_generate_batched(
+            params, cfg, dparams, dcfg, prompt, 8, gamma=2,
+            key=jax.random.PRNGKey(5), temperature=0.8, top_k=4,
+            impl="ragged"))
+        assert got.shape == (2, 8)
+        assert got.min() >= 0 and got.max() < cfg.vocab
+
+    def test_batched_ragged_rejects_int8(self):
+        from hpc_patterns_tpu.models.speculative import (
+            speculative_generate_batched,
+        )
+
+        cfg, params, prompt = _setup(batch=2, kv_cache_dtype="int8")
+        dcfg = TransformerConfig(**{**BASE, "d_model": 16, "d_ff": 32,
+                                    "n_layers": 1, "n_heads": 2,
+                                    "kv_cache_dtype": "int8"})
+        dparams = init_params(jax.random.PRNGKey(42), dcfg)
+        with pytest.raises(ValueError, match="ragged"):
+            speculative_generate_batched(params, cfg, dparams, dcfg,
+                                         prompt, 8, gamma=2)
+
+
+class TestPagedExtend:
+    @pytest.mark.parametrize("over", [
+        {},
+        {"pos_embed": "rope"},
+        {"n_kv_heads": 2},
+    ])
+    def test_ragged_extend_matches_sequential_ragged_steps(self, over):
+        # one c-token RAGGED extend == c sequential ragged paged
+        # decode_steps: same logits at every chunk position, same pool
+        # contents — with every row at a DIFFERENT starting length
+        from hpc_patterns_tpu.models.decode import (
+            init_paged_cache,
+            paged_decode_step,
+            paged_extend_step,
+            paged_prefill,
+        )
+
+        cfg, params, prompt = _setup(**over)
+        pos = jnp.array([8, 9], jnp.int32)  # row 1 one past row 0
+        chunk = jnp.array([[1, 2, 3], [4, 5, 6]], jnp.int32)
+        ca = init_paged_cache(cfg, 2, pages_per_seq=3, page_size=8)
+        cb = init_paged_cache(cfg, 2, pages_per_seq=3, page_size=8)
+        _, ca = paged_prefill(params, prompt, cfg, ca, 8)
+        _, cb = paged_prefill(params, prompt, cfg, cb, 8)
+        # row 1 needs its position-8 row filled before starting at 9
+        _, cb = paged_decode_step(params, cb, jnp.array([12, 8],
+                                                       jnp.int32),
+                                  jnp.array([0, 9], jnp.int32), cfg)
+        _, ca = paged_decode_step(params, ca, jnp.array([12, 8],
+                                                       jnp.int32),
+                                  jnp.array([0, 9], jnp.int32), cfg)
+        le, ca = paged_extend_step(params, ca, pos, chunk, cfg)
+        for j in range(3):
+            lj, cb = paged_decode_step(params, cb, pos + j,
+                                       chunk[:, j], cfg)
+            np.testing.assert_allclose(np.asarray(le[:, j]),
+                                       np.asarray(lj), atol=2e-5,
+                                       err_msg=f"chunk position {j}")
+        for a, b in zip(jax.tree.leaves(ca), jax.tree.leaves(cb)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5)
+
+    def test_guards(self):
+        from hpc_patterns_tpu.models.decode import (
+            init_paged_cache,
+            paged_extend_step,
+        )
+
+        cfg, params, _ = _setup()
+        cache = init_paged_cache(cfg, 2, pages_per_seq=2, page_size=8)
+        with pytest.raises(ValueError, match="capacity"):
+            paged_extend_step(params, cache, jnp.array([14, 3],
+                                                       jnp.int32),
+                              jnp.zeros((2, 3), jnp.int32), cfg)
+        with pytest.raises(ValueError, match="per-row"):
+            paged_extend_step(params, cache, jnp.int32(3),
+                              jnp.zeros((2, 3), jnp.int32), cfg)
+        qcfg = TransformerConfig(**{**BASE, "kv_cache_dtype": "int8"})
+        qcache = init_paged_cache(qcfg, 2, pages_per_seq=2, page_size=8)
+        with pytest.raises(ValueError, match="compute"):
+            paged_extend_step(params, qcache, jnp.array([3, 3],
+                                                        jnp.int32),
+                              jnp.zeros((2, 3), jnp.int32), qcfg)
+
 
 class TestPagedCache:
     """Block-table (paged) KV serving: the paged kernel must reproduce
