@@ -1,0 +1,193 @@
+"""Serve app: continuous batching over the paged KV cache, validated.
+
+Completes the lifecycle triad's serving leg as a CLI: a stream of
+requests with varied prompt lengths and budgets served through
+models/serving.ContinuousBatcher (page free-list, admission as pages
+free, per-row completion), then EVERY sequence validated token-exact
+against its standalone ``paged_generate`` — the reference's
+benchmark-IS-the-test discipline (SURVEY.md §4: the binary measures
+its own claim and exits SUCCESS/FAILURE). Reports tokens/s and, with
+``--static-compare``, the static-batching baseline wall clock.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from hpc_patterns_tpu import topology
+from hpc_patterns_tpu.harness import RunLog, Verdict
+from hpc_patterns_tpu.harness.cli import base_parser
+from hpc_patterns_tpu.models import TransformerConfig, init_params
+
+
+def build_parser():
+    p = base_parser(__doc__.splitlines()[0])
+    p.add_argument("--requests", type=int, default=8)
+    p.add_argument("--slots", type=int, default=2,
+                   help="concurrent rows in the pool")
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--chunk", type=int, default=4,
+                   help="decode steps per jitted dispatch (admission "
+                        "granularity)")
+    p.add_argument("--prompt-len", type=int, default=12)
+    p.add_argument("--budget", type=int, default=12,
+                   help="max new tokens per request (actual budgets "
+                        "vary 1/4..1x)")
+    p.add_argument("--pool-pages", type=int, default=0,
+                   help="shared arena size (0 = slots * pages needed "
+                        "for prompt+budget)")
+    p.add_argument("--eos-id", type=int, default=-1,
+                   help=">= 0: end rows early at this token")
+    p.add_argument("--d-model", type=int, default=64)
+    p.add_argument("--n-layers", type=int, default=2)
+    p.add_argument("--n-heads", type=int, default=4)
+    p.add_argument("--n-kv-heads", type=int, default=0)
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--pos-embed", default="learned",
+                   choices=["learned", "rope"])
+    p.add_argument("--kv-cache-dtype", default="compute",
+                   choices=["compute", "int8"])
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="serve a trained checkpoint (train_app "
+                        "--checkpoint-dir); default: fresh init")
+    p.add_argument("--static-compare", action="store_true",
+                   help="also time static batching (batches of "
+                        "--slots padded to the batch max budget)")
+    return p
+
+
+def run(args) -> int:
+    log = RunLog(args.log, truncate=not args.log_append)
+    topology.init_distributed_from_env()
+    from hpc_patterns_tpu.models.decode import paged_generate
+    from hpc_patterns_tpu.models.serving import ContinuousBatcher
+
+    need = args.prompt_len + args.budget
+    try:
+        cfg = TransformerConfig(
+            vocab=args.vocab, d_model=args.d_model,
+            n_heads=args.n_heads, n_layers=args.n_layers,
+            d_ff=4 * args.d_model, max_seq=need,
+            n_kv_heads=args.n_kv_heads, pos_embed=args.pos_embed,
+            kv_cache_dtype=args.kv_cache_dtype,
+        )
+    except ValueError as e:
+        log.print(f"ERROR: {e}")
+        log.print("FAILURE")
+        return 1
+    if args.requests < 1 or args.slots < 1 or args.budget < 1:
+        log.print("ERROR: --requests/--slots/--budget must be >= 1")
+        log.print("FAILURE")
+        return 1
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    if args.checkpoint_dir:
+        from hpc_patterns_tpu.utils.checkpoint import restore_params
+
+        try:
+            params, step = restore_params(args.checkpoint_dir)
+            log.print(f"restored step {step} from {args.checkpoint_dir}")
+        except (FileNotFoundError, ValueError, KeyError) as e:
+            log.print(f"ERROR: cannot restore {args.checkpoint_dir}: {e}")
+            log.print("FAILURE")
+            return 1
+
+    pages_per_seq = -(-need // args.page_size)
+    pool_pages = args.pool_pages or args.slots * pages_per_seq
+    rng = np.random.RandomState(7)
+    reqs = []
+    for _ in range(args.requests):
+        prompt = rng.randint(0, cfg.vocab,
+                             size=args.prompt_len).astype(np.int32)
+        budget = int(rng.choice([max(1, args.budget // 4),
+                                 max(1, args.budget // 2), args.budget]))
+        reqs.append((prompt, budget))
+    total_budget = sum(b for _, b in reqs)
+
+    def serve():
+        eng = ContinuousBatcher(
+            params, cfg, slots=args.slots, pool_pages=pool_pages,
+            pages_per_seq=pages_per_seq, page_size=args.page_size,
+            chunk=args.chunk,
+            eos_id=args.eos_id if args.eos_id >= 0 else None,
+        )
+        ids = [eng.submit(p, b) for p, b in reqs]
+        try:
+            got = eng.run()
+        except RuntimeError as e:
+            return None, str(e)
+        return {i: got[sid] for i, sid in enumerate(ids)}, None
+
+    out, err = serve()  # warmup (compiles)
+    if err is not None:
+        log.print(f"ERROR: {err}")
+        log.print("FAILURE")
+        return 1
+    t0 = time.perf_counter()
+    out, _ = serve()
+    dt = time.perf_counter() - t0
+    served = sum(len(v) for v in out.values())
+
+    # the oracle: every sequence token-exact vs standalone paged decode
+    # (truncated at eos when enabled — same rule the engine applies)
+    exact = True
+    for i, (prompt, budget) in enumerate(reqs):
+        want = np.asarray(paged_generate(
+            params, jnp.asarray(prompt)[None, :], cfg, budget,
+            page_size=args.page_size))[0]
+        if args.eos_id >= 0 and np.any(want == args.eos_id):
+            want = want[:int(np.argmax(want == args.eos_id)) + 1]
+        if not np.array_equal(out[i], want):
+            exact = False
+            log.print(f"MISMATCH seq {i}: engine {out[i][:8]}... vs "
+                      f"standalone {want[:8]}...")
+    ok = exact and served > 0
+    log.emit(kind="result", name="serve", success=ok,
+             requests=args.requests, slots=args.slots,
+             pool_pages=pool_pages, page_size=args.page_size,
+             chunk=args.chunk, served_tokens=served,
+             tokens_per_s=served / dt, oracle_exact=exact)
+    log.print(f"serve[{args.slots} slots, pool {pool_pages}p x "
+              f"{args.page_size}] {args.requests} reqs, {served} tokens "
+              f"(budget {total_budget}): {dt:.3f}s, "
+              f"{served / dt:,.1f} tok/s, oracle "
+              f"{'exact' if exact else 'MISMATCH'}")
+
+    if args.static_compare:
+        def run_static():
+            o = {}
+            for i in range(0, args.requests, args.slots):
+                batch = reqs[i:i + args.slots]
+                prompts = jnp.asarray(np.stack([p for p, _ in batch]))
+                run_len = max(b for _, b in batch)
+                toks = np.asarray(paged_generate(
+                    params, prompts, cfg, run_len,
+                    page_size=args.page_size))
+                for j, (_, b) in enumerate(batch):
+                    o[i + j] = toks[j, :b]
+            return o
+
+        run_static()  # warmup
+        t0 = time.perf_counter()
+        run_static()
+        ts = time.perf_counter() - t0
+        log.print(f"static batching: {ts:.3f}s "
+                  f"({served / ts:,.1f} tok/s) — engine/static "
+                  f"{ts / dt:.2f}x")
+
+    verdict = Verdict(success=ok, messages=("SUCCESS" if ok else "FAILURE",))
+    log.print(verdict.summary_line())
+    return verdict.exit_code
+
+
+def main(argv=None) -> int:
+    return run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -204,3 +204,39 @@ class TestEvalApp:
         out = capsys.readouterr().out
         assert code == 1
         assert "ERROR" in out and "FAILURE" in out
+
+
+class TestServeApp:
+    def test_serve_oracle_exact_success(self, capsys):
+        from hpc_patterns_tpu.apps import serve_app
+
+        code = serve_app.main(
+            ["--requests", "5", "--slots", "2", "--budget", "8",
+             "--prompt-len", "9", "--chunk", "2"]
+        )
+        out = capsys.readouterr().out
+        assert code == 0, out
+        assert "oracle exact" in out and "SUCCESS" in out
+
+    def test_serve_eos_and_int8(self, capsys):
+        from hpc_patterns_tpu.apps import serve_app
+
+        code = serve_app.main(
+            ["--requests", "4", "--slots", "2", "--budget", "8",
+             "--prompt-len", "9", "--eos-id", "3",
+             "--kv-cache-dtype", "int8"]
+        )
+        out = capsys.readouterr().out
+        assert code == 0, out
+        assert "SUCCESS" in out
+
+    def test_serve_pool_too_small_fails_cleanly(self, capsys):
+        from hpc_patterns_tpu.apps import serve_app
+
+        code = serve_app.main(
+            ["--requests", "2", "--slots", "1", "--budget", "8",
+             "--prompt-len", "9", "--pool-pages", "1"]
+        )
+        out = capsys.readouterr().out
+        assert code == 1
+        assert "FAILURE" in out
