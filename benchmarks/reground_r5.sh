@@ -1,0 +1,76 @@
+#!/bin/bash
+# Round-5 re-grounding sequence (VERDICT items 1b, 2, 8 + serving rows).
+# Ordering discipline: light jobs first, near-full-HBM jobs (65k/131k)
+# LAST — they can crash the tunnel worker and degrade the session for
+# everything after (round-4 lesson, memory: axon-env-quirks).
+# Usage: bash benchmarks/reground_r5.sh [logfile]
+set -u
+LOG="${1:-benchmarks/r5_chip.log}"
+cd "$(dirname "$0")/.."
+run() {
+  local name="$1"; shift
+  echo "=== $name ($(date +%H:%M:%S)) ===" | tee -a "$LOG"
+  timeout 1200 "$@" 2>&1 | tee -a "$LOG"
+  echo "--- rc=$? ---" | tee -a "$LOG"
+}
+
+# 0. session health + headline (the driver-style capture, kept as a row)
+run "bench.py headline" python bench.py
+
+# 1. T=2048 MFU row (the 73-75% config)
+run "train T=2048 kv=2" python - <<'EOF'
+import sys; sys.argv = ["b", "--seq=2048", "--batch=8", "--remat=1", "--kv=2"]
+sys.path.insert(0, "benchmarks"); import bench_train as bt; bt.main()
+EOF
+
+# 2. fused-MLP confirm at the headline (clean-session, item 8)
+run "train T=2048 fused" python - <<'EOF'
+import sys; sys.argv = ["b", "--seq=2048", "--batch=8", "--remat=1", "--kv=2", "--mlp=fused"]
+sys.path.insert(0, "benchmarks"); import bench_train as bt; bt.main()
+EOF
+
+# 3. decode absolutes at the 2k-prefix/16k-alloc regime + the paged
+#    unroll sweep (item 2: gap target <= 1.2x of linear)
+run "decode flash+gather" python benchmarks/bench_decode.py --prompt=2048 --slack=14336 --kv=2
+run "decode paged auto-unroll" python benchmarks/bench_decode.py --prompt=2048 --slack=14336 --kv=2 --impl=paged
+run "decode paged ppstep=1 (round-4 form)" python benchmarks/bench_decode.py --prompt=2048 --slack=14336 --kv=2 --impl=paged --ppstep=1
+run "decode paged ppstep=2" python benchmarks/bench_decode.py --prompt=2048 --slack=14336 --kv=2 --impl=paged --ppstep=2
+run "decode paged ppstep=8" python benchmarks/bench_decode.py --prompt=2048 --slack=14336 --kv=2 --impl=paged --ppstep=8
+run "decode paged page=2048" python benchmarks/bench_decode.py --prompt=2048 --slack=14336 --kv=2 --impl=paged --page=2048
+
+# 4. continuous batching vs static (item 3's chip row)
+run "serving engine vs static" python benchmarks/bench_serving.py
+
+# 5. aligned speculative pair + gamma sweep + batched impls (item 4, 7)
+run "make draft pair" python benchmarks/make_draft_pair.py --out=benchmarks/pair_r5
+run "speculative aligned sweep" python benchmarks/bench_speculative.py --pair=benchmarks/pair_r5 --batched=8
+
+# 6. T=32k long-context confirm (item 1b) + fused at 32k (item 8)
+run "train T=32k split+chunk" python - <<'EOF'
+import sys; sys.argv = ["b", "--seq=32768", "--batch=1", "--remat=1", "--rp=split", "--chunk=4096", "--kv=2"]
+sys.path.insert(0, "benchmarks"); import bench_train as bt; bt.main()
+EOF
+run "train T=32k fused" python - <<'EOF'
+import sys; sys.argv = ["b", "--seq=32768", "--batch=1", "--remat=1", "--rp=split", "--chunk=4096", "--kv=2", "--mlp=fused"]
+sys.path.insert(0, "benchmarks"); import bench_train as bt; bt.main()
+EOF
+
+# 7. RISKY LAST: the OPEN 65k question — does rp=split fit at 65k on a
+#    fresh session (expected ~115-120 TF/s) or does OOM confirm
+#    rp=nothing (~102) stands? Then the rp=nothing confirm, then 131k.
+run "train T=65k SPLIT+chunk (OPEN row)" python - <<'EOF'
+import sys; sys.argv = ["b", "--seq=65536", "--batch=1", "--remat=1", "--rp=split", "--chunk=4096", "--kv=2"]
+sys.path.insert(0, "benchmarks"); import bench_train as bt; bt.main()
+EOF
+run "train T=65k rp=nothing confirm (round-3 2835ms row)" python - <<'EOF'
+import sys; sys.argv = ["b", "--seq=65536", "--batch=1", "--remat=1", "--rp=nothing", "--chunk=4096", "--kv=2"]
+sys.path.insert(0, "benchmarks"); import bench_train as bt; bt.main()
+EOF
+run "train T=131k (round-3 reproduce cmd)" python - <<'EOF'
+import sys; sys.argv = ["b", "--seq=131072", "--batch=1", "--remat=1", "--rp=nothing", "--chunk=4096", "--pos=rope", "--offload=1"]
+sys.path.insert(0, "benchmarks"); import bench_train as bt; bt.main()
+EOF
+
+# 8. final health check — did the risky jobs degrade the session?
+run "bench.py post-check" python bench.py
+echo "DONE $(date +%H:%M:%S)" | tee -a "$LOG"

@@ -56,6 +56,14 @@ def build_parser():
     p.add_argument("--checkpoint-dir", default=None,
                    help="serve a trained checkpoint (train_app "
                         "--checkpoint-dir); default: fresh init")
+    p.add_argument("--draft-pair", default=None, metavar="DIR",
+                   help="serve an aligned draft/target pair "
+                        "(benchmarks/make_draft_pair.py): speculative "
+                        "rounds inside the engine — rows advance "
+                        "1..gamma+1 tokens per dispatch (overrides the "
+                        "model-dim flags with the pair's configs)")
+    p.add_argument("--gamma", type=int, default=4,
+                   help="draft proposals per round with --draft-pair")
     p.add_argument("--static-compare", action="store_true",
                    help="also time static batching (batches of "
                         "--slots padded to the batch max budget)")
@@ -69,15 +77,41 @@ def run(args) -> int:
     from hpc_patterns_tpu.models.serving import ContinuousBatcher
 
     need = args.prompt_len + args.budget
+    draft_params = draft_cfg = None
+    if args.draft_pair and args.checkpoint_dir:
+        log.print("ERROR: --draft-pair serves the pair's own target "
+                  "checkpoint; --checkpoint-dir would be silently "
+                  "ignored — pass one or the other")
+        log.print("FAILURE")
+        return 1
     try:
-        cfg = TransformerConfig(
-            vocab=args.vocab, d_model=args.d_model,
-            n_heads=args.n_heads, n_layers=args.n_layers,
-            d_ff=4 * args.d_model, max_seq=need,
-            n_kv_heads=args.n_kv_heads, pos_embed=args.pos_embed,
-            kv_cache_dtype=args.kv_cache_dtype,
-        )
-    except ValueError as e:
+        if args.draft_pair:
+            import json
+            import os
+
+            from hpc_patterns_tpu.utils.checkpoint import restore_params
+
+            with open(os.path.join(args.draft_pair, "META.json")) as f:
+                meta = json.load(f)
+            cfg = TransformerConfig(**{**meta["target_cfg"],
+                                       "max_seq": need})
+            draft_cfg = TransformerConfig(**{**meta["draft_cfg"],
+                                             "max_seq": need})
+            params, _ = restore_params(
+                os.path.join(args.draft_pair, "target"))
+            draft_params, _ = restore_params(
+                os.path.join(args.draft_pair, "draft"))
+            log.print(f"aligned pair from {args.draft_pair} "
+                      f"(gamma={args.gamma})")
+        else:
+            cfg = TransformerConfig(
+                vocab=args.vocab, d_model=args.d_model,
+                n_heads=args.n_heads, n_layers=args.n_layers,
+                d_ff=4 * args.d_model, max_seq=need,
+                n_kv_heads=args.n_kv_heads, pos_embed=args.pos_embed,
+                kv_cache_dtype=args.kv_cache_dtype,
+            )
+    except (ValueError, FileNotFoundError, KeyError) as e:
         log.print(f"ERROR: {e}")
         log.print("FAILURE")
         return 1
@@ -85,19 +119,25 @@ def run(args) -> int:
         log.print("ERROR: --requests/--slots/--budget must be >= 1")
         log.print("FAILURE")
         return 1
-    params = init_params(jax.random.PRNGKey(0), cfg)
-    if args.checkpoint_dir:
-        from hpc_patterns_tpu.utils.checkpoint import restore_params
+    if not args.draft_pair:
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        if args.checkpoint_dir:
+            from hpc_patterns_tpu.utils.checkpoint import restore_params
 
-        try:
-            params, step = restore_params(args.checkpoint_dir)
-            log.print(f"restored step {step} from {args.checkpoint_dir}")
-        except (FileNotFoundError, ValueError, KeyError) as e:
-            log.print(f"ERROR: cannot restore {args.checkpoint_dir}: {e}")
-            log.print("FAILURE")
-            return 1
+            try:
+                params, step = restore_params(args.checkpoint_dir)
+                log.print(
+                    f"restored step {step} from {args.checkpoint_dir}")
+            except (FileNotFoundError, ValueError, KeyError) as e:
+                log.print(f"ERROR: cannot restore "
+                          f"{args.checkpoint_dir}: {e}")
+                log.print("FAILURE")
+                return 1
 
-    pages_per_seq = -(-need // args.page_size)
+    # the engine owns the sizing rule (incl. speculative slack)
+    pages_per_seq = ContinuousBatcher.pages_needed(
+        args.prompt_len, args.budget, args.page_size,
+        gamma=args.gamma if draft_params is not None else None)
     pool_pages = args.pool_pages or args.slots * pages_per_seq
     rng = np.random.RandomState(7)
     reqs = []
@@ -110,16 +150,21 @@ def run(args) -> int:
     total_budget = sum(b for _, b in reqs)
 
     def serve():
-        eng = ContinuousBatcher(
-            params, cfg, slots=args.slots, pool_pages=pool_pages,
-            pages_per_seq=pages_per_seq, page_size=args.page_size,
-            chunk=args.chunk,
-            eos_id=args.eos_id if args.eos_id >= 0 else None,
-        )
-        ids = [eng.submit(p, b) for p, b in reqs]
+        # constructor/submit ValueErrors (bad gamma, int8+draft, vocab
+        # mismatch, oversize request) keep the clean ERROR/FAILURE
+        # contract too, not just run()'s RuntimeError
         try:
+            eng = ContinuousBatcher(
+                params, cfg, slots=args.slots, pool_pages=pool_pages,
+                pages_per_seq=pages_per_seq, page_size=args.page_size,
+                chunk=args.chunk,
+                eos_id=args.eos_id if args.eos_id >= 0 else None,
+                draft_params=draft_params, draft_cfg=draft_cfg,
+                gamma=args.gamma,
+            )
+            ids = [eng.submit(p, b) for p, b in reqs]
             got = eng.run()
-        except RuntimeError as e:
+        except (ValueError, RuntimeError) as e:
             return None, str(e)
         return {i: got[sid] for i, sid in enumerate(ids)}, None
 

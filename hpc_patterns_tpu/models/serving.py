@@ -102,6 +102,29 @@ def _chunk_step(params, cache, pos, limit, tokens, *, cfg, chunk,
     return cache, pos, limit, tokens, out
 
 
+@partial(jax.jit, static_argnames=("cfg", "dcfg", "gamma"),
+         donate_argnums=(2, 3))
+def _spec_round(params, dparams, cache, dcache, pos, limit, cur, *,
+                cfg, dcfg, gamma):
+    """One draft-assisted serving round (greedy): THE shared
+    speculative round body (models/speculative.paged_round — one
+    acceptance/emit definition for the engine and
+    speculative_generate_batched) at each row's own cursor; per-row
+    advances of 1..gamma+1 tokens per dispatch. Rows past their limit
+    run at a clamped cursor (their garbage lands in pages they own or
+    the trash page). Returns (cache, dcache, a, emit) — the HOST
+    applies budget/EOS truncation and admission, which is what makes
+    over-acceptance past a row's budget safe to discard."""
+    from hpc_patterns_tpu.models.speculative import paged_round
+
+    active = pos < limit
+    pos_eff = jnp.where(active, pos, 0)
+    cache, dcache, a, emit, _ = paged_round(
+        params, cfg, dparams, dcfg, cache, dcache, pos_eff, cur,
+        gamma, jax.random.PRNGKey(0), True, 0, jnp.float32(1.0))
+    return cache, dcache, a, emit
+
+
 @partial(jax.jit, static_argnames=("cfg", "page_size", "mesh"),
          donate_argnums=(2,))
 def _prefill_one(params, prompt, cache_one, *, cfg, page_size, mesh):
@@ -129,10 +152,34 @@ class ContinuousBatcher:
 
     def __init__(self, params, cfg: TransformerConfig, *, slots: int,
                  pool_pages: int, pages_per_seq: int, page_size: int,
-                 chunk: int = 8, eos_id: int | None = None, mesh=None):
+                 chunk: int = 8, eos_id: int | None = None, mesh=None,
+                 draft_params=None, draft_cfg: TransformerConfig | None
+                 = None, gamma: int = 4):
         if cfg.n_experts:
             # paged serving is dense-model territory so far
             raise ValueError("continuous batching: dense models only")
+        if draft_params is not None:
+            if draft_cfg is None:
+                raise ValueError("draft_params needs draft_cfg")
+            if draft_cfg.vocab != cfg.vocab:
+                raise ValueError("draft/target vocab mismatch")
+            if mesh is not None:
+                raise ValueError(
+                    "draft-assisted serving is single-device for now "
+                    "(the ragged paged extend is unsharded)")
+            if cfg.kv_cache_dtype != "compute" or (
+                    draft_cfg.kv_cache_dtype != "compute"):
+                raise ValueError(
+                    "draft-assisted serving needs compute-dtype caches "
+                    "(the paged extend is compute-dtype)")
+            if gamma < 1:
+                raise ValueError(f"gamma must be >= 1, got {gamma}")
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.gamma = gamma
+        # speculative rounds touch positions up to pos+gamma; the page
+        # allocation (NOT max_seq) must cover the overshoot
+        self.spec_slack = gamma + 1 if draft_params is not None else 0
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -147,6 +194,14 @@ class ContinuousBatcher:
             cfg, slots, pages_per_seq, page_size,
             pool_pages=pool_pages + 1, table=jnp.asarray(table),
         )
+        if draft_params is not None:
+            # the draft pool mirrors the target's page geometry and
+            # SHARES the page table (one allocation decision serves
+            # both caches)
+            self.dcache = init_paged_cache(
+                draft_cfg, slots, pages_per_seq, page_size,
+                pool_pages=pool_pages + 1, table=jnp.asarray(table),
+            )
         self.free_pages = list(range(pool_pages))
         self._table = table  # host mirror
         self.pos = jnp.zeros((slots,), jnp.int32)
@@ -159,6 +214,21 @@ class ContinuousBatcher:
 
     # -- admission ---------------------------------------------------------
 
+    @staticmethod
+    def pages_needed(prompt_len: int, max_new: int, page_size: int, *,
+                     gamma: int | None = None) -> int:
+        """Pages one request holds in this engine: prompt + budget,
+        plus the speculative overshoot slack (gamma+1) when a draft
+        serves — THE sizing rule; callers building their own pools
+        (serve_app) must use it rather than re-deriving the slack."""
+        slack = (gamma + 1) if gamma is not None else 0
+        return -(-(prompt_len + max_new + slack) // page_size)
+
+    def _pages_for(self, prompt_len: int, max_new: int) -> int:
+        return self.pages_needed(
+            prompt_len, max_new, self.page_size,
+            gamma=self.gamma if self.draft_params is not None else None)
+
     def submit(self, prompt, max_new: int, seq_id: int | None = None) -> int:
         """Enqueue a sequence; returns its id. Tokens appear in
         ``finished[id]`` once served."""
@@ -167,11 +237,12 @@ class ContinuousBatcher:
             raise ValueError(f"prompt must be 1-D nonempty, {prompt.shape}")
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
-        need = -(-(prompt.size + max_new) // self.page_size)
+        need = self._pages_for(prompt.size, max_new)
         if need > self.pages_per_seq:
             raise ValueError(
-                f"prompt {prompt.size} + budget {max_new} needs {need} "
-                f"pages > pages_per_seq {self.pages_per_seq}"
+                f"prompt {prompt.size} + budget {max_new} (+ spec "
+                f"slack {self.spec_slack}) needs {need} pages > "
+                f"pages_per_seq {self.pages_per_seq}"
             )
         if prompt.size + max_new > self.cfg.max_seq:
             raise ValueError(
@@ -202,7 +273,7 @@ class ContinuousBatcher:
         if free_slot is None:
             return False
         for qi, req in enumerate(self._queue):
-            need = -(-(req.prompt.size + req.max_new) // self.page_size)
+            need = self._pages_for(req.prompt.size, req.max_new)
             if need <= len(self.free_pages):
                 self._queue.pop(qi)
                 self._admit(free_slot, req, need)
@@ -232,6 +303,18 @@ class ContinuousBatcher:
         for k, v in out.items():
             if k != "table":
                 self.cache[k] = v
+        if self.draft_params is not None:
+            self.dcache["table"] = jnp.asarray(self._table)
+            done = dict(self.dcache)
+            done["table"] = jnp.asarray(self._table[slot:slot + 1])
+            _, dout = _prefill_one(
+                self.draft_params, jnp.asarray(req.prompt)[None, :],
+                done, cfg=self.draft_cfg, page_size=self.page_size,
+                mesh=None,
+            )
+            for k, v in dout.items():
+                if k != "table":
+                    self.dcache[k] = v
         first = int(jnp.argmax(logits[0]))
         st = self._slots[slot]
         st.seq_id, st.pages, st.prompt_len = req.seq_id, pages, T
@@ -252,6 +335,8 @@ class ContinuousBatcher:
         self.free_pages.extend(st.pages)
         self._table[slot] = self.trash
         self.cache["table"] = jnp.asarray(self._table)
+        if self.draft_params is not None:
+            self.dcache["table"] = jnp.asarray(self._table)
         self._slots[slot] = _Slot()
         self.pos = self.pos.at[slot].set(0)
         self.limit = self.limit.at[slot].set(0)
@@ -276,6 +361,39 @@ class ContinuousBatcher:
             if pos_start[i] + valid >= limit_new[i]:
                 self._finish(i)
 
+    def _run_spec_round(self):
+        """One draft-assisted round: per-row advances of 1..gamma+1
+        tokens per dispatch. The HOST truncates acceptance at each
+        row's budget (over-acceptance beyond the limit is discarded —
+        the caches' stale rows get overwritten when the cursor
+        re-crosses them, the speculative invariant) and applies EOS."""
+        pos_start = np.asarray(self.pos)
+        limit_np = np.asarray(self.limit)
+        self.cache, self.dcache, a, emit = _spec_round(
+            self.params, self.draft_params, self.cache, self.dcache,
+            self.pos, self.limit, self.tokens,
+            cfg=self.cfg, dcfg=self.draft_cfg, gamma=self.gamma,
+        )
+        a = np.asarray(a)
+        emit = np.asarray(emit)  # (slots, gamma+1)
+        for i, st in enumerate(self._slots):
+            if not st.active:
+                continue
+            valid = int(min(a[i] + 1, limit_np[i] - pos_start[i]))
+            toks = [int(t) for t in emit[i, :valid]]
+            if self.eos_id >= 0 and self.eos_id in toks:
+                toks = toks[:toks.index(self.eos_id) + 1]
+            st.out.extend(toks)
+            new_pos = int(pos_start[i]) + len(toks)
+            done = (new_pos >= limit_np[i]
+                    or (self.eos_id >= 0 and toks
+                        and toks[-1] == self.eos_id))
+            if done:
+                self._finish(i)
+            else:
+                self.pos = self.pos.at[i].set(new_pos)
+                self.tokens = self.tokens.at[i].set(toks[-1])
+
     def run(self):
         """Serve until queue and slots drain. Returns ``finished``:
         {seq_id: np.ndarray of emitted tokens (<= max_new; ends at
@@ -291,5 +409,8 @@ class ContinuousBatcher:
                         "smallest waiting request)"
                     )
                 break
-            self._run_chunk()
+            if self.draft_params is not None:
+                self._run_spec_round()
+            else:
+                self._run_chunk()
         return self.finished

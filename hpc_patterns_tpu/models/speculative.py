@@ -249,6 +249,58 @@ def speculative_generate(params, cfg: TransformerConfig, draft_params,
                             temperature, mesh)
 
 
+def paged_round(params, cfg, draft_params, draft_cfg, cache, dcache,
+                pos_eff, cur, gamma: int, key, greedy: bool,
+                top_k: int, temperature):
+    """ONE batched draft/verify round on the ragged paged caches — THE
+    shared speculative round body (``_speculative_batched_ragged_jit``
+    and the serving engine's draft-assisted rounds both call it; an
+    acceptance/emit fix lands in both or neither).
+
+    The draft runs gamma+1 ragged steps from each row's own cursor
+    (the extra one writes the last proposal's K/V, the cache
+    invariant); the target verifies ``[cur, props]`` in one ragged
+    paged extend; acceptance is greedy-exact or rejection-sampling per
+    row. Returns ``(cache, dcache, a, emit, key)``: per-row
+    accepted-prefix lengths (B,) and the round's tokens
+    (B, gamma+1) — positions > a are filler the caller masks."""
+    B = pos_eff.shape[0]
+    props = []
+    qs = []
+    tok = cur
+    dc = dcache
+    for j in range(gamma + 1):
+        dlogits, dc = paged_decode_step(draft_params, dc, pos_eff + j,
+                                        tok, draft_cfg)
+        key, sub = jax.random.split(key)
+        tok = _pick(dlogits, sub, temperature, greedy, top_k)
+        if j < gamma:
+            props.append(tok)
+            if not greedy:
+                qs.append(_warp(dlogits, temperature, top_k))
+    props = jnp.stack(props, axis=1)  # (B, gamma)
+
+    chunk = jnp.concatenate([cur[:, None], props], axis=1)
+    vlogits, cache = paged_extend_step(params, cache, pos_eff, chunk,
+                                       cfg)
+    if greedy:
+        t_all = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+        matches = (props == t_all[:, :gamma]).astype(jnp.int32)
+        a = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)  # (B,)
+        nxt = t_all[jnp.arange(B), a]
+    else:
+        key, sub = jax.random.split(key)
+        a, nxt = jax.vmap(_accept_resample)(
+            jax.random.split(sub, B), props,
+            jnp.stack(qs, axis=1),
+            _warp(vlogits, temperature, top_k),
+        )
+    props_padded = jnp.concatenate([props, props[:, -1:]], axis=1)
+    emit = jnp.where(jnp.arange(gamma + 1)[None, :] < a[:, None],
+                     props_padded, nxt[:, None])
+    return cache, dc, a, emit, key
+
+
 @partial(jax.jit, static_argnums=(1, 3, 5, 6, 8, 9))
 def _speculative_batched_ragged_jit(params, cfg, draft_params, draft_cfg,
                                     prompts, new_tokens, gamma, key,
@@ -294,45 +346,12 @@ def _speculative_batched_ragged_jit(params, cfg, draft_params, draft_cfg,
         # their page allocation; their garbage lands in pages they own
         pos_eff = jnp.where(active, pos, 0)
 
-        # --- draft: gamma proposals per row (gamma+1 steps; the extra
-        # one writes the last proposal's K/V, the shared invariant)
-        props = []
-        qs = []
-        tok = cur
-        dc = dcache
-        for j in range(gamma + 1):
-            dlogits, dc = paged_decode_step(draft_params, dc,
-                                            pos_eff + j, tok, draft_cfg)
-            key, sub = jax.random.split(key)
-            tok = _pick(dlogits, sub, temperature, greedy, top_k)
-            if j < gamma:
-                props.append(tok)
-                if not greedy:
-                    qs.append(_warp(dlogits, temperature, top_k))
-        props = jnp.stack(props, axis=1)  # (B, gamma)
-
-        # --- target verifies [cur, props] in ONE ragged paged extend
-        chunk = jnp.concatenate([cur[:, None], props], axis=1)
-        vlogits, cache = paged_extend_step(params, cache, pos_eff,
-                                           chunk, cfg)
-
-        if greedy:
-            t_all = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
-            matches = (props == t_all[:, :gamma]).astype(jnp.int32)
-            a = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)  # (B,)
-            nxt = t_all[rows, a]
-        else:
-            key, sub = jax.random.split(key)
-            a, nxt = jax.vmap(_accept_resample)(
-                jax.random.split(sub, B), props,
-                jnp.stack(qs, axis=1),
-                _warp(vlogits, temperature, top_k),
-            )
+        cache, dc, a, emit, key = paged_round(
+            params, cfg, draft_params, draft_cfg, cache, dcache,
+            pos_eff, cur, gamma, key, greedy, top_k, temperature)
+        nxt = emit[rows, a]
         # emitted this round per row: props[:a], then nxt; frozen rows
         # re-write their existing slots (gather-old / where / scatter)
-        props_padded = jnp.concatenate([props, props[:, -1:]], axis=1)
-        emit = jnp.where(jnp.arange(gamma + 1)[None, :] < a[:, None],
-                         props_padded, nxt[:, None])
         idx = jnp.minimum(n_out[:, None] + jnp.arange(gamma + 1),
                           out.shape[1] - 1)
         old = out[rows[:, None], idx]

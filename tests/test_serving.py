@@ -119,6 +119,78 @@ class TestContinuousBatching:
             np.testing.assert_array_equal(
                 got[sid], _standalone(params, cfg, prompt, max_new))
 
+    @pytest.mark.parametrize("gamma", [2, 4])
+    def test_draft_assisted_matches_standalone(self, gamma):
+        # speculative decoding INSIDE the engine: the draft proposes,
+        # the target verifies per round, rows advance 1..gamma+1 tokens
+        # per dispatch at their own acceptance — and every sequence is
+        # STILL token-exact vs its standalone paged decode (greedy
+        # speculative == greedy target, the serving oracle)
+        from hpc_patterns_tpu.models.transformer import init_params as ip
+
+        cfg, params = _setup()
+        dcfg = TransformerConfig(**{**BASE, "d_model": 16, "d_ff": 32,
+                                    "n_layers": 1, "n_heads": 2})
+        dparams = ip(jax.random.PRNGKey(42), dcfg)
+        eng = ContinuousBatcher(params, cfg, slots=2, pool_pages=8,
+                                pages_per_seq=4, page_size=8,
+                                draft_params=dparams, draft_cfg=dcfg,
+                                gamma=gamma)
+        reqs = _requests(cfg, 5, seed=11)
+        ids = [eng.submit(p, m) for p, m in reqs]
+        got = eng.run()
+        for sid, (prompt, max_new) in zip(ids, reqs):
+            np.testing.assert_array_equal(
+                got[sid], _standalone(params, cfg, prompt, max_new),
+                err_msg=f"seq {sid} gamma={gamma}")
+        assert sorted(eng.free_pages) == list(range(8))
+
+    def test_draft_assisted_self_draft_accepts_everything(self):
+        # target drafting for itself: every proposal accepted, rows
+        # advance gamma+1 per round, output still exact
+        cfg, params = _setup()
+        eng = ContinuousBatcher(params, cfg, slots=2, pool_pages=8,
+                                pages_per_seq=4, page_size=8,
+                                draft_params=params, draft_cfg=cfg,
+                                gamma=3)
+        prompt = np.arange(5, dtype=np.int32)
+        sid = eng.submit(prompt, 9)
+        got = eng.run()[sid]
+        np.testing.assert_array_equal(
+            got, _standalone(params, cfg, prompt, 9))
+
+    def test_draft_assisted_eos(self):
+        cfg, params = _setup()
+        prompt = np.arange(5, dtype=np.int32)
+        full = _standalone(params, cfg, prompt, 9)
+        eos = int(full[3])
+        first = int(np.argmax(full == eos))
+        eng = ContinuousBatcher(params, cfg, slots=1, pool_pages=4,
+                                pages_per_seq=4, page_size=8,
+                                draft_params=params, draft_cfg=cfg,
+                                gamma=2, eos_id=eos)
+        sid = eng.submit(prompt, 9)
+        got = eng.run()[sid]
+        np.testing.assert_array_equal(got, full[:first + 1])
+
+    def test_draft_guards(self):
+        cfg, params = _setup()
+        dcfg = TransformerConfig(**{**BASE, "d_model": 16, "d_ff": 32,
+                                    "n_layers": 1, "n_heads": 2})
+        from hpc_patterns_tpu.models.transformer import init_params as ip
+
+        dparams = ip(jax.random.PRNGKey(42), dcfg)
+        with pytest.raises(ValueError, match="draft_cfg"):
+            ContinuousBatcher(params, cfg, slots=1, pool_pages=3,
+                              pages_per_seq=3, page_size=8,
+                              draft_params=dparams)
+        qcfg = TransformerConfig(**{**BASE, "kv_cache_dtype": "int8"})
+        with pytest.raises(ValueError, match="compute"):
+            ContinuousBatcher(ip(jax.random.PRNGKey(0), qcfg), qcfg,
+                              slots=1, pool_pages=3, pages_per_seq=3,
+                              page_size=8, draft_params=dparams,
+                              draft_cfg=dcfg)
+
     def test_guards(self):
         cfg, params = _setup()
         eng = ContinuousBatcher(params, cfg, slots=1, pool_pages=2,
