@@ -960,16 +960,17 @@ def paged_extend_step(params, cache, pos, tokens, cfg: TransformerConfig):
     the gather form over the table-linearized pools — a c-row query
     block against the live prefix is MXU territory, exactly
     :func:`extend_step`'s reasoning, with per-row causal masks
-    ``row <= pos[b]+i``. Compute-dtype pools only (like extend_step).
+    ``row <= pos[b]+i``. int8 pools compose: chunk rows quantize
+    per-row like :func:`paged_decode_step`'s writes, and the gather
+    dequantizes the linearized view (unlike linear
+    :func:`extend_step`, which stays compute-only).
     Returns (logits (B, c, vocab) f32, updated cache).
 
     CONTRACT (same as :func:`paged_decode_step`): every touched
     position < pages_per_seq * page_size; concrete ``pos`` is checked,
     traced ``pos`` clamps silently past capacity.
     """
-    if cfg.kv_cache_dtype != "compute":
-        raise ValueError(
-            "paged_extend_step supports compute-dtype pools only")
+    int8 = cfg.kv_cache_dtype == "int8"
     dt = jnp.dtype(cfg.dtype)
     B, c = tokens.shape
     if jnp.ndim(pos) != 1 or jnp.shape(pos)[0] != B:
@@ -996,51 +997,79 @@ def paged_extend_step(params, cache, pos, tokens, cfg: TransformerConfig):
     off = (positions % Pg).reshape(-1)  # (B*c,)
     pids = jnp.take_along_axis(table, page, axis=1).reshape(-1)
 
-    def body(h, lp, k_pool, v_pool):
+    def lin_view(pool):
+        # table-linearized view: (B, Hkv, pages*Pg, D) — the extend
+        # reads the whole live prefix once, gather-form
+        return jnp.einsum("bphsd->bhpsd", pool[table]).reshape(
+            B, Hkv, pages * Pg, Dh)
+
+    def lin_scales(spool):
+        # (pool, Hkv, 1, Pg) lane-major -> (B, Hkv, pages*Pg)
+        return jnp.einsum("bphls->bhpls", spool[table]).reshape(
+            B, Hkv, pages * Pg)
+
+    def body(h, lp, state):
+        k_pool, v_pool, ks_pool, vs_pool = state
         hn = _rmsnorm(h, lp["ln1_scale"])
         q, k_new, v_new = project_qkv(hn, lp, cfg)  # (B, c, H/Hkv, Dh)
         if cfg.pos_embed == "rope":
             q = apply_rope(q, positions, cfg)
             k_new = apply_rope(k_new, positions, cfg)
-        rows_k = k_new.reshape(B * c, Hkv, Dh).astype(k_pool.dtype)
-        rows_v = v_new.reshape(B * c, Hkv, Dh).astype(v_pool.dtype)
-        k_pool = k_pool.at[pids, :, off, :].set(rows_k)
-        v_pool = v_pool.at[pids, :, off, :].set(rows_v)
-        # table-linearized view: (B, Hkv, pages*Pg, D) — the extend
-        # reads the whole live prefix once, gather-form
-        k_lin = jnp.einsum("bphsd->bhpsd", k_pool[table]).reshape(
-            B, Hkv, pages * Pg, Dh)
-        v_lin = jnp.einsum("bphsd->bhpsd", v_pool[table]).reshape(
-            B, Hkv, pages * Pg, Dh)
+        rows_k = k_new.reshape(B * c, Hkv, Dh)
+        rows_v = v_new.reshape(B * c, Hkv, Dh)
+        if int8:
+            rows_k, k_s = _quantize_rows(rows_k)
+            rows_v, v_s = _quantize_rows(rows_v)
+            ks_pool = ks_pool.at[pids, :, 0, off].set(k_s)
+            vs_pool = vs_pool.at[pids, :, 0, off].set(v_s)
+        k_pool = k_pool.at[pids, :, off, :].set(
+            rows_k.astype(k_pool.dtype))
+        v_pool = v_pool.at[pids, :, off, :].set(
+            rows_v.astype(v_pool.dtype))
+        if int8:
+            kd = (lin_view(k_pool).astype(jnp.float32)
+                  * lin_scales(ks_pool)[..., None])
+            vd = (lin_view(v_pool).astype(jnp.float32)
+                  * lin_scales(vs_pool)[..., None])
+        else:
+            kd = lin_view(k_pool).astype(jnp.float32)
+            vd = lin_view(v_pool).astype(jnp.float32)
         qg = q.reshape(B, c, Hkv, g, Dh)
         s = jnp.einsum(
-            "bckgd,bksd->bkgcs", qg.astype(jnp.float32),
-            k_lin.astype(jnp.float32),
+            "bckgd,bksd->bkgcs", qg.astype(jnp.float32), kd,
             precision=lax.Precision.HIGHEST,
         ) * scale
         row_pos = lax.broadcasted_iota(jnp.int32, s.shape, 4)
         q_pos = positions[:, None, None, :, None]  # (B,1,1,c,1)
         s = jnp.where(row_pos <= q_pos, s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bkgcs,bksd->bckgd", p,
-                       v_lin.astype(jnp.float32),
+        o = jnp.einsum("bkgcs,bksd->bckgd", p, vd,
                        precision=lax.Precision.HIGHEST)
         o = jnp.dot(o.reshape(B, c, cfg.d_model).astype(dt),
                     lp["wo"].astype(dt))
         h = _mlp(h + o, lp, cfg)
-        return h, (k_pool, v_pool)
+        return h, (k_pool, v_pool, ks_pool, vs_pool)
 
-    ks, vs = [], []
+    states = []
     for l in range(cfg.n_layers):
         lp = jax.tree.map(lambda a: a[l], params["layers"])
-        x, (k_l, v_l) = body(x, lp, cache["k"][l], cache["v"][l])
-        ks.append(k_l)
-        vs.append(v_l)
+        x, st = body(x, lp, (
+            cache["k"][l], cache["v"][l],
+            cache["k_scale"][l] if int8 else None,
+            cache["v_scale"][l] if int8 else None,
+        ))
+        states.append(st)
     x = _rmsnorm(x, params["ln_f_scale"])
     logits = jnp.dot(x, params["lm_head"].astype(dt))
-    return logits.astype(jnp.float32), {
-        "k": tuple(ks), "v": tuple(vs), "table": table,
+    out = {
+        "k": tuple(s[0] for s in states),
+        "v": tuple(s[1] for s in states),
+        "table": table,
     }
+    if int8:
+        out["k_scale"] = tuple(s[2] for s in states)
+        out["v_scale"] = tuple(s[3] for s in states)
+    return logits.astype(jnp.float32), out
 
 
 @partial(jax.jit, static_argnums=(2, 3, 4, 5, 8, 9, 10))

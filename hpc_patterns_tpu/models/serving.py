@@ -167,11 +167,6 @@ class ContinuousBatcher:
                 raise ValueError(
                     "draft-assisted serving is single-device for now "
                     "(the ragged paged extend is unsharded)")
-            if cfg.kv_cache_dtype != "compute" or (
-                    draft_cfg.kv_cache_dtype != "compute"):
-                raise ValueError(
-                    "draft-assisted serving needs compute-dtype caches "
-                    "(the paged extend is compute-dtype)")
             if gamma < 1:
                 raise ValueError(f"gamma must be >= 1, got {gamma}")
         self.draft_params = draft_params

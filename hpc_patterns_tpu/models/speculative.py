@@ -399,10 +399,6 @@ def speculative_generate_batched(params, cfg: TransformerConfig,
         cfg, temperature, top_k, key
     )
     if impl == "ragged":
-        if cfg.kv_cache_dtype != "compute":
-            raise ValueError(
-                "impl='ragged' needs compute-dtype caches (the paged "
-                "extend is compute-dtype; use impl='vmap' for int8)")
         return _speculative_batched_ragged_jit(
             params, cfg, draft_params, draft_cfg, prompts, new_tokens,
             gamma, key, greedy, top_k, temperature)

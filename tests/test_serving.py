@@ -173,6 +173,27 @@ class TestContinuousBatching:
         got = eng.run()[sid]
         np.testing.assert_array_equal(got, full[:first + 1])
 
+    def test_draft_assisted_int8_matches_standalone(self):
+        # all three serving levers at once: draft-assisted rounds over
+        # int8 page pools — still token-exact vs standalone int8 paged
+        from hpc_patterns_tpu.models.transformer import init_params as ip
+
+        cfg, params = _setup(kv_cache_dtype="int8")
+        dcfg = TransformerConfig(**{**BASE, "d_model": 16, "d_ff": 32,
+                                    "n_layers": 1, "n_heads": 2,
+                                    "kv_cache_dtype": "int8"})
+        dparams = ip(jax.random.PRNGKey(42), dcfg)
+        eng = ContinuousBatcher(params, cfg, slots=2, pool_pages=8,
+                                pages_per_seq=4, page_size=8,
+                                draft_params=dparams, draft_cfg=dcfg,
+                                gamma=2)
+        reqs = _requests(cfg, 4, seed=13)
+        ids = [eng.submit(p, m) for p, m in reqs]
+        got = eng.run()
+        for sid, (prompt, max_new) in zip(ids, reqs):
+            np.testing.assert_array_equal(
+                got[sid], _standalone(params, cfg, prompt, max_new))
+
     def test_draft_guards(self):
         cfg, params = _setup()
         dcfg = TransformerConfig(**{**BASE, "d_model": 16, "d_ff": 32,
@@ -184,12 +205,6 @@ class TestContinuousBatching:
             ContinuousBatcher(params, cfg, slots=1, pool_pages=3,
                               pages_per_seq=3, page_size=8,
                               draft_params=dparams)
-        qcfg = TransformerConfig(**{**BASE, "kv_cache_dtype": "int8"})
-        with pytest.raises(ValueError, match="compute"):
-            ContinuousBatcher(ip(jax.random.PRNGKey(0), qcfg), qcfg,
-                              slots=1, pool_pages=3, pages_per_seq=3,
-                              page_size=8, draft_params=dparams,
-                              draft_cfg=dcfg)
 
     def test_guards(self):
         cfg, params = _setup()
