@@ -142,12 +142,20 @@ class ContinuousBatcher:
 
     ``pool_pages``: the shared arena size (pages; one extra trash page
     is appended internally). ``pages_per_seq``: table width = the max
-    pages any single sequence may hold. ``chunk``: decode steps per
-    jitted dispatch — admission/eviction happen at chunk boundaries
-    (larger amortizes host+dispatch; 1 = immediate). Greedy decoding
-    (the serving oracle); ``eos_id`` optionally ends rows early.
-    ``mesh``: tp-sharded serving — pools/kernel shard exactly like
+    pages any single sequence may hold (size requests with
+    :meth:`pages_needed`). ``chunk``: decode steps per jitted dispatch
+    — admission/eviction happen at chunk boundaries (larger amortizes
+    host+dispatch; 1 = immediate). Greedy decoding (the serving
+    oracle); ``eos_id`` optionally ends rows early. ``mesh``:
+    tp-sharded serving — pools/kernel shard exactly like
     ``paged_generate(..., mesh=...)``.
+
+    ``draft_params``/``draft_cfg``/``gamma``: draft-assisted serving —
+    each dispatch becomes one speculative ROUND (draft proposes gamma,
+    target verifies in one ragged extend; rows advance 1..gamma+1
+    tokens at their own acceptance). ``chunk`` is unused in this mode:
+    the round IS the dispatch unit, and admission/eviction happen at
+    round boundaries. Single-device (no ``mesh``) for now.
     """
 
     def __init__(self, params, cfg: TransformerConfig, *, slots: int,

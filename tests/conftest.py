@@ -28,8 +28,31 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 # persistent XLA compile cache: shard_map compiles dominate suite wall
-# time; warm reruns skip them entirely (first/cold run is unchanged)
-_cache_dir = Path(__file__).resolve().parent.parent / ".cache" / "jax"
+# time; warm reruns skip them entirely (first/cold run is unchanged).
+# The directory is keyed by the HOST CPU's feature set: XLA:CPU loads
+# AOT cache entries compiled on a different machine with only a
+# warning ("could lead to execution errors such as SIGILL"), and a
+# stale cross-machine cache did exactly that — reproducible SIGABRTs
+# mid-suite (round 5; fresh cache = 18/18 green on the same tests).
+
+
+def _cpu_fingerprint() -> str:
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    import hashlib
+
+                    return hashlib.sha1(line.encode()).hexdigest()[:12]
+    except OSError:
+        pass
+    import platform
+
+    return platform.machine() or "unknown"
+
+
+_cache_dir = (Path(__file__).resolve().parent.parent / ".cache"
+              / f"jax-{_cpu_fingerprint()}")
 jax.config.update("jax_compilation_cache_dir", str(_cache_dir))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 

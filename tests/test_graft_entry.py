@@ -22,17 +22,29 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 @pytest.mark.slow
 def test_dryrun_runs_flagship_paths(capfd):
+    import jax
+
     import __graft_entry__ as g
 
-    with warnings.catch_warnings():
-        # any degraded-path telemetry warning fails the dry run
-        warnings.filterwarnings("error", message=".*routing runs replicated.*")
-        warnings.filterwarnings("error", message=".*falls back.*")
-        g.dryrun_multichip(8)
+    # the spmd partitioner only runs during COMPILATION — a warm
+    # persistent compile cache would skip it and the stderr assert
+    # below would pass vacuously against an empty stream. Force cold
+    # compiles: drop the persistent cache for this test and clear the
+    # in-memory executable caches, so partitioning provably happened.
+    old_cache = jax.config.jax_compilation_cache_dir
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        jax.clear_caches()
+        with warnings.catch_warnings():
+            # any degraded-path telemetry warning fails the dry run
+            warnings.filterwarnings(
+                "error", message=".*routing runs replicated.*")
+            warnings.filterwarnings("error", message=".*falls back.*")
+            g.dryrun_multichip(8)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old_cache)
 
     # the spmd partitioner logs involuntary full remats to stderr (C++
-    # absl logging); a clean flagship dry run has none. NOTE: a warm
-    # persistent compile cache skips partitioning, so this line only
-    # bites on cold compiles (CI cold runs and the driver's fresh run).
+    # absl logging); a clean flagship dry run has none
     err = capfd.readouterr().err
     assert "Involuntary full rematerialization" not in err
