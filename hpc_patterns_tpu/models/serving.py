@@ -102,10 +102,10 @@ def _chunk_step(params, cache, pos, limit, tokens, *, cfg, chunk,
     return cache, pos, limit, tokens, out
 
 
-@partial(jax.jit, static_argnames=("cfg", "dcfg", "gamma"),
+@partial(jax.jit, static_argnames=("cfg", "dcfg", "gamma", "mesh"),
          donate_argnums=(2, 3))
 def _spec_round(params, dparams, cache, dcache, pos, limit, cur, *,
-                cfg, dcfg, gamma):
+                cfg, dcfg, gamma, mesh=None):
     """One draft-assisted serving round (greedy): THE shared
     speculative round body (models/speculative.paged_round — one
     acceptance/emit definition for the engine and
@@ -121,7 +121,8 @@ def _spec_round(params, dparams, cache, dcache, pos, limit, cur, *,
     pos_eff = jnp.where(active, pos, 0)
     cache, dcache, a, emit, _ = paged_round(
         params, cfg, dparams, dcfg, cache, dcache, pos_eff, cur,
-        gamma, jax.random.PRNGKey(0), True, 0, jnp.float32(1.0))
+        gamma, jax.random.PRNGKey(0), True, 0, jnp.float32(1.0),
+        mesh=mesh)
     return cache, dcache, a, emit
 
 
@@ -155,7 +156,9 @@ class ContinuousBatcher:
     target verifies in one ragged extend; rows advance 1..gamma+1
     tokens at their own acceptance). ``chunk`` is unused in this mode:
     the round IS the dispatch unit, and admission/eviction happen at
-    round boundaries. Single-device (no ``mesh``) for now.
+    round boundaries. Composes with ``mesh``: draft steps ride the
+    shard_map paged-kernel route, the ragged extend partitions via
+    GSPMD (tp must divide BOTH models' kv_heads).
     """
 
     def __init__(self, params, cfg: TransformerConfig, *, slots: int,
@@ -171,10 +174,6 @@ class ContinuousBatcher:
                 raise ValueError("draft_params needs draft_cfg")
             if draft_cfg.vocab != cfg.vocab:
                 raise ValueError("draft/target vocab mismatch")
-            if mesh is not None:
-                raise ValueError(
-                    "draft-assisted serving is single-device for now "
-                    "(the ragged paged extend is unsharded)")
             if gamma < 1:
                 raise ValueError(f"gamma must be >= 1, got {gamma}")
         self.draft_params = draft_params
@@ -313,7 +312,7 @@ class ContinuousBatcher:
             _, dout = _prefill_one(
                 self.draft_params, jnp.asarray(req.prompt)[None, :],
                 done, cfg=self.draft_cfg, page_size=self.page_size,
-                mesh=None,
+                mesh=self.mesh,
             )
             for k, v in dout.items():
                 if k != "table":
@@ -376,6 +375,7 @@ class ContinuousBatcher:
             self.params, self.draft_params, self.cache, self.dcache,
             self.pos, self.limit, self.tokens,
             cfg=self.cfg, dcfg=self.draft_cfg, gamma=self.gamma,
+            mesh=self.mesh,
         )
         a = np.asarray(a)
         emit = np.asarray(emit)  # (slots, gamma+1)

@@ -251,7 +251,7 @@ def speculative_generate(params, cfg: TransformerConfig, draft_params,
 
 def paged_round(params, cfg, draft_params, draft_cfg, cache, dcache,
                 pos_eff, cur, gamma: int, key, greedy: bool,
-                top_k: int, temperature):
+                top_k: int, temperature, mesh=None):
     """ONE batched draft/verify round on the ragged paged caches — THE
     shared speculative round body (``_speculative_batched_ragged_jit``
     and the serving engine's draft-assisted rounds both call it; an
@@ -263,7 +263,12 @@ def paged_round(params, cfg, draft_params, draft_cfg, cache, dcache,
     paged extend; acceptance is greedy-exact or rejection-sampling per
     row. Returns ``(cache, dcache, a, emit, key)``: per-row
     accepted-prefix lengths (B,) and the round's tokens
-    (B, gamma+1) — positions > a are filler the caller masks."""
+    (B, gamma+1) — positions > a are filler the caller masks.
+
+    ``mesh``: tp-sharded rounds — the draft's ragged steps take the
+    shard_map paged-kernel route (kv-head blocks), while the ragged
+    extend is pure XLA scatter/gather/einsum math and partitions via
+    GSPMD from the sharded params/pools alone."""
     B = pos_eff.shape[0]
     props = []
     qs = []
@@ -271,7 +276,7 @@ def paged_round(params, cfg, draft_params, draft_cfg, cache, dcache,
     dc = dcache
     for j in range(gamma + 1):
         dlogits, dc = paged_decode_step(draft_params, dc, pos_eff + j,
-                                        tok, draft_cfg)
+                                        tok, draft_cfg, mesh=mesh)
         key, sub = jax.random.split(key)
         tok = _pick(dlogits, sub, temperature, greedy, top_k)
         if j < gamma:
@@ -301,10 +306,11 @@ def paged_round(params, cfg, draft_params, draft_cfg, cache, dcache,
     return cache, dc, a, emit, key
 
 
-@partial(jax.jit, static_argnums=(1, 3, 5, 6, 8, 9))
+@partial(jax.jit, static_argnums=(1, 3, 5, 6, 8, 9, 11))
 def _speculative_batched_ragged_jit(params, cfg, draft_params, draft_cfg,
                                     prompts, new_tokens, gamma, key,
-                                    greedy, top_k, temperature):
+                                    greedy, top_k, temperature,
+                                    mesh=None):
     """Per-row-progress batched speculative decoding on the ragged
     paged machinery: ONE batched draft/verify round per iteration,
     every row advancing at its OWN acceptance rate through per-row
@@ -322,9 +328,10 @@ def _speculative_batched_ragged_jit(params, cfg, draft_params, draft_cfg,
 
     cache = init_paged_cache(cfg, B, pages, page)
     dcache = init_paged_cache(draft_cfg, B, pages, page)
-    logits, cache = paged_prefill(params, prompts, cfg, cache, page)
+    logits, cache = paged_prefill(params, prompts, cfg, cache, page,
+                                  mesh=mesh)
     _, dcache = paged_prefill(draft_params, prompts, draft_cfg, dcache,
-                              page)
+                              page, mesh=mesh)
     if key is None:
         key = jax.random.PRNGKey(0)  # unused in greedy mode
     key, sub = jax.random.split(key)
@@ -348,7 +355,8 @@ def _speculative_batched_ragged_jit(params, cfg, draft_params, draft_cfg,
 
         cache, dc, a, emit, key = paged_round(
             params, cfg, draft_params, draft_cfg, cache, dcache,
-            pos_eff, cur, gamma, key, greedy, top_k, temperature)
+            pos_eff, cur, gamma, key, greedy, top_k, temperature,
+            mesh=mesh)
         nxt = emit[rows, a]
         # emitted this round per row: props[:a], then nxt; frozen rows
         # re-write their existing slots (gather-old / where / scatter)
@@ -372,7 +380,8 @@ def speculative_generate_batched(params, cfg: TransformerConfig,
                                  draft_cfg: TransformerConfig, prompts,
                                  new_tokens: int, *, gamma: int = 4,
                                  key=None, temperature: float = 0.0,
-                                 top_k: int = 0, impl: str = "ragged"):
+                                 top_k: int = 0, impl: str = "ragged",
+                                 mesh=None):
     """Batched speculative decoding, (B, new_tokens) int32.
 
     ``impl="ragged"`` (default): per-row-progress on the ragged paged
@@ -380,18 +389,20 @@ def speculative_generate_batched(params, cfg: TransformerConfig,
     per-row position cursors, each row advancing at its own acceptance
     rate (greedy output row-wise token-identical to
     :func:`speculative_generate`; sampling rows draw from the same law
-    but consume randomness differently than the vmap form).
+    but consume randomness differently than the vmap form). ``mesh``:
+    tp-sharded serving — draft steps ride the shard_map paged-kernel
+    route, the ragged extend partitions via GSPMD.
 
     ``impl="vmap"``: the round-3 form — ``jax.vmap`` over per-row
     loops (each lane's cache update lifts to a full-cache scatter;
     kept for comparison and for exact per-row key-fold reproducibility
-    with per-sequence sampling calls).
+    with per-sequence sampling calls). Single-device (vmap over the
+    shard_map route is not supported).
 
     Wall-clock note (both impls): the CALL returns when the slowest
     row finishes — that is batch semantics, not an impl property; for
     throughput past it, serve via models/serving.py's continuous
-    batching. Single-device (for tp-sharded serving use per-sequence
-    ``speculative_generate(..., mesh=...)``)."""
+    batching."""
     if prompts.ndim != 2:
         raise ValueError(f"prompts must be (B, T), got {prompts.shape}")
     _validate(cfg, draft_cfg, prompts.shape[1], new_tokens, gamma)
@@ -401,9 +412,13 @@ def speculative_generate_batched(params, cfg: TransformerConfig,
     if impl == "ragged":
         return _speculative_batched_ragged_jit(
             params, cfg, draft_params, draft_cfg, prompts, new_tokens,
-            gamma, key, greedy, top_k, temperature)
+            gamma, key, greedy, top_k, temperature, mesh)
     if impl != "vmap":
         raise ValueError(f"impl must be 'ragged' or 'vmap', got {impl!r}")
+    if mesh is not None:
+        raise ValueError(
+            "impl='vmap' is single-device (vmap over the shard_map "
+            "route is unsupported); use impl='ragged' with a mesh")
     # greedy mode still threads per-row keys through vmap (unused by the
     # accept path); split a fixed root so the dummies share the REAL
     # keys' dtype/format — raw uint32 zeros relied on the deprecated

@@ -591,6 +591,27 @@ class TestSpeculativeBatched:
         assert got.shape == (2, 8)
         assert got.min() >= 0 and got.max() < cfg.vocab
 
+    def test_batched_ragged_tp_matches_greedy(self, mesh_dp_sp_tp):
+        # the ragged impl under tp: draft steps ride the shard_map
+        # paged-kernel route, the ragged extend partitions via GSPMD —
+        # tokens must equal unsharded target greedy exactly
+        from hpc_patterns_tpu.models.sharding import shard_params
+        from hpc_patterns_tpu.models.speculative import (
+            speculative_generate_batched,
+        )
+
+        cfg, params, prompt = _setup(batch=2, n_heads=4, n_kv_heads=2)
+        dcfg = TransformerConfig(**{**BASE, "d_model": 16, "d_ff": 32,
+                                    "n_layers": 1, "n_heads": 2})
+        dparams = init_params(jax.random.PRNGKey(42), dcfg)
+        want = np.asarray(greedy_generate(params, prompt, cfg, 8))
+        p_sh = shard_params(params, mesh_dp_sp_tp, cfg)
+        d_sh = shard_params(dparams, mesh_dp_sp_tp, dcfg)
+        got = np.asarray(jax.device_get(speculative_generate_batched(
+            p_sh, cfg, d_sh, dcfg, prompt, 8, gamma=2,
+            mesh=mesh_dp_sp_tp)))
+        np.testing.assert_array_equal(got, want)
+
     def test_batched_ragged_int8_matches_greedy(self):
         # int8 pools through the ragged impl: the paged extend
         # quantizes chunk writes and dequantizes the gather, so the

@@ -194,6 +194,30 @@ class TestContinuousBatching:
             np.testing.assert_array_equal(
                 got[sid], _standalone(params, cfg, prompt, max_new))
 
+    def test_draft_assisted_tp_matches_standalone(self, mesh_dp_sp_tp):
+        # draft-assisted rounds under tp: the engine's pools shard on
+        # kv heads, draft kernel steps shard_map, the extend rides
+        # GSPMD — still token-exact vs unsharded standalone
+        from hpc_patterns_tpu.models.sharding import shard_params
+        from hpc_patterns_tpu.models.transformer import init_params as ip
+
+        cfg, params = _setup(n_heads=4)  # kv_heads 4, tp=2 divides
+        dcfg = TransformerConfig(**{**BASE, "d_model": 16, "d_ff": 32,
+                                    "n_layers": 1, "n_heads": 2})
+        dparams = ip(jax.random.PRNGKey(42), dcfg)
+        p_sh = shard_params(params, mesh_dp_sp_tp, cfg)
+        d_sh = shard_params(dparams, mesh_dp_sp_tp, dcfg)
+        eng = ContinuousBatcher(p_sh, cfg, slots=2, pool_pages=8,
+                                pages_per_seq=4, page_size=8,
+                                draft_params=d_sh, draft_cfg=dcfg,
+                                gamma=2, mesh=mesh_dp_sp_tp)
+        reqs = _requests(cfg, 3, seed=17)
+        ids = [eng.submit(p, m) for p, m in reqs]
+        got = eng.run()
+        for sid, (prompt, max_new) in zip(ids, reqs):
+            np.testing.assert_array_equal(
+                got[sid], _standalone(params, cfg, prompt, max_new))
+
     def test_draft_guards(self):
         cfg, params = _setup()
         dcfg = TransformerConfig(**{**BASE, "d_model": 16, "d_ff": 32,
