@@ -256,12 +256,19 @@ def check_tp(cfg: TransformerConfig, tp: int):
             "experts)"
         )
     for name, val in (("d_model", cfg.d_model), ("n_heads", cfg.n_heads),
-                      ("kv_heads", cfg.kv_heads), ("d_ff", cfg.d_ff)):
+                      ("kv_heads", cfg.kv_heads), ("d_ff", cfg.d_ff),
+                      ("vocab", cfg.vocab)):
         if val % tp:
             raise ValueError(
                 f"{name} {val} must divide by tp={tp} for Megatron "
                 "stage sharding"
             )
+    if cfg.loss_chunk:
+        raise ValueError(
+            "pp x tp shards the loss head over vocab (V/tp per rank) "
+            "instead of chunking it; drop loss_chunk (compose the two "
+            "if V/tp alone still doesn't fit)"
+        )
 
 
 def _loss_head(lp, y, target_tokens, *, loss_chunk: int = 0):
@@ -280,6 +287,63 @@ def _loss_head(lp, y, target_tokens, *, loss_chunk: int = 0):
         )
     logits = jnp.dot(x, lp["lm_head"].astype(y.dtype)).astype(jnp.float32)
     return masked_causal_nll(logits, target_tokens)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _tp_pmax_sg(x, axis):
+    """stop-gradient pmax over ``axis``: lax.pmax has no
+    differentiation rule at all (even a downstream stop_gradient
+    doesn't save the trace), and a logsumexp stability shift's
+    cotangent is identically zero anyway — so the backward is an
+    explicit zero."""
+    return lax.pmax(x, axis)
+
+
+def _tp_pmax_sg_fwd(x, axis):
+    return lax.pmax(x, axis), None
+
+
+def _tp_pmax_sg_bwd(axis, _, ct):
+    return (jnp.zeros_like(ct),)
+
+
+_tp_pmax_sg.defvjp(_tp_pmax_sg_fwd, _tp_pmax_sg_bwd)
+
+
+def _loss_head_tp(lp, y, target_tokens, *, axis_tp: str):
+    """Vocab-sharded pipeline loss head: the last stage's lm_head is
+    column-split over tp (each rank holds V/tp vocab columns — the
+    Megatron head), so per-rank logits are (b, T, V/tp) instead of the
+    full vocabulary replicated per tp rank, and the masked causal NLL
+    comes out of sharded-softmax reductions. The tp sums ride the g
+    operator (psum-fwd/identity-bwd — lax.psum's transpose under
+    check_vma=False would be wrong, same as the layer math) and the
+    stability max-shift is stop_gradient'd (exact: a logsumexp shift's
+    cotangent is identically zero). ``y`` enters through f so the
+    stage backward receives a REPLICATED cotangent (each rank only
+    computes the contribution through its own vocab columns).
+    Numerically masked_causal_nll at f32, oracle-tested."""
+    y = _tp_f(y, axis_tp)
+    x = _rmsnorm(y, lp["ln_f_scale"])
+    logits = jnp.dot(x, lp["lm_head"].astype(y.dtype)).astype(
+        jnp.float32)  # (b, T, V/tp)
+    B, T = target_tokens.shape
+    targets = jnp.roll(target_tokens, -1, axis=1)
+    v_loc = logits.shape[-1]
+    lo = lax.axis_index(axis_tp) * v_loc
+    m = _tp_pmax_sg(jnp.max(logits, axis=-1), axis_tp)
+    se = jnp.sum(jnp.exp(logits - m[..., None]), axis=-1)
+    logz = m + jnp.log(_tp_g(se, axis_tp))
+    t_loc = targets - lo
+    in_shard = (t_loc >= 0) & (t_loc < v_loc)
+    gold_local = jnp.take_along_axis(
+        logits, jnp.clip(t_loc, 0, v_loc - 1)[..., None], axis=-1
+    )[..., 0]
+    gold = _tp_g(jnp.where(in_shard, gold_local, 0.0), axis_tp)
+    nll = logz - gold
+    mask = (lax.broadcasted_iota(jnp.int32, (B, T), 1)
+            < T - 1).astype(nll.dtype)
+    return jnp.sum(nll * mask) / jnp.sum(mask)
 
 
 def _pp_layer_specs(cfg: TransformerConfig, axis_pp: str,
@@ -404,7 +468,8 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
             layers_full,
             x_mb,
             toks,
-            partial(_loss_head, loss_chunk=cfg.loss_chunk),
+            (partial(_loss_head_tp, axis_tp=axis_tp) if axis_tp
+             else partial(_loss_head, loss_chunk=cfg.loss_chunk)),
             axis_pp,
             loss_params=head,
             return_input_grads=True,
@@ -428,6 +493,13 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
             loss = loss + cfg.moe_aux_weight * aux_mean
         head_grads = jax.tree.map(lambda g: lax.psum(g, axis_pp),
                                   extras["loss_grads"])
+        if axis_tp:
+            # sharded-head grads: lm_head's shard is per-rank unique,
+            # but ln_f_scale is replicated over tp and each rank only
+            # computed the contribution through its own vocab columns
+            head_grads = dict(head_grads)
+            head_grads["ln_f_scale"] = lax.psum(
+                head_grads["ln_f_scale"], axis_tp)
         outer_grads = jax.tree.map(
             lambda g: lax.psum(
                 jnp.where(lax.axis_index(axis_pp) == 0, g.astype(jnp.float32),
@@ -484,12 +556,16 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
 
     batch_axes = tuple(a for a in (axis_dp, axis_fsdp) if a)
     tok_spec = P(batch_axes) if batch_axes else P()
+    # with tp the loss head is vocab-sharded (Megatron head): lm_head
+    # column-split over tp, final norm replicated
+    head_specs = ({"ln_f_scale": P(), "lm_head": P(None, axis_tp)}
+                  if axis_tp else P())
     loss_spec = (P((*batch_axes, axis_pp)) if batch_axes else P(axis_pp))
     loss_r, outer_g, layer_g, head_g = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(), layer_specs, P(), tok_spec),
-        out_specs=(loss_spec, P(), layer_specs, P()),
+        in_specs=(P(), layer_specs, head_specs, tok_spec),
+        out_specs=(loss_spec, P(), layer_specs, head_specs),
         check_vma=False,  # validity masks + psum-broadcasts aren't VMA-provable
     )(outer, layers_in, head, tokens)
     if axis_tp:

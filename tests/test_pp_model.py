@@ -20,6 +20,19 @@ CFG = dict(vocab=32, d_model=16, n_heads=2, n_layers=4, d_ff=32,
            max_seq=8, dtype="float32")
 
 
+def _pp_lg(params, tokens, cfg, mesh, **kw):
+    """pp_loss_and_grads under ONE jit — the production shape
+    (make_pp_train_step jits the whole step). Eagerly driving the
+    unrolled-1F1B shard_map dispatches hundreds of tiny multi-device
+    programs back to back, which intermittently SIGABRTs the XLA:CPU
+    runtime (a dispatch race: observed repeatedly mid-suite on the
+    8-device host mesh, never under jit). One eager test stays below
+    for the op-by-op path's coverage."""
+    return jax.jit(
+        lambda p, t: pplib.pp_loss_and_grads(p, t, cfg, mesh, **kw)
+    )(params, tokens)
+
+
 @pytest.fixture(scope="module")
 def setup():
     cfg = TransformerConfig(**CFG)
@@ -36,7 +49,7 @@ class TestPPModel:
     def test_pure_pp_matches_oracle(self, setup):
         cfg, params, tokens, want_loss, want_g = setup
         mesh = topology.make_mesh({"pp": 4}, jax.devices()[:4])
-        loss, grads = pplib.pp_loss_and_grads(
+        loss, grads = _pp_lg(
             params, tokens, cfg, mesh, microbatches=2
         )
         np.testing.assert_allclose(float(loss), want_loss, rtol=1e-5)
@@ -47,7 +60,7 @@ class TestPPModel:
     def test_dp_x_pp_matches_oracle(self, setup):
         cfg, params, tokens, want_loss, want_g = setup
         mesh = topology.make_mesh({"dp": 2, "pp": 2}, jax.devices()[:4])
-        loss, grads = pplib.pp_loss_and_grads(
+        loss, grads = _pp_lg(
             params, tokens, cfg, mesh, microbatches=2, axis_dp="dp"
         )
         np.testing.assert_allclose(float(loss), want_loss, rtol=1e-5)
@@ -72,7 +85,7 @@ class TestPPModel:
         # equal the single-device autodiff oracle
         cfg, params, tokens, want_loss, want_g = setup
         mesh = topology.make_mesh({"fsdp": 2, "pp": 2}, jax.devices()[:4])
-        loss, grads = pplib.pp_loss_and_grads(
+        loss, grads = _pp_lg(
             params, tokens, cfg, mesh, microbatches=2, axis_fsdp="fsdp"
         )
         np.testing.assert_allclose(float(loss), want_loss, rtol=1e-5)
@@ -86,7 +99,7 @@ class TestPPModel:
         cfg, params, tokens, want_loss, want_g = setup
         mesh = topology.make_mesh({"dp": 2, "fsdp": 2, "pp": 2},
                                   jax.devices()[:8])
-        loss, grads = pplib.pp_loss_and_grads(
+        loss, grads = _pp_lg(
             params, tokens, cfg, mesh, microbatches=1, axis_dp="dp",
             axis_fsdp="fsdp"
         )
@@ -131,7 +144,7 @@ class TestPPModel:
             lambda p: loss_fn(p, tokens, cfg)
         )(params)
         mesh = topology.make_mesh({"pp": 2}, jax.devices()[:2])
-        loss, grads = pplib.pp_loss_and_grads(
+        loss, grads = _pp_lg(
             params, tokens, cfg, mesh, microbatches=2
         )
         np.testing.assert_allclose(float(loss), float(want_loss),
@@ -147,7 +160,7 @@ class TestPPModel:
         # standard public layout) must equal single-device autodiff
         cfg, params, tokens, want_loss, want_g = setup
         mesh = topology.make_mesh({"pp": 2, "tp": 2}, jax.devices()[:4])
-        loss, grads = pplib.pp_loss_and_grads(
+        loss, grads = _pp_lg(
             params, tokens, cfg, mesh, microbatches=2, axis_tp="tp"
         )
         np.testing.assert_allclose(float(loss), want_loss, rtol=1e-5)
@@ -166,7 +179,7 @@ class TestPPModel:
         cfg, params, tokens, want_loss, want_g = setup
         mesh = topology.make_mesh({"dp": 2, "pp": 2, "tp": 2},
                                   jax.devices()[:8])
-        loss, grads = pplib.pp_loss_and_grads(
+        loss, grads = _pp_lg(
             params, tokens, cfg, mesh, microbatches=2, axis_dp="dp",
             axis_tp="tp",
         )
@@ -186,7 +199,7 @@ class TestPPModel:
             lambda p: loss_fn(p, tokens, cfg)
         )(params)
         mesh = topology.make_mesh({"pp": 2, "tp": 2}, jax.devices()[:4])
-        loss, grads = pplib.pp_loss_and_grads(
+        loss, grads = _pp_lg(
             params, tokens, cfg, mesh, microbatches=2, axis_tp="tp"
         )
         np.testing.assert_allclose(float(loss), float(want_loss),
@@ -202,7 +215,7 @@ class TestPPModel:
         cfg, params, tokens, want_loss, want_g = setup
         mesh = topology.make_mesh({"fsdp": 2, "pp": 2, "tp": 2},
                                   jax.devices()[:8])
-        loss, grads = pplib.pp_loss_and_grads(
+        loss, grads = _pp_lg(
             params, tokens, cfg, mesh, microbatches=2, axis_fsdp="fsdp",
             axis_tp="tp",
         )
@@ -218,12 +231,12 @@ class TestPPModel:
                                     "int32")
         mesh = topology.make_mesh({"pp": 2, "tp": 2}, jax.devices()[:4])
         with pytest.raises(ValueError, match="MoE"):
-            pplib.pp_loss_and_grads(params, tokens, cfg, mesh,
+            _pp_lg(params, tokens, cfg, mesh,
                                     microbatches=2, axis_tp="tp")
         bad = TransformerConfig(**{**CFG, "n_heads": 1})
         paramsb = init_params(jax.random.PRNGKey(0), bad)
         with pytest.raises(ValueError, match="divide"):
-            pplib.pp_loss_and_grads(paramsb, tokens, bad, mesh,
+            _pp_lg(paramsb, tokens, bad, mesh,
                                     microbatches=2, axis_tp="tp")
 
     def test_fused_mlp_pp_matches_oracle(self):
@@ -238,7 +251,7 @@ class TestPPModel:
             lambda p: loss_fn(p, tokens, dense)
         )(params)
         mesh = topology.make_mesh({"pp": 2}, jax.devices()[:2])
-        loss, grads = pplib.pp_loss_and_grads(
+        loss, grads = _pp_lg(
             params, tokens, cfg, mesh, microbatches=2
         )
         np.testing.assert_allclose(float(loss), float(want_loss),
@@ -258,7 +271,7 @@ class TestPPModel:
             lambda p: loss_fn(p, tokens, cfg)
         )(params)
         mesh = topology.make_mesh({"pp": 2}, jax.devices()[:2])
-        loss, grads = pplib.pp_loss_and_grads(
+        loss, grads = _pp_lg(
             params, tokens, cfg, mesh, microbatches=2
         )
         assert "pos_embed" not in grads
@@ -289,7 +302,7 @@ class TestPPModel:
         want_loss, want_g = jax.value_and_grad(oracle)(params)
         axes = {"dp": 2, "pp": 2} if dp else {"pp": 2}
         mesh = topology.make_mesh(axes, jax.devices()[:2 * dsize])
-        loss, grads = pplib.pp_loss_and_grads(
+        loss, grads = _pp_lg(
             params, tokens, cfg, mesh, microbatches=M, axis_dp=dp
         )
         np.testing.assert_allclose(float(loss), float(want_loss), rtol=1e-5)
@@ -306,7 +319,7 @@ class TestPPModel:
         cfg, params, tokens, want_loss, want_g = setup
         ccfg = TransformerConfig(**{**CFG, "loss_chunk": 8})
         mesh = topology.make_mesh({"pp": 4}, jax.devices()[:4])
-        loss, grads = pplib.pp_loss_and_grads(
+        loss, grads = _pp_lg(
             params, tokens, ccfg, mesh, microbatches=2
         )
         np.testing.assert_allclose(float(loss), want_loss, rtol=1e-5)
@@ -319,7 +332,7 @@ class TestPPModel:
         mesh = topology.make_mesh({"pp": 4}, jax.devices()[:4])
         bad = TransformerConfig(**{**CFG, "n_layers": 6})
         with pytest.raises(ValueError, match="divide"):
-            pplib.pp_loss_and_grads(
+            _pp_lg(
                 init_params(jax.random.PRNGKey(0), bad), tokens, bad, mesh,
                 microbatches=4,
             )
