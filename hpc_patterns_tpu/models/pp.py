@@ -256,19 +256,12 @@ def check_tp(cfg: TransformerConfig, tp: int):
             "experts)"
         )
     for name, val in (("d_model", cfg.d_model), ("n_heads", cfg.n_heads),
-                      ("kv_heads", cfg.kv_heads), ("d_ff", cfg.d_ff),
-                      ("vocab", cfg.vocab)):
+                      ("kv_heads", cfg.kv_heads), ("d_ff", cfg.d_ff)):
         if val % tp:
             raise ValueError(
                 f"{name} {val} must divide by tp={tp} for Megatron "
                 "stage sharding"
             )
-    if cfg.loss_chunk:
-        raise ValueError(
-            "pp x tp shards the loss head over vocab (V/tp per rank) "
-            "instead of chunking it; drop loss_chunk (compose the two "
-            "if V/tp alone still doesn't fit)"
-        )
 
 
 def _loss_head(lp, y, target_tokens, *, loss_chunk: int = 0):
@@ -333,13 +326,17 @@ def _loss_head_tp(lp, y, target_tokens, *, axis_tp: str):
     lo = lax.axis_index(axis_tp) * v_loc
     m = _tp_pmax_sg(jnp.max(logits, axis=-1), axis_tp)
     se = jnp.sum(jnp.exp(logits - m[..., None]), axis=-1)
-    logz = m + jnp.log(_tp_g(se, axis_tp))
     t_loc = targets - lo
     in_shard = (t_loc >= 0) & (t_loc < v_loc)
     gold_local = jnp.take_along_axis(
         logits, jnp.clip(t_loc, 0, v_loc - 1)[..., None], axis=-1
     )[..., 0]
-    gold = _tp_g(jnp.where(in_shard, gold_local, 0.0), axis_tp)
+    # one stacked psum for both reductions (se and the masked gold
+    # logit share the (B, T) shape; the pmax above must stay separate
+    # — se depends on m)
+    se, gold = _tp_g(
+        jnp.stack([se, jnp.where(in_shard, gold_local, 0.0)]), axis_tp)
+    logz = m + jnp.log(se)
     nll = logz - gold
     mask = (lax.broadcasted_iota(jnp.int32, (B, T), 1)
             < T - 1).astype(nll.dtype)
@@ -403,8 +400,11 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
     ``axis_tp``: the canonical large-model layout — tp innermost (ICI
     neighbors), stage weights column/row-split per models/sharding.py's
     rule table, activations replicated over tp, two psums per layer
-    (see the Megatron block above). The loss head runs replicated per
-    tp rank (vocab stays unsharded inside the pipeline); tokens are
+    (see the Megatron block above). The loss head is vocab-sharded too
+    (lm_head column-split over tp, V/tp logits per rank, sharded-
+    softmax NLL — :func:`_loss_head_tp`) whenever vocab divides by tp
+    and ``loss_chunk`` is off; otherwise it falls back to the
+    replicated head (chunked when ``loss_chunk`` is set). Tokens are
     shared across tp. MoE stages reject tp.
     """
     M = microbatches
@@ -420,6 +420,11 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
         axis_tp = None  # size-1 tp axis: plain stage math
     else:
         check_tp(cfg, tp)
+    # Megatron (vocab-sharded) loss head whenever it can serve;
+    # otherwise the replicated head stays available as the fallback
+    # (loss_chunk keeps its chunked form, and a vocab tp doesn't
+    # divide keeps full-vocab logits per rank)
+    shard_head = bool(axis_tp) and cfg.vocab % tp == 0 and not cfg.loss_chunk
     if B % (M * dp * fs):
         raise ValueError(
             f"batch {B} must divide by microbatches*dp*fsdp={M * dp * fs}"
@@ -468,7 +473,7 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
             layers_full,
             x_mb,
             toks,
-            (partial(_loss_head_tp, axis_tp=axis_tp) if axis_tp
+            (partial(_loss_head_tp, axis_tp=axis_tp) if shard_head
              else partial(_loss_head, loss_chunk=cfg.loss_chunk)),
             axis_pp,
             loss_params=head,
@@ -493,10 +498,12 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
             loss = loss + cfg.moe_aux_weight * aux_mean
         head_grads = jax.tree.map(lambda g: lax.psum(g, axis_pp),
                                   extras["loss_grads"])
-        if axis_tp:
+        if shard_head:
             # sharded-head grads: lm_head's shard is per-rank unique,
             # but ln_f_scale is replicated over tp and each rank only
-            # computed the contribution through its own vocab columns
+            # computed the contribution through its own vocab columns.
+            # (The replicated-head fallback needs neither: its grads
+            # are identical across tp ranks.)
             head_grads = dict(head_grads)
             head_grads["ln_f_scale"] = lax.psum(
                 head_grads["ln_f_scale"], axis_tp)
@@ -556,10 +563,10 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
 
     batch_axes = tuple(a for a in (axis_dp, axis_fsdp) if a)
     tok_spec = P(batch_axes) if batch_axes else P()
-    # with tp the loss head is vocab-sharded (Megatron head): lm_head
-    # column-split over tp, final norm replicated
+    # with the Megatron head, lm_head enters column-split over tp and
+    # the final norm replicated
     head_specs = ({"ln_f_scale": P(), "lm_head": P(None, axis_tp)}
-                  if axis_tp else P())
+                  if shard_head else P())
     loss_spec = (P((*batch_axes, axis_pp)) if batch_axes else P(axis_pp))
     loss_r, outer_g, layer_g, head_g = jax.shard_map(
         local,

@@ -224,6 +224,30 @@ class TestPPModel:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-5)
 
+    @pytest.mark.parametrize("over", [
+        {"loss_chunk": 16},  # chunked loss keeps the replicated head
+        {"vocab": 33},       # vocab % tp != 0: replicated fallback
+    ])
+    def test_tp_pp_head_fallback_matches_oracle(self, over):
+        # configs the Megatron (vocab-sharded) head cannot serve fall
+        # back to the replicated head instead of rejecting — and still
+        # match single-device autodiff exactly
+        cfg = TransformerConfig(**{**CFG, **over})
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0,
+                                    cfg.vocab, "int32")
+        want_loss, want_g = jax.value_and_grad(
+            lambda p: loss_fn(p, tokens, cfg)
+        )(params)
+        mesh = topology.make_mesh({"pp": 2, "tp": 2}, jax.devices()[:4])
+        loss, grads = _pp_lg(params, tokens, cfg, mesh, microbatches=2,
+                             axis_tp="tp")
+        np.testing.assert_allclose(float(loss), float(want_loss),
+                                   rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(grads), jax.tree.leaves(want_g)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5)
+
     def test_tp_pp_rejects_moe_and_indivisible(self):
         cfg = TransformerConfig(**{**CFG, "n_experts": 2})
         params = init_params(jax.random.PRNGKey(0), cfg)
