@@ -579,7 +579,14 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
         layer_g = dict(layer_g)
         layer_g["wqkv"] = tp_unpermute_wqkv(layer_g["wqkv"], cfg, tp)
 
-    loss = loss_r[0]
+    # pin the scalar replicated: XLA may otherwise leave it sharded
+    # along an axis that spans OS processes (observed with pp x tp in
+    # a 2-process launch), making float(loss) fail on non-addressable
+    # ranks
+    from jax.sharding import NamedSharding
+
+    loss = lax.with_sharding_constraint(
+        loss_r[0], NamedSharding(mesh, P()))
     grads = {
         "embed": outer_g["embed"],
         "layers": layer_g,
@@ -631,13 +638,22 @@ def make_pp_train_step(cfg: TransformerConfig, mesh, *, microbatches: int,
             opt_state = jax.device_put(opt_state, host_sh)
         return loss, params, opt_state
 
+    # the loss OUTPUT is pinned replicated at the jit boundary: the
+    # internal with_sharding_constraint alone can be overridden by the
+    # partitioner's output placement, and a loss left sharded along a
+    # process-spanning axis (seen with pp x tp under a 2-process
+    # launch) breaks float(loss) on non-addressable ranks
+    from jax.sharding import NamedSharding
+
+    rep = NamedSharding(mesh, P())
     if host_sh is not None:
         return jax.jit(
             step, donate_argnums=(0, 1),
             in_shardings=(None, host_sh, None),
-            out_shardings=(None, None, host_sh),
+            out_shardings=(rep, None, host_sh),
         )
-    return jax.jit(step, donate_argnums=(0, 1))
+    return jax.jit(step, donate_argnums=(0, 1),
+                   out_shardings=(rep, None, None))
 
 
 def init_pp_train_state(key, cfg: TransformerConfig, optimizer=None,

@@ -95,6 +95,19 @@ class TestLaunch:
         assert code == 0, out
         assert "SUCCESS" in out and "dcn-dp=2" in out
 
+    def test_train_pp_tp_across_processes(self, capsys):
+        # Megatron tp inside pipeline stages with the mesh spanning two
+        # OS processes: the per-layer tp psums (f/g) and the sharded
+        # loss head's reductions run as true cross-process collectives
+        code = _launch(["hpc_patterns_tpu.apps.train_app", "--pp", "2",
+                        "--tp", "2", "--steps", "2", "--batch", "4",
+                        "--microbatches", "2", "--seq", "32",
+                        "--d-model", "32", "--n-heads", "4",
+                        "--n-layers", "2", "--vocab", "128"])
+        out = capsys.readouterr().out
+        assert code == 0, out
+        assert "SUCCESS" in out and "tp=2" in out
+
     def test_train_sp_ring_attention_across_processes(self, capsys):
         # ring attention with the sp axis spanning both OS processes:
         # the per-step K/V ppermute crosses the process boundary
