@@ -102,28 +102,57 @@ def _chunk_step(params, cache, pos, limit, tokens, *, cfg, chunk,
     return cache, pos, limit, tokens, out
 
 
-@partial(jax.jit, static_argnames=("cfg", "dcfg", "gamma", "mesh"),
-         donate_argnums=(2, 3))
-def _spec_round(params, dparams, cache, dcache, pos, limit, cur, *,
-                cfg, dcfg, gamma, mesh=None):
-    """One draft-assisted serving round (greedy): THE shared
-    speculative round body (models/speculative.paged_round — one
-    acceptance/emit definition for the engine and
-    speculative_generate_batched) at each row's own cursor; per-row
-    advances of 1..gamma+1 tokens per dispatch. Rows past their limit
-    run at a clamped cursor (their garbage lands in pages they own or
-    the trash page). Returns (cache, dcache, a, emit) — the HOST
-    applies budget/EOS truncation and admission, which is what makes
-    over-acceptance past a row's budget safe to discard."""
+@partial(jax.jit,
+         static_argnames=("cfg", "dcfg", "gamma", "rounds", "eos_id",
+                          "mesh"),
+         donate_argnums=(2, 3, 4, 5, 6))
+def _spec_chunk(params, dparams, cache, dcache, pos, limit, cur, *,
+                cfg, dcfg, gamma, rounds, eos_id, mesh=None):
+    """``rounds`` draft-assisted serving rounds in ONE dispatch
+    (greedy): each round is THE shared speculative round body
+    (models/speculative.paged_round — one acceptance/emit definition
+    for the engine and speculative_generate_batched) at each row's own
+    cursor, advancing 1..gamma+1 tokens per round. Budget and EOS
+    truncation happen ON DEVICE between rounds (``adv`` clamps at the
+    row's limit; an emitted eos pulls the limit to the row's end), so
+    the host pays one round trip per ``rounds`` — the draft-mode
+    counterpart of _chunk_step's dispatch amortization. Rows at their
+    limit run at a clamped cursor (garbage lands in pages they own or
+    the trash page). Returns (cache, dcache, pos, limit, cur, emits,
+    advs): per-round tokens (rounds, B, gamma+1) and valid counts
+    (rounds, B) for the host to append."""
     from hpc_patterns_tpu.models.speculative import paged_round
 
-    active = pos < limit
-    pos_eff = jnp.where(active, pos, 0)
-    cache, dcache, a, emit, _ = paged_round(
-        params, cfg, dparams, dcfg, cache, dcache, pos_eff, cur,
-        gamma, jax.random.PRNGKey(0), True, 0, jnp.float32(1.0),
-        mesh=mesh)
-    return cache, dcache, a, emit
+    B = pos.shape[0]
+    rows = jnp.arange(B)
+
+    def one_round(carry, _):
+        cache, dcache, pos, limit, cur = carry
+        active = pos < limit
+        pos_eff = jnp.where(active, pos, 0)
+        cache, dcache, a, emit, _ = paged_round(
+            params, cfg, dparams, dcfg, cache, dcache, pos_eff, cur,
+            gamma, jax.random.PRNGKey(0), True, 0, jnp.float32(1.0),
+            mesh=mesh)
+        adv = jnp.where(active,
+                        jnp.minimum(a + 1, limit - pos), 0)
+        if eos_id >= 0:
+            k = jnp.arange(gamma + 1)[None, :]
+            is_eos = (emit == eos_id) & (k < adv[:, None])
+            has = jnp.any(is_eos, axis=1)
+            first = jnp.argmax(is_eos, axis=1)
+            adv = jnp.where(has, first + 1, adv)
+        new_cur = emit[rows, jnp.clip(adv - 1, 0, gamma)]
+        cur = jnp.where(adv > 0, new_cur, cur)
+        pos = pos + adv
+        if eos_id >= 0:
+            limit = jnp.where(has, pos, limit)
+        return (cache, dcache, pos, limit, cur), (emit, adv)
+
+    (cache, dcache, pos, limit, cur), (emits, advs) = lax.scan(
+        one_round, (cache, dcache, pos, limit, cur), None,
+        length=rounds)
+    return cache, dcache, pos, limit, cur, emits, advs
 
 
 @partial(jax.jit, static_argnames=("cfg", "page_size", "mesh"),
@@ -152,13 +181,14 @@ class ContinuousBatcher:
     ``paged_generate(..., mesh=...)``.
 
     ``draft_params``/``draft_cfg``/``gamma``: draft-assisted serving —
-    each dispatch becomes one speculative ROUND (draft proposes gamma,
-    target verifies in one ragged extend; rows advance 1..gamma+1
-    tokens at their own acceptance). ``chunk`` is unused in this mode:
-    the round IS the dispatch unit, and admission/eviction happen at
-    round boundaries. Composes with ``mesh``: draft steps ride the
-    shard_map paged-kernel route, the ragged extend partitions via
-    GSPMD (tp must divide BOTH models' kv_heads).
+    speculative ROUNDS (draft proposes gamma, target verifies in one
+    ragged extend; rows advance 1..gamma+1 tokens at their own
+    acceptance). ``chunk`` here means ROUNDS per jitted dispatch
+    (budget/EOS truncation runs on device between rounds), so
+    admission/eviction happen every chunk·(1..gamma+1) tokens.
+    Composes with ``mesh``: draft steps ride the shard_map
+    paged-kernel route, the ragged extend partitions via GSPMD (tp
+    must divide BOTH models' kv_heads).
     """
 
     def __init__(self, params, cfg: TransformerConfig, *, slots: int,
@@ -364,38 +394,32 @@ class ContinuousBatcher:
                 self._finish(i)
 
     def _run_spec_round(self):
-        """One draft-assisted round: per-row advances of 1..gamma+1
-        tokens per dispatch. The HOST truncates acceptance at each
-        row's budget (over-acceptance beyond the limit is discarded —
-        the caches' stale rows get overwritten when the cursor
-        re-crosses them, the speculative invariant) and applies EOS."""
-        pos_start = np.asarray(self.pos)
-        limit_np = np.asarray(self.limit)
-        self.cache, self.dcache, a, emit = _spec_round(
+        """``chunk`` draft-assisted rounds per dispatch: budget/EOS
+        truncation happens on device between rounds (_spec_chunk), so
+        over-acceptance beyond a limit is discarded there and the
+        caches' stale rows get overwritten when the cursor re-crosses
+        them (the speculative invariant). The host just appends each
+        round's valid tokens and finishes exhausted rows."""
+        (self.cache, self.dcache, self.pos, self.limit, self.tokens,
+         emits, advs) = _spec_chunk(
             self.params, self.draft_params, self.cache, self.dcache,
             self.pos, self.limit, self.tokens,
             cfg=self.cfg, dcfg=self.draft_cfg, gamma=self.gamma,
-            mesh=self.mesh,
+            rounds=self.chunk, eos_id=self.eos_id, mesh=self.mesh,
         )
-        a = np.asarray(a)
-        emit = np.asarray(emit)  # (slots, gamma+1)
+        emits = np.asarray(emits)  # (rounds, slots, gamma+1)
+        advs = np.asarray(advs)    # (rounds, slots)
+        pos_np = np.asarray(self.pos)
+        limit_np = np.asarray(self.limit)
         for i, st in enumerate(self._slots):
             if not st.active:
                 continue
-            valid = int(min(a[i] + 1, limit_np[i] - pos_start[i]))
-            toks = [int(t) for t in emit[i, :valid]]
-            if self.eos_id >= 0 and self.eos_id in toks:
-                toks = toks[:toks.index(self.eos_id) + 1]
-            st.out.extend(toks)
-            new_pos = int(pos_start[i]) + len(toks)
-            done = (new_pos >= limit_np[i]
-                    or (self.eos_id >= 0 and toks
-                        and toks[-1] == self.eos_id))
-            if done:
+            for k in range(advs.shape[0]):
+                v = int(advs[k, i])
+                if v:
+                    st.out.extend(int(t) for t in emits[k, i, :v])
+            if pos_np[i] >= limit_np[i]:
                 self._finish(i)
-            else:
-                self.pos = self.pos.at[i].set(new_pos)
-                self.tokens = self.tokens.at[i].set(toks[-1])
 
     def run(self):
         """Serve until queue and slots drain. Returns ``finished``:
