@@ -7,6 +7,17 @@
 set -u
 LOG="${1:-benchmarks/r5_chip.log}"
 cd "$(dirname "$0")/.."
+
+# preflight: a hung tunnel blocks `import jax` in C — don't start a
+# 16-step sequence whose every step would burn its full timeout
+if ! timeout 90 python -c \
+    "import jax; assert jax.default_backend()=='tpu'; import jax.numpy as jnp; x=jnp.ones((128,128)); (x@x).block_until_ready()" \
+    >/dev/null 2>&1; then
+  echo "PREFLIGHT FAILED: TPU tunnel unresponsive ($(date +%H:%M:%S))" | tee -a "$LOG"
+  exit 2
+fi
+echo "PREFLIGHT OK ($(date +%H:%M:%S))" | tee -a "$LOG"
+
 run() {
   local name="$1"; shift
   echo "=== $name ($(date +%H:%M:%S)) ===" | tee -a "$LOG"
