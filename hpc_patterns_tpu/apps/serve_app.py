@@ -160,7 +160,7 @@ def run(args) -> int:
                 chunk=args.chunk,
                 eos_id=args.eos_id if args.eos_id >= 0 else None,
                 draft_params=draft_params, draft_cfg=draft_cfg,
-                gamma=args.gamma,
+                gamma=args.gamma, emit=log.emit,
             )
             ids = [eng.submit(p, b) for p, b in reqs]
             got = eng.run()

@@ -195,7 +195,7 @@ class ContinuousBatcher:
                  pool_pages: int, pages_per_seq: int, page_size: int,
                  chunk: int = 8, eos_id: int | None = None, mesh=None,
                  draft_params=None, draft_cfg: TransformerConfig | None
-                 = None, gamma: int = 4):
+                 = None, gamma: int = 4, emit=None):
         if cfg.n_experts:
             # paged serving is dense-model territory so far
             raise ValueError("continuous batching: dense models only")
@@ -243,6 +243,11 @@ class ContinuousBatcher:
         self._queue: list[Request] = []
         self.finished: dict[int, np.ndarray] = {}
         self._next_id = 0
+        # observability hook (the framework's metrics/logging
+        # subsystem, SURVEY.md §5): a callable taking keyword fields —
+        # pass harness.RunLog.emit for JSONL records of admissions,
+        # completions, and queue waits; None = silent
+        self._emit = emit or (lambda **kw: None)
 
     # -- admission ---------------------------------------------------------
 
@@ -351,6 +356,10 @@ class ContinuousBatcher:
         st = self._slots[slot]
         st.seq_id, st.pages, st.prompt_len = req.seq_id, pages, T
         st.out, st.active = [first], True
+        self._emit(kind="serve_admit", seq_id=req.seq_id, slot=slot,
+                   pages=need, prompt_len=T, budget=req.max_new,
+                   free_pages=len(self.free_pages),
+                   queued=len(self._queue))
         self.pos = self.pos.at[slot].set(T)
         done = (self.eos_id >= 0 and first == self.eos_id) or req.max_new == 1
         self.limit = self.limit.at[slot].set(
@@ -364,6 +373,8 @@ class ContinuousBatcher:
     def _finish(self, slot: int):
         st = self._slots[slot]
         self.finished[st.seq_id] = np.asarray(st.out, np.int32)
+        self._emit(kind="serve_finish", seq_id=st.seq_id, slot=slot,
+                   tokens=len(st.out), pages_freed=len(st.pages))
         self.free_pages.extend(st.pages)
         self._table[slot] = self.trash
         self.cache["table"] = jnp.asarray(self._table)

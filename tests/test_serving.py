@@ -230,6 +230,30 @@ class TestContinuousBatching:
                               pages_per_seq=3, page_size=8,
                               draft_params=dparams)
 
+    def test_telemetry_events(self):
+        # the observability hook records every admission and
+        # completion with page accounting (the metrics/logging
+        # subsystem applied to serving)
+        cfg, params = _setup()
+        events = []
+        eng = ContinuousBatcher(params, cfg, slots=2, pool_pages=6,
+                                pages_per_seq=3, page_size=8, chunk=2,
+                                emit=lambda **kw: events.append(kw))
+        reqs = _requests(cfg, 3, seed=21)
+        ids = [eng.submit(p, m) for p, m in reqs]
+        eng.run()
+        admits = [e for e in events if e["kind"] == "serve_admit"]
+        finishes = [e for e in events if e["kind"] == "serve_finish"]
+        assert sorted(e["seq_id"] for e in admits) == sorted(ids)
+        assert sorted(e["seq_id"] for e in finishes) == sorted(ids)
+        for e, (prompt, max_new) in zip(sorted(admits,
+                                               key=lambda e: e["seq_id"]),
+                                        reqs):
+            assert e["prompt_len"] == len(prompt)
+            assert e["budget"] == max_new
+        for e in finishes:
+            assert e["tokens"] >= 1 and e["pages_freed"] >= 1
+
     def test_guards(self):
         cfg, params = _setup()
         eng = ContinuousBatcher(params, cfg, slots=1, pool_pages=2,
