@@ -5,6 +5,7 @@
 # everything after (round-4 lesson, memory: axon-env-quirks).
 # Usage: bash benchmarks/reground_r5.sh [logfile]
 set -u
+set -o pipefail
 LOG="${1:-benchmarks/r5_chip.log}"
 cd "$(dirname "$0")/.."
 
@@ -22,7 +23,10 @@ run() {
   local name="$1"; shift
   echo "=== $name ($(date +%H:%M:%S)) ===" | tee -a "$LOG"
   timeout 1200 "$@" 2>&1 | tee -a "$LOG"
-  echo "--- rc=$? ---" | tee -a "$LOG"
+  # the benchmark's status, not tee's: $? after a pipeline is the LAST
+  # command's (always-0 tee), which masked failures/timeouts (ADVICE r5)
+  local rc=${PIPESTATUS[0]}
+  echo "--- rc=$rc ---" | tee -a "$LOG"
 }
 
 # 0. session health + headline (the driver-style capture, kept as a row)

@@ -32,6 +32,7 @@ import numpy as np
 from hpc_patterns_tpu.harness.timing import blocking
 
 from hpc_patterns_tpu.apps import common
+from hpc_patterns_tpu.comm.communicator import record_collective_bandwidth
 from hpc_patterns_tpu.dtypes import get_traits
 from hpc_patterns_tpu.harness import RunLog, Verdict, correctness_verdict, measure
 from hpc_patterns_tpu.harness.cli import (
@@ -230,7 +231,8 @@ def _run_point(args, log, comm, algorithm: str, log2_elements: int,
         kind_cache["kind"] = memory_kind
 
     result = measure(
-        blocking(step, x), repetitions=args.repetitions, warmup=args.warmup
+        blocking(step, x), repetitions=args.repetitions, warmup=args.warmup,
+        label=f"allreduce.{algorithm}",
     )
     elapsed = max_across_processes(result.min_s)
 
@@ -277,6 +279,8 @@ def _run_point(args, log, comm, algorithm: str, log2_elements: int,
 
     nbytes = n * traits.itemsize
     busbw = common.allreduce_bus_bandwidth_gbps(nbytes, elapsed, world)
+    record_collective_bandwidth(f"allreduce.{algorithm}", nbytes, elapsed,
+                                busbw_gbps=busbw)
     log.result(
         f"allreduce[{algorithm}]",
         verdict,
@@ -298,7 +302,7 @@ def _run_point(args, log, comm, algorithm: str, log2_elements: int,
 
 
 def main(argv=None) -> int:
-    return run(build_parser().parse_args(argv))
+    return common.run_instrumented(run, build_parser().parse_args(argv))
 
 
 if __name__ == "__main__":

@@ -7,10 +7,36 @@ allreduce-mpi-sycl.cpp:135-152, world-size guard at :95-97, reporting at
 
 from __future__ import annotations
 
+from typing import Callable
+
 import jax
 
 from hpc_patterns_tpu import topology
 from hpc_patterns_tpu.comm import Communicator
+
+
+def run_instrumented(run_fn: Callable[[object], int], args) -> int:
+    """The shared ``--metrics`` session every app main() runs through:
+    install a fresh process-wide registry from the flags (a no-op
+    registry without ``--metrics`` — the disabled fast path), run the
+    app, and on ANY exit path append one ``kind=metrics`` snapshot
+    record to ``--log``, the record `python -m
+    hpc_patterns_tpu.harness.report` aggregates. Appending (never
+    truncating) keeps the app's own records: the snapshot is the log's
+    closing record, like run.sh's trailing grep summary."""
+    from hpc_patterns_tpu.harness import metrics
+    from hpc_patterns_tpu.harness.runlog import RunLog
+
+    # mirror_traces stays off here: profiling.maybe_trace toggles it
+    # (and restores it) around the actual traced region, so spans only
+    # pay for TraceAnnotation while a trace is live
+    m = metrics.configure(enabled=getattr(args, "metrics", False))
+    try:
+        return run_fn(args)
+    finally:
+        if m.enabled and getattr(args, "log", None):
+            RunLog(args.log, truncate=False).emit(
+                kind="metrics", **m.snapshot())
 
 
 def make_communicator(

@@ -25,8 +25,10 @@ from __future__ import annotations
 import sys
 
 from hpc_patterns_tpu import topology
+from hpc_patterns_tpu.apps import common
 from hpc_patterns_tpu.concurrency import autotune, commands as cmds, engine
 from hpc_patterns_tpu.harness import RunLog, concurrency_verdict
+from hpc_patterns_tpu.harness import metrics as metricslib
 from hpc_patterns_tpu.harness.cli import AUTO, base_parser
 from hpc_patterns_tpu.harness.profiling import maybe_trace
 
@@ -151,6 +153,24 @@ def _onchip_supported(args, mode) -> bool:
     )
 
 
+def _record_overlap_metrics(engine_name, names, serial_s, concurrent_s,
+                            verdict) -> None:
+    """Overlap outcome gauges (no-op when --metrics is off): the
+    serial/concurrent pair and the achieved speedup, keyed by
+    ``<engine>.<mode>`` and the command pair so a sweep over modes
+    accumulates the full matrix instead of overwriting one key."""
+    m = metricslib.get_metrics()
+    if not m.enabled:
+        return
+    pair = "+".join(names)
+    m.gauge(f"concurrency.{engine_name}.{pair}.serial_s").set(serial_s)
+    m.gauge(f"concurrency.{engine_name}.{pair}.concurrent_s").set(
+        concurrent_s)
+    if verdict.speedup is not None:
+        m.gauge(f"concurrency.{engine_name}.{pair}.speedup").set(
+            verdict.speedup)
+
+
 def run_onchip(args, log, mode) -> int:
     """C1's experiment as ONE Pallas kernel: the copy commands are
     HBM↔VMEM DMA streams, the compute command is the busy-wait chain,
@@ -221,6 +241,8 @@ def run_onchip(args, log, mode) -> int:
     verdict = concurrency_verdict(
         per_times, t_concurrent, rule=args.rule, resources=resources
     )
+    _record_overlap_metrics(f"onchip.{mode}", names, t_serial,
+                            t_concurrent, verdict)
     log.result(
         f"concurrency[onchip:{'+'.join(names)}]",
         verdict,
@@ -281,6 +303,9 @@ def run(args) -> int:
     verdict = concurrency_verdict(
         per_times, concurrent.total.min_s, rule=args.rule
     )
+    _record_overlap_metrics(f"dispatch.{mode}", names,
+                            serial.best_serial_total_s,
+                            concurrent.total.min_s, verdict)
     log.result(
         f"concurrency[{mode}:{'+'.join(names)}]",
         verdict,
@@ -296,7 +321,7 @@ def run(args) -> int:
 
 
 def main(argv=None) -> int:
-    return run(build_parser().parse_args(argv))
+    return common.run_instrumented(run, build_parser().parse_args(argv))
 
 
 if __name__ == "__main__":

@@ -18,7 +18,9 @@ import jax
 import jax.numpy as jnp
 
 from hpc_patterns_tpu import topology
+from hpc_patterns_tpu.apps import common
 from hpc_patterns_tpu.harness import RunLog, Verdict
+from hpc_patterns_tpu.harness import metrics as metricslib
 from hpc_patterns_tpu.harness.cli import base_parser
 from hpc_patterns_tpu.models import TransformerConfig, init_params
 from hpc_patterns_tpu.models.transformer import loss_fn
@@ -105,9 +107,16 @@ def run(args) -> int:
     # and train NLL semantics cannot drift; no experts here, so the MoE
     # aux term loss_fn would add is identically zero
     nll_fn = jax.jit(lambda p, t: loss_fn(p, t, cfg))
-    nlls = [float(nll_fn(params, jnp.asarray(b))) for b in source]
+    m = metricslib.get_metrics()
+    nlls = []
+    for b in source:
+        with m.span("eval.batch"):
+            # float() blocks on the device, closing the span honestly
+            nlls.append(float(nll_fn(params, jnp.asarray(b))))
     mean_nll = sum(nlls) / len(nlls)
     ppl = math.exp(mean_nll)
+    m.gauge("eval.mean_nll").set(mean_nll)
+    m.gauge("eval.perplexity").set(ppl)
 
     finite = all(math.isfinite(x) for x in nlls)
     if args.checkpoint_dir is None:
@@ -129,7 +138,7 @@ def run(args) -> int:
 
 
 def main(argv=None) -> int:
-    return run(build_parser().parse_args(argv))
+    return common.run_instrumented(run, build_parser().parse_args(argv))
 
 
 if __name__ == "__main__":

@@ -40,7 +40,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from hpc_patterns_tpu.apps import common
 from hpc_patterns_tpu.harness import RunLog, Verdict
+from hpc_patterns_tpu.harness import metrics as metricslib
 from hpc_patterns_tpu.harness.cli import base_parser
 from hpc_patterns_tpu.interop import native, zero_copy
 
@@ -162,6 +164,9 @@ def run(args) -> int:
         checks.append(("native C++ XLA driver", _native_driver_leg(log, n)))
 
     all_ok = all(ok for _, ok in checks)
+    m = metricslib.get_metrics()
+    m.gauge("interop.checks_total").set(len(checks))
+    m.gauge("interop.checks_ok").set(sum(ok for _, ok in checks))
     for i, (name, ok) in enumerate(checks):
         log.print(f"{'Passed' if ok else 'FAILED'} {i} ({name})")
     log.emit(kind="result", name="interop", success=all_ok,
@@ -172,7 +177,7 @@ def run(args) -> int:
 
 
 def main(argv=None) -> int:
-    return run(build_parser().parse_args(argv))
+    return common.run_instrumented(run, build_parser().parse_args(argv))
 
 
 if __name__ == "__main__":

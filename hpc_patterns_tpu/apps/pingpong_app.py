@@ -20,6 +20,7 @@ import sys
 import numpy as np
 
 from hpc_patterns_tpu.apps import common
+from hpc_patterns_tpu.comm.communicator import record_collective_bandwidth
 from hpc_patterns_tpu.dtypes import get_traits
 from hpc_patterns_tpu.harness import RunLog, Verdict, measure
 from hpc_patterns_tpu.harness.cli import (
@@ -58,7 +59,8 @@ def run(args) -> int:
         x = comm.rank_filled(n, traits.dtype)
         exchange = comm.jit_pingpong(x)
         result = measure(
-            blocking(exchange, x), repetitions=args.repetitions, warmup=args.warmup
+            blocking(exchange, x), repetitions=args.repetitions,
+            warmup=args.warmup, label="pingpong",
         )
         elapsed = max_across_processes(result.min_s)
         # validation: one hop moves rank r's data to r^1; rank_filled
@@ -74,6 +76,8 @@ def run(args) -> int:
         ok = common.all_processes_agree(ok)
         all_ok &= ok
         nbytes = n * traits.itemsize
+        record_collective_bandwidth("pingpong", nbytes, elapsed,
+                                    latency_us=elapsed * 1e6)
         log.emit(
             kind="result",
             name=f"pingpong[p={p}]",
@@ -93,7 +97,7 @@ def run(args) -> int:
 
 
 def main(argv=None) -> int:
-    return run(build_parser().parse_args(argv))
+    return common.run_instrumented(run, build_parser().parse_args(argv))
 
 
 if __name__ == "__main__":

@@ -21,7 +21,9 @@ import jax
 import jax.numpy as jnp
 
 from hpc_patterns_tpu import topology
+from hpc_patterns_tpu.apps import common
 from hpc_patterns_tpu.harness import RunLog, Verdict
+from hpc_patterns_tpu.harness import metrics as metricslib
 from hpc_patterns_tpu.harness.cli import base_parser
 from hpc_patterns_tpu.models import TransformerConfig, init_params
 
@@ -150,9 +152,9 @@ def run(args) -> int:
     total_budget = sum(b for _, b in reqs)
 
     def serve():
-        # constructor/submit ValueErrors (bad gamma, int8+draft, vocab
-        # mismatch, oversize request) keep the clean ERROR/FAILURE
-        # contract too, not just run()'s RuntimeError
+        # constructor/submit ValueErrors (bad gamma, vocab mismatch,
+        # oversize request) keep the clean ERROR/FAILURE contract too,
+        # not just run()'s RuntimeError
         try:
             eng = ContinuousBatcher(
                 params, cfg, slots=args.slots, pool_pages=pool_pages,
@@ -168,15 +170,26 @@ def run(args) -> int:
             return None, str(e)
         return {i: got[sid] for i, sid in enumerate(ids)}, None
 
-    out, err = serve()  # warmup (compiles)
+    # warmup (compiles) — keep its records out of the registry: its
+    # TTFT would be compile-dominated and its counters would double
+    # every request (the warmup-vs-timed discipline of harness.timing)
+    m = metricslib.get_metrics()
+    prev_enabled = m.enabled
+    m.enabled = False
+    try:
+        out, err = serve()
+    finally:
+        m.enabled = prev_enabled
     if err is not None:
         log.print(f"ERROR: {err}")
         log.print("FAILURE")
         return 1
     t0 = time.perf_counter()
-    out, _ = serve()
+    with metricslib.span("serve.measure"):
+        out, _ = serve()
     dt = time.perf_counter() - t0
     served = sum(len(v) for v in out.values())
+    metricslib.get_metrics().gauge("serve.tokens_per_s").set(served / dt)
 
     # the oracle: every sequence token-exact vs standalone paged decode
     # (truncated at eos when enabled — same rule the engine applies)
@@ -231,7 +244,7 @@ def run(args) -> int:
 
 
 def main(argv=None) -> int:
-    return run(build_parser().parse_args(argv))
+    return common.run_instrumented(run, build_parser().parse_args(argv))
 
 
 if __name__ == "__main__":

@@ -29,7 +29,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from hpc_patterns_tpu.apps import common
 from hpc_patterns_tpu.comm import halo
+from hpc_patterns_tpu.comm.communicator import record_collective_bandwidth
 from hpc_patterns_tpu.harness import RunLog, Verdict, measure
+from hpc_patterns_tpu.harness import metrics as metricslib
 from hpc_patterns_tpu.harness.cli import add_msg_size_args, base_parser
 from hpc_patterns_tpu.harness.timing import blocking, max_across_processes
 
@@ -69,7 +71,7 @@ def run(args) -> int:
 
     result = measure(
         blocking(stepper, u0_sharded),
-        repetitions=args.repetitions, warmup=args.warmup,
+        repetitions=args.repetitions, warmup=args.warmup, label="stencil",
     )
     out = stepper(u0_sharded)
 
@@ -97,6 +99,8 @@ def run(args) -> int:
     ok = common.all_processes_agree(conserved and matches)
     per_step = max_across_processes(result.min_s) / steps
     halo_bytes = 2 * 4 * world  # 2 directions × f32 per rank, per step
+    record_collective_bandwidth("halo", halo_bytes, per_step)
+    metricslib.get_metrics().gauge("stencil.step_us").set(per_step * 1e6)
     log.emit(
         kind="result", name="stencil", success=ok, world=world,
         elements=n, steps=steps, per_step_us=per_step * 1e6,
@@ -117,7 +121,7 @@ def run(args) -> int:
 
 
 def main(argv=None) -> int:
-    return run(build_parser().parse_args(argv))
+    return common.run_instrumented(run, build_parser().parse_args(argv))
 
 
 if __name__ == "__main__":

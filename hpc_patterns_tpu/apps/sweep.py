@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import sys
 
-from hpc_patterns_tpu.apps import concurrency_app
+from hpc_patterns_tpu.apps import common, concurrency_app
 from hpc_patterns_tpu.harness import RunLog
 from hpc_patterns_tpu.harness.cli import base_parser
 
@@ -75,7 +75,10 @@ def run(args) -> int:
 
 
 def main(argv=None) -> int:
-    return run(build_parser().parse_args(argv))
+    # one shared registry for the whole sweep: sub-apps run in-process
+    # via concurrency_app.run, so their spans/gauges accumulate into
+    # the harness's single closing kind=metrics snapshot
+    return common.run_instrumented(run, build_parser().parse_args(argv))
 
 
 if __name__ == "__main__":

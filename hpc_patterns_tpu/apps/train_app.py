@@ -23,10 +23,16 @@ from functools import partial
 import jax
 
 from hpc_patterns_tpu import topology
+from hpc_patterns_tpu.apps import common
 from hpc_patterns_tpu.harness import RunLog, Verdict
 from hpc_patterns_tpu.harness.cli import base_parser
 from hpc_patterns_tpu.models import ATTENTION_IMPLS, TransformerConfig
-from hpc_patterns_tpu.models.train import init_train_state, make_batch, make_train_step
+from hpc_patterns_tpu.models.train import (
+    init_train_state,
+    make_batch,
+    make_train_step,
+    record_step_metrics,
+)
 
 
 def build_parser():
@@ -202,6 +208,8 @@ def _train_loop(args, log, cfg, mesh, params, opt_state, step_fn, *,
         loss_val = float(loss)  # blocks: readback is the completion fence
         t_steps.append(time.perf_counter() - t0)
         losses.append(loss_val)
+        record_step_metrics(i, loss_val, t_steps[-1],
+                            args.batch * args.seq)
         extra = {}
         if drop_rates_fn is not None and i % args.drop_rate_every == 0:
             # capacity drops during training are otherwise invisible
@@ -579,7 +587,7 @@ def run(args) -> int:
 
 
 def main(argv=None) -> int:
-    return run(build_parser().parse_args(argv))
+    return common.run_instrumented(run, build_parser().parse_args(argv))
 
 
 if __name__ == "__main__":

@@ -23,8 +23,37 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from hpc_patterns_tpu.comm import collectives, ring
+from hpc_patterns_tpu.harness import metrics as metricslib
 
 Algorithm = Literal["collective", "ring", "ring_chunked"]
+
+
+def _ready_in_span(result):
+    """Block before an open span exits so it measures collective
+    completion, not async dispatch — the shard_map call returns an
+    unready array. Only when a span actually records (metrics or trace
+    mirroring on); the disabled path stays fully async."""
+    m = metricslib.get_metrics()
+    if m.enabled or m.mirror_traces:
+        jax.block_until_ready(result)
+    return result
+
+
+def record_collective_bandwidth(op: str, nbytes: int, seconds: float,
+                                **attrs) -> None:
+    """Per-collective bandwidth gauge + latency histogram in the
+    process-wide metrics registry (no-op when disabled): the
+    observability layer's view of the BASELINE bandwidth metrics, so a
+    sweep's ``kind=metrics`` snapshot carries the same numbers the
+    per-point ``kind=result`` records do. ``attrs`` become gauges too
+    (e.g. ``busbw_gbps=...`` for the ring-normalized form)."""
+    m = metricslib.get_metrics()
+    if not m.enabled or seconds <= 0:
+        return
+    m.gauge(f"comm.{op}.bandwidth_gbps").set(nbytes / seconds / 1e9)
+    m.histogram(f"comm.{op}.s").observe(seconds)
+    for key, value in attrs.items():
+        m.gauge(f"comm.{op}.{key}").set(value)
 
 # allreduce algorithm table: library collective vs hand-built rings —
 # the comparison the reference exists to make (SURVEY.md §2.3(b)).
@@ -95,7 +124,9 @@ class Communicator:
         ``"collective"``; the :173-182 hand ring for ``"ring"``;
         two-phase bandwidth-optimal ring for ``"ring_chunked"``)."""
         impl = _ALLREDUCE[algorithm]
-        return self._shmap(lambda local: impl(local, self.axis), x)(x)
+        with metricslib.span("comm.allreduce", algorithm=algorithm):
+            return _ready_in_span(
+                self._shmap(lambda local: impl(local, self.axis), x)(x))
 
     def jit_allreduce(self, x, algorithm: Algorithm = "collective"):
         """The compiled allreduce closure for ``x``'s shape — what a
@@ -106,7 +137,8 @@ class Communicator:
     def pingpong(self, x) -> jax.Array:
         """Pairwise even/odd exchange: row r swaps with row r^1 — the
         pt2pt ping-pong config of BASELINE.json."""
-        return self.jit_pingpong(x)(x)
+        with metricslib.span("comm.pingpong"):
+            return _ready_in_span(self.jit_pingpong(x)(x))
 
     def jit_pingpong(self, x):
         """Compiled pairwise-exchange closure (for timing loops)."""
@@ -115,25 +147,31 @@ class Communicator:
     def sendrecv_ring(self, x, shift: int = 1) -> jax.Array:
         """One ring hop: row r moves to row (r+shift) % size
         (SendRecvRing, allreduce-mpi-sycl.cpp:43-59)."""
-        return self._shmap(lambda l: ring.ring_shift(l, self.axis, shift), x)(x)
+        with metricslib.span("comm.sendrecv_ring", shift=shift):
+            return _ready_in_span(self._shmap(
+                lambda l: ring.ring_shift(l, self.axis, shift), x)(x))
 
     def all_gather(self, x) -> jax.Array:
         """Every rank receives every row: (size, n) -> (size, size, n)."""
         fn = lambda l: collectives.all_gather(l, self.axis, tiled=False).squeeze(1)[None]
         spec = P(self.axis, None, *([None] * (jnp.ndim(x) - 1)))
-        return self._shmap(fn, x, out_specs=spec)(x)
+        with metricslib.span("comm.all_gather"):
+            return _ready_in_span(self._shmap(fn, x, out_specs=spec)(x))
 
     def reduce_scatter(self, x) -> jax.Array:
         """(size, size*n) rows -> (size, n): rank r gets chunk r of the sum."""
         fn = lambda l: collectives.reduce_scatter(l, self.axis, scatter_axis=jnp.ndim(x) - 1)
-        return self._shmap(fn, x, out_specs=P(self.axis, *([None] * (jnp.ndim(x) - 1))))(x)
+        with metricslib.span("comm.reduce_scatter"):
+            return _ready_in_span(self._shmap(
+                fn, x, out_specs=P(self.axis, *([None] * (jnp.ndim(x) - 1))))(x))
 
     def all_to_all(self, x) -> jax.Array:
         """Row r's chunk c goes to row c's chunk r (MPI_Alltoall)."""
         fn = lambda l: collectives.all_to_all(
             l, self.axis, split_axis=jnp.ndim(x) - 1, concat_axis=jnp.ndim(x) - 1
         )
-        return self._shmap(fn, x)(x)
+        with metricslib.span("comm.all_to_all"):
+            return _ready_in_span(self._shmap(fn, x)(x))
 
     # -- miniapp-style buffer init ---------------------------------------
 

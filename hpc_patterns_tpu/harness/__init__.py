@@ -13,3 +13,9 @@ from hpc_patterns_tpu.harness.verdict import (  # noqa: F401
     correctness_verdict,
 )
 from hpc_patterns_tpu.harness.runlog import RunLog  # noqa: F401
+from hpc_patterns_tpu.harness.metrics import (  # noqa: F401
+    Metrics,
+    configure as configure_metrics,
+    get_metrics,
+    span,
+)

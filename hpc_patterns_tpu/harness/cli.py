@@ -46,6 +46,13 @@ def base_parser(description: str) -> argparse.ArgumentParser:
         action="store_true",
         help="append to --log instead of truncating (for harness-invoked runs)",
     )
+    p.add_argument(
+        "--metrics",
+        action="store_true",
+        help="enable the metrics/span registry (harness/metrics.py); with "
+             "--log, one final kind=metrics snapshot record is appended — "
+             "aggregate with `python -m hpc_patterns_tpu.harness.report`",
+    )
     return p
 
 

@@ -23,10 +23,23 @@ def maybe_trace(enabled: bool, logdir: str | None = None):
     Yields the trace directory (or None when disabled), so callers can
     surface it in the run log — the upgrade over the reference's
     write-only property.
+
+    Inside the traced region, metric spans (harness/metrics.py) mirror
+    into ``jax.profiler.TraceAnnotation`` regardless of whether the
+    registry records, so the XProf timeline and the JSONL snapshot name
+    the same phases.
     """
     if not enabled:
         yield None
         return
+    from hpc_patterns_tpu.harness import metrics
+
     logdir = logdir or tempfile.mkdtemp(prefix="hpcpat_trace_")
-    with jax.profiler.trace(logdir):
-        yield logdir
+    m = metrics.get_metrics()
+    prev = m.mirror_traces
+    m.mirror_traces = True
+    try:
+        with jax.profiler.trace(logdir):
+            yield logdir
+    finally:
+        m.mirror_traces = prev

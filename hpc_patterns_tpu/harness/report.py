@@ -1,0 +1,185 @@
+"""Runlog aggregator: per-phase/per-metric summary over JSONL logs.
+
+Replaces eyeballing raw JSONL (the structured upgrade of grepping
+``run.log``, SURVEY.md §5): point it at any run log — one app run or a
+whole sweep, one file or several — and it merges every ``kind=metrics``
+snapshot (harness/metrics.py) into one table of counters, gauges, and
+histogram percentiles, plus a result-record summary. Histogram
+percentiles are recomputed from the snapshots' fixed log-spaced bucket
+counts, so the table shows exactly what a live registry would
+(quantized to bucket resolution — the round-trip guarantee).
+
+Usage::
+
+    python -m hpc_patterns_tpu.harness.report run.jsonl [more.jsonl ...]
+
+Exit 0 when records were read (even with no metrics snapshots — the
+result summary still prints); 2 on unreadable/empty input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from pathlib import Path
+from typing import Any, Iterable
+
+from hpc_patterns_tpu.harness.metrics import BUCKET_LAYOUT, Gauge, Histogram
+
+PERCENTILES = (50.0, 95.0)
+
+
+def load_records(paths: Iterable[str | Path]) -> list[dict[str, Any]]:
+    """All JSON records across ``paths``, in file-then-line order.
+    Unparseable lines are skipped (a crashed run can truncate its last
+    line; the rest of the log is still worth aggregating)."""
+    records = []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    return records
+
+
+def aggregate(records: list[dict[str, Any]]) -> dict[str, Any]:
+    """Merge every ``kind=metrics`` snapshot: counters sum, gauges keep
+    the last value (min/max/n across snapshots), histograms merge
+    bucket counts. Returns the merged tables plus record-kind stats."""
+    counters: dict[str, float] = {}
+    gauges: dict[str, Gauge] = {}
+    histograms: dict[str, Histogram] = {}
+    kinds: dict[str, int] = {}
+    n_ok = n_bad = n_snapshots = n_layout_skipped = 0
+    for rec in records:
+        kind = rec.get("kind", "?")
+        kinds[kind] = kinds.get(kind, 0) + 1
+        if kind == "result":
+            if rec.get("success"):
+                n_ok += 1
+            else:
+                n_bad += 1
+        if kind != "metrics":
+            continue
+        n_snapshots += 1
+        for name, value in rec.get("counters", {}).items():
+            counters[name] = counters.get(name, 0) + value
+        for name, snap in rec.get("gauges", {}).items():
+            # null means the live value was non-finite (diverged loss):
+            # last renders as "-", min/max just don't update
+            g = gauges.setdefault(name, Gauge())
+            g.last = (math.nan if snap["last"] is None
+                      else float(snap["last"]))
+            if snap["min"] is not None:
+                g.min = min(g.min, float(snap["min"]))
+            if snap["max"] is not None:
+                g.max = max(g.max, float(snap["max"]))
+            g.n += int(snap["n"])
+        # bucket indices only mean the same thing under the same layout:
+        # a snapshot written under a different one cannot be merged —
+        # its percentiles would silently shift by up to a decade
+        layout = rec.get("bucket_layout")
+        if layout is not None and layout != BUCKET_LAYOUT:
+            n_layout_skipped += 1
+            continue
+        for name, snap in rec.get("histograms", {}).items():
+            h = histograms.setdefault(name, Histogram())
+            h.merge(Histogram.from_snapshot(snap))
+    return {
+        "counters": counters,
+        "gauges": gauges,
+        "histograms": histograms,
+        "kinds": kinds,
+        "n_snapshots": n_snapshots,
+        "n_layout_skipped": n_layout_skipped,
+        "results": (n_ok, n_bad),
+    }
+
+
+def _fmt(v: float) -> str:
+    if not math.isfinite(v):
+        return "-"
+    return f"{v:.4g}"
+
+
+def format_report(agg: dict[str, Any], source: str = "") -> str:
+    """The human table. Span histograms (``span.<path>``) are the
+    per-phase timing attribution; everything else is per-metric."""
+    lines = []
+    n_records = sum(agg["kinds"].values())
+    ok, bad = agg["results"]
+    kinds = ", ".join(f"{k}={n}" for k, n in sorted(agg["kinds"].items()))
+    head = f"{n_records} records"
+    if source:
+        head += f" from {source}"
+    head += f" ({kinds})"
+    lines.append(head)
+    if ok or bad:
+        lines.append(f"results: {ok} SUCCESS / {bad} FAILURE")
+    if not agg["n_snapshots"]:
+        lines.append("no kind=metrics snapshots (run apps with "
+                     "--metrics --log to record them)")
+        return "\n".join(lines)
+    lines.append(f"merged {agg['n_snapshots']} metrics snapshot(s)")
+    if agg.get("n_layout_skipped"):
+        lines.append(
+            f"WARNING: histograms from {agg['n_layout_skipped']} "
+            "snapshot(s) skipped — written under a different bucket "
+            "layout (counters/gauges still merged)")
+
+    if agg["counters"]:
+        lines.append("")
+        lines.append(f"{'counter':<44} {'total':>12}")
+        for name, value in sorted(agg["counters"].items()):
+            lines.append(f"{name:<44} {_fmt(value):>12}")
+
+    if agg["gauges"]:
+        lines.append("")
+        lines.append(f"{'gauge':<44} {'last':>12} {'min':>12} "
+                     f"{'max':>12} {'n':>6}")
+        for name, g in sorted(agg["gauges"].items()):
+            lines.append(f"{name:<44} {_fmt(g.last):>12} {_fmt(g.min):>12} "
+                         f"{_fmt(g.max):>12} {g.n:>6}")
+
+    if agg["histograms"]:
+        lines.append("")
+        cols = " ".join(f"{'p%g' % q:>12}" for q in PERCENTILES)
+        lines.append(f"{'histogram':<44} {'count':>8} {cols} {'max':>12}")
+        for name, h in sorted(agg["histograms"].items()):
+            pcts = " ".join(f"{_fmt(h.percentile(q)):>12}"
+                            for q in PERCENTILES)
+            hmax = h.max if h.count else math.nan
+            lines.append(f"{name:<44} {h.count:>8} {pcts} "
+                         f"{_fmt(hmax):>12}")
+    return "\n".join(lines)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("logs", nargs="+", help="runlog JSONL file(s)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        records = load_records(args.logs)
+    except OSError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 2
+    if not records:
+        print("ERROR: no records in input", file=sys.stderr)
+        return 2
+    print(format_report(aggregate(records), source=", ".join(args.logs)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

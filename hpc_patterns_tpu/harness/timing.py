@@ -25,6 +25,8 @@ from typing import Callable, Sequence
 
 import jax
 
+from hpc_patterns_tpu.harness import metrics as metricslib
+
 
 @dataclasses.dataclass(frozen=True)
 class TimingResult:
@@ -57,6 +59,7 @@ def measure(
     *,
     repetitions: int = 10,
     warmup: int = 1,
+    label: str = "measure",
 ) -> TimingResult:
     """Time ``fn`` with the reference's protocol: ``warmup`` untimed calls
     (absorbing XLA compilation), then ``repetitions`` timed calls; the
@@ -64,16 +67,38 @@ def measure(
 
     ``fn`` must block until its device work completes; wrap JAX work so it
     ends in ``jax.block_until_ready``. Use :func:`blocking` for that.
+
+    With the metrics registry enabled (``--metrics``), the warmup and
+    timed phases become ``<label>.warmup`` / ``<label>.timed`` spans and
+    every repetition lands in the ``<label>.rep_s`` histogram — the
+    per-phase attribution that separates compile-absorbing warmup from
+    the numbers a verdict consumes. Disabled (the default), this is the
+    identical code path as always: no spans, no records, no extra work.
     """
     if repetitions < 1:
         raise ValueError("repetitions must be >= 1")
-    for _ in range(warmup):
-        fn()
+    m = metricslib.get_metrics()
+    if not (m.enabled or m.mirror_traces):
+        for _ in range(warmup):
+            fn()
+        times = []
+        for _ in range(repetitions):
+            t0 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t0)
+        return TimingResult(tuple(_native_identity(times)))
+    with m.span(f"{label}.warmup", repetitions=warmup):
+        for _ in range(warmup):
+            fn()
+    hist = m.histogram(f"{label}.rep_s")
     times = []
-    for _ in range(repetitions):
-        t0 = time.perf_counter()
-        fn()
-        times.append(time.perf_counter() - t0)
+    with m.span(f"{label}.timed", repetitions=repetitions):
+        for _ in range(repetitions):
+            t0 = time.perf_counter()
+            fn()
+            dt = time.perf_counter() - t0
+            hist.observe(dt)
+            times.append(dt)
     return TimingResult(tuple(_native_identity(times)))
 
 
@@ -104,6 +129,7 @@ def measure_forced(
     *,
     repetitions: int = 5,
     warmup: int = 1,
+    label: str = "measure_forced",
 ) -> TimingResult:
     """Like :func:`measure`, but forces completion by reading the result
     back to the host (``np.asarray``).
@@ -118,7 +144,8 @@ def measure_forced(
     def forced():
         np.asarray(fn())
 
-    return measure(forced, repetitions=repetitions, warmup=warmup)
+    return measure(forced, repetitions=repetitions, warmup=warmup,
+                   label=label)
 
 
 def amortized_seconds(
@@ -128,6 +155,7 @@ def amortized_seconds(
     repetitions: int = 5,
     warmup: int = 1,
     base_iters: int = 1,
+    label: str = "amortized",
 ) -> float:
     """Per-iteration device time via differencing: run the workload with
     ``iters`` internal repetitions and with ``base_iters``, both
@@ -151,11 +179,12 @@ def amortized_seconds(
     if not 1 <= base_iters < iters:
         raise ValueError(f"need 1 <= base_iters < iters, got {base_iters}")
     t_many = measure_forced(
-        lambda: run_with_iters(iters), repetitions=repetitions, warmup=warmup
+        lambda: run_with_iters(iters), repetitions=repetitions, warmup=warmup,
+        label=f"{label}.many",
     ).min_s
     t_base = measure_forced(
         lambda: run_with_iters(base_iters), repetitions=repetitions,
-        warmup=warmup
+        warmup=warmup, label=f"{label}.base",
     ).min_s
     return max(t_many - t_base, 0.0) / (iters - base_iters)
 

@@ -38,6 +38,7 @@ oracle on every run.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from functools import partial
 
@@ -46,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from hpc_patterns_tpu.harness import metrics as metricslib
 from hpc_patterns_tpu.models.decode import (
     init_paged_cache,
     paged_decode_step,
@@ -57,10 +59,12 @@ from hpc_patterns_tpu.models.transformer import TransformerConfig
 @dataclass
 class Request:
     """One sequence to serve: ``prompt`` (T,) int32, up to ``max_new``
-    generated tokens (fewer if ``eos_id`` fires)."""
+    generated tokens (fewer if ``eos_id`` fires). ``t_submit`` stamps
+    queue entry so admission can attribute time-to-first-token."""
     prompt: np.ndarray
     max_new: int
     seq_id: int = -1
+    t_submit: float = 0.0
 
 
 @dataclass
@@ -70,6 +74,7 @@ class _Slot:
     prompt_len: int = 0
     out: list = field(default_factory=list)
     active: bool = False
+    t_admit: float = 0.0
 
 
 @partial(jax.jit, static_argnames=("cfg", "chunk", "eos_id", "mesh"),
@@ -125,6 +130,11 @@ def _spec_chunk(params, dparams, cache, dcache, pos, limit, cur, *,
 
     B = pos.shape[0]
     rows = jnp.arange(B)
+    # the engine serves greedily (greedy=True below): paged_round never
+    # reads the key or temperature on that path — these are inert
+    # placeholders filling its sampling signature, NOT live sampling
+    inert_greedy_key = jax.random.PRNGKey(0)
+    inert_temperature = jnp.float32(1.0)
 
     def one_round(carry, _):
         cache, dcache, pos, limit, cur = carry
@@ -132,7 +142,7 @@ def _spec_chunk(params, dparams, cache, dcache, pos, limit, cur, *,
         pos_eff = jnp.where(active, pos, 0)
         cache, dcache, a, emit, _ = paged_round(
             params, cfg, dparams, dcfg, cache, dcache, pos_eff, cur,
-            gamma, jax.random.PRNGKey(0), True, 0, jnp.float32(1.0),
+            gamma, inert_greedy_key, True, 0, inert_temperature,
             mesh=mesh)
         adv = jnp.where(active,
                         jnp.minimum(a + 1, limit - pos), 0)
@@ -296,7 +306,10 @@ class ContinuousBatcher:
                 "would silently merge under one key"
             )
         self._next_id = max(self._next_id, sid) + 1
-        self._queue.append(Request(prompt, max_new, sid))
+        self._queue.append(Request(prompt, max_new, sid,
+                                   t_submit=time.perf_counter()))
+        metricslib.get_metrics().gauge("serve.queue_depth").set(
+            len(self._queue))
         return sid
 
     def _try_admit(self) -> bool:
@@ -333,10 +346,11 @@ class ContinuousBatcher:
         # _prefill_one donates its table — an alias would delete the
         # engine's live table with it
         one["table"] = jnp.asarray(self._table[slot:slot + 1])
-        logits, out = _prefill_one(
-            self.params, jnp.asarray(req.prompt)[None, :], one,
-            cfg=self.cfg, page_size=self.page_size, mesh=self.mesh,
-        )
+        with metricslib.span("serve.prefill", prompt_len=T):
+            logits, out = _prefill_one(
+                self.params, jnp.asarray(req.prompt)[None, :], one,
+                cfg=self.cfg, page_size=self.page_size, mesh=self.mesh,
+            )
         for k, v in out.items():
             if k != "table":
                 self.cache[k] = v
@@ -356,10 +370,20 @@ class ContinuousBatcher:
         st = self._slots[slot]
         st.seq_id, st.pages, st.prompt_len = req.seq_id, pages, T
         st.out, st.active = [first], True
+        st.t_admit = time.perf_counter()
         self._emit(kind="serve_admit", seq_id=req.seq_id, slot=slot,
                    pages=need, prompt_len=T, budget=req.max_new,
                    free_pages=len(self.free_pages),
                    queued=len(self._queue))
+        m = metricslib.get_metrics()
+        if m.enabled:
+            # prefill emitted the first token: admit time IS first-token
+            # time for this engine (TTFT counted from submit)
+            m.histogram("serve.ttft_s").observe(
+                st.t_admit - (req.t_submit or st.t_admit))
+            m.gauge("serve.queue_depth").set(len(self._queue))
+            m.gauge("serve.free_pages").set(len(self.free_pages))
+            m.counter("serve.admitted").inc()
         self.pos = self.pos.at[slot].set(T)
         done = (self.eos_id >= 0 and first == self.eos_id) or req.max_new == 1
         self.limit = self.limit.at[slot].set(
@@ -375,6 +399,15 @@ class ContinuousBatcher:
         self.finished[st.seq_id] = np.asarray(st.out, np.int32)
         self._emit(kind="serve_finish", seq_id=st.seq_id, slot=slot,
                    tokens=len(st.out), pages_freed=len(st.pages))
+        m = metricslib.get_metrics()
+        if m.enabled:
+            dt = time.perf_counter() - st.t_admit
+            m.histogram("serve.per_token_s").observe(
+                dt / max(1, len(st.out)))
+            m.counter("serve.finished").inc()
+            m.counter("serve.tokens").inc(len(st.out))
+            m.gauge("serve.free_pages").set(
+                len(self.free_pages) + len(st.pages))
         self.free_pages.extend(st.pages)
         self._table[slot] = self.trash
         self.cache["table"] = jnp.asarray(self._table)
@@ -388,12 +421,13 @@ class ContinuousBatcher:
 
     def _run_chunk(self):
         pos_start = np.asarray(self.pos)
-        self.cache, self.pos, self.limit, self.tokens, out = _chunk_step(
-            self.params, self.cache, self.pos, self.limit, self.tokens,
-            cfg=self.cfg, chunk=self.chunk, eos_id=self.eos_id,
-            mesh=self.mesh,
-        )
-        out = np.asarray(out)  # (chunk, slots)
+        with metricslib.span("serve.decode_round", chunk=self.chunk):
+            self.cache, self.pos, self.limit, self.tokens, out = _chunk_step(
+                self.params, self.cache, self.pos, self.limit, self.tokens,
+                cfg=self.cfg, chunk=self.chunk, eos_id=self.eos_id,
+                mesh=self.mesh,
+            )
+            out = np.asarray(out)  # (chunk, slots); readback closes the span
         limit_new = np.asarray(self.limit)
         for i, st in enumerate(self._slots):
             if not st.active:
@@ -411,15 +445,17 @@ class ContinuousBatcher:
         caches' stale rows get overwritten when the cursor re-crosses
         them (the speculative invariant). The host just appends each
         round's valid tokens and finishes exhausted rows."""
-        (self.cache, self.dcache, self.pos, self.limit, self.tokens,
-         emits, advs) = _spec_chunk(
-            self.params, self.draft_params, self.cache, self.dcache,
-            self.pos, self.limit, self.tokens,
-            cfg=self.cfg, dcfg=self.draft_cfg, gamma=self.gamma,
-            rounds=self.chunk, eos_id=self.eos_id, mesh=self.mesh,
-        )
-        emits = np.asarray(emits)  # (rounds, slots, gamma+1)
-        advs = np.asarray(advs)    # (rounds, slots)
+        with metricslib.span("serve.spec_round", rounds=self.chunk,
+                             gamma=self.gamma):
+            (self.cache, self.dcache, self.pos, self.limit, self.tokens,
+             emits, advs) = _spec_chunk(
+                self.params, self.draft_params, self.cache, self.dcache,
+                self.pos, self.limit, self.tokens,
+                cfg=self.cfg, dcfg=self.draft_cfg, gamma=self.gamma,
+                rounds=self.chunk, eos_id=self.eos_id, mesh=self.mesh,
+            )
+            emits = np.asarray(emits)  # (rounds, slots, gamma+1)
+            advs = np.asarray(advs)    # (rounds, slots)
         pos_np = np.asarray(self.pos)
         limit_np = np.asarray(self.limit)
         for i, st in enumerate(self._slots):

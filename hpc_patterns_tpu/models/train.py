@@ -24,8 +24,32 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
+from hpc_patterns_tpu.harness import metrics as metricslib
 from hpc_patterns_tpu.models import sharding as shardlib
 from hpc_patterns_tpu.models.transformer import TransformerConfig, init_params, loss_fn
+
+
+def record_step_metrics(step: int, loss: float, dt_s: float,
+                        tokens: int) -> None:
+    """Per-step training telemetry into the process-wide registry
+    (harness/metrics.py; no-op when disabled): loss/step-time/throughput
+    gauges, a step-time histogram split by phase — step 0 is the
+    compile-dominated step, so it lands in a ``train.compile_s`` gauge
+    instead of polluting the steady-state ``train.step_s`` percentiles
+    (the warmup-vs-timed discipline of harness.timing applied to the
+    training loop)."""
+    m = metricslib.get_metrics()
+    if not m.enabled:
+        return
+    m.counter("train.steps").inc()
+    m.gauge("train.loss").set(loss)
+    m.gauge("train.step_time_s").set(dt_s)
+    if dt_s > 0:
+        m.gauge("train.tokens_per_s").set(tokens / dt_s)
+    if step == 0:
+        m.gauge("train.compile_s").set(dt_s)
+    else:
+        m.histogram("train.step_s").observe(dt_s)
 
 
 def make_optimizer(learning_rate: float = 3e-4, weight_decay: float = 0.01,

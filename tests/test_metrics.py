@@ -1,0 +1,257 @@
+"""Tests for the metrics/span registry (harness/metrics.py).
+
+The observability contract: percentiles survive JSON round-trips
+through RunLog (the fixed-bucket guarantee), spans nest and attribute
+wall time per phase, and the disabled registry is a true no-op —
+zero records, identical timing code path (the tier-1 protection).
+"""
+
+import json
+import math
+import time
+
+import pytest
+
+from hpc_patterns_tpu.harness import metrics as metricslib
+from hpc_patterns_tpu.harness.metrics import (
+    Histogram,
+    Metrics,
+    bucket_index,
+    bucket_value,
+)
+from hpc_patterns_tpu.harness.runlog import RunLog
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    # never leak enablement into other tests: the suite's default is
+    # the disabled registry (the production default)
+    yield
+    metricslib.configure(enabled=False)
+
+
+class TestHistogram:
+    def test_bucket_layout_roundtrip(self):
+        # every bucket's representative value maps back to its bucket
+        for i in range(0, metricslib.N_BUCKETS):
+            assert bucket_index(bucket_value(i)) == i
+
+    def test_observe_and_percentiles(self):
+        h = Histogram()
+        for v in [0.001] * 50 + [0.01] * 45 + [0.1] * 5:
+            h.observe(v)
+        assert h.count == 100
+        assert h.min == 0.001 and h.max == 0.1
+        # p50 in the 1ms bucket, p95 in the 10ms bucket, p100 == max
+        assert h.percentile(50) == bucket_value(bucket_index(0.001))
+        assert h.percentile(95) == bucket_value(bucket_index(0.01))
+        assert h.percentile(100) == 0.1
+
+    def test_percentile_clamps_to_observed_range(self):
+        # single sample: every percentile is that sample exactly (the
+        # clamp to [min, max]), not the bucket midpoint
+        h = Histogram()
+        h.observe(0.005)
+        for q in (0, 50, 100):
+            assert h.percentile(q) == 0.005
+
+    def test_empty_percentile_nan(self):
+        assert math.isnan(Histogram().percentile(50))
+
+    def test_out_of_range_values_clamp_to_end_buckets(self):
+        h = Histogram()
+        h.observe(1e-12)  # below the lowest decade
+        h.observe(1e9)    # above the highest
+        h.observe(0.0)    # nonpositive
+        assert h.count == 3
+        assert set(h.counts) == {0, metricslib.N_BUCKETS - 1}
+        assert h.min == 0.0 and h.max == 1e9
+
+    def test_snapshot_roundtrip_preserves_percentiles(self):
+        h = Histogram()
+        for v in (1e-6, 3e-6, 2e-3, 0.5, 0.5, 7.0):
+            h.observe(v)
+        # through actual JSON, as RunLog would write it
+        back = Histogram.from_snapshot(json.loads(json.dumps(h.snapshot())))
+        for q in (0, 25, 50, 75, 90, 95, 99, 100):
+            assert back.percentile(q) == h.percentile(q)
+        assert (back.count, back.sum, back.min, back.max) == (
+            h.count, h.sum, h.min, h.max)
+
+    def test_merge(self):
+        a, b = Histogram(), Histogram()
+        for v in (0.001, 0.002):
+            a.observe(v)
+        for v in (0.1, 0.2):
+            b.observe(v)
+        a.merge(b)
+        assert a.count == 4
+        assert a.min == 0.001 and a.max == 0.2
+        assert a.sum == pytest.approx(0.303)
+
+
+class TestRegistry:
+    def test_counter_gauge(self):
+        m = Metrics(enabled=True)
+        m.counter("c").inc()
+        m.counter("c").inc(4)
+        m.gauge("g").set(2.0)
+        m.gauge("g").set(0.5)
+        snap = m.snapshot()
+        assert snap["counters"]["c"] == 5
+        assert snap["gauges"]["g"] == {
+            "last": 0.5, "min": 0.5, "max": 2.0, "n": 2}
+
+    def test_span_records_and_nests(self):
+        m = Metrics(enabled=True)
+        with m.span("outer"):
+            with m.span("inner"):
+                time.sleep(0.001)
+        snap = m.snapshot()
+        assert set(snap["histograms"]) == {"span.outer",
+                                           "span.outer/inner"}
+        inner = snap["histograms"]["span.outer/inner"]
+        outer = snap["histograms"]["span.outer"]
+        assert inner["count"] == outer["count"] == 1
+        assert outer["max"] >= inner["max"] >= 0.001
+
+    def test_span_stack_survives_exceptions(self):
+        m = Metrics(enabled=True)
+        with pytest.raises(RuntimeError):
+            with m.span("a"):
+                raise RuntimeError("boom")
+        with m.span("b"):
+            pass
+        # "a" popped despite the exception: "b" is NOT nested under it
+        assert set(m.snapshot()["histograms"]) == {"span.a", "span.b"}
+
+    def test_disabled_registry_is_noop(self):
+        m = Metrics(enabled=False)
+        m.counter("c").inc()
+        m.gauge("g").set(1.0)
+        m.histogram("h").observe(1.0)
+        with m.span("s"):
+            pass
+        snap = m.snapshot()
+        assert snap["counters"] == {}
+        assert snap["gauges"] == {}
+        assert snap["histograms"] == {}
+
+    def test_disabled_span_is_shared_nullcontext(self):
+        m = Metrics(enabled=False)
+        # the no-op fast path allocates nothing per call
+        assert m.span("x") is m.span("y")
+
+    def test_nonfinite_values_stay_strict_json(self):
+        # a diverged loss is NaN; bare NaN tokens are invalid strict
+        # JSON, so the snapshot nulls them and histograms drop them
+        m = Metrics(enabled=True)
+        m.gauge("loss").set(math.nan)
+        m.histogram("h").observe(math.nan)
+        m.histogram("h").observe(0.5)
+        snap = m.snapshot()
+        json.dumps(snap, allow_nan=False)  # raises on NaN/Infinity
+        assert snap["gauges"]["loss"]["last"] is None
+        assert snap["histograms"]["h"]["count"] == 1
+
+    def test_configure_installs_fresh_registry(self):
+        m1 = metricslib.configure(enabled=True)
+        m1.counter("c").inc()
+        m2 = metricslib.configure(enabled=True)
+        assert metricslib.get_metrics() is m2
+        assert m2.snapshot()["counters"] == {}
+
+
+class TestTimingIntegration:
+    def test_measure_disabled_records_nothing(self):
+        from hpc_patterns_tpu.harness.timing import measure
+
+        m = metricslib.configure(enabled=False)
+        r = measure(lambda: None, repetitions=3, warmup=1)
+        assert len(r.times_s) == 3
+        assert m.snapshot()["histograms"] == {}
+
+    def test_measure_enabled_reports_phases(self):
+        from hpc_patterns_tpu.harness.timing import measure
+
+        m = metricslib.configure(enabled=True)
+        r = measure(lambda: time.sleep(0.0005), repetitions=4, warmup=2,
+                    label="unit")
+        assert len(r.times_s) == 4
+        snap = m.snapshot()
+        # warmup-vs-timed phase attribution + the per-rep histogram
+        assert snap["histograms"]["span.unit.warmup"]["count"] == 1
+        assert snap["histograms"]["span.unit.timed"]["count"] == 1
+        assert snap["histograms"]["unit.rep_s"]["count"] == 4
+        # the rep histogram's p100 is the slowest rep, exactly
+        back = Histogram.from_snapshot(snap["histograms"]["unit.rep_s"])
+        assert back.percentile(100) == max(r.times_s)
+
+    def test_train_step_metrics_phases(self):
+        from hpc_patterns_tpu.models.train import record_step_metrics
+
+        m = metricslib.configure(enabled=True)
+        record_step_metrics(0, 6.9, 2.0, 1024)   # compile step
+        record_step_metrics(1, 5.0, 0.01, 1024)  # steady
+        record_step_metrics(2, 4.0, 0.01, 1024)
+        snap = m.snapshot()
+        assert snap["counters"]["train.steps"] == 3
+        assert snap["gauges"]["train.compile_s"]["last"] == 2.0
+        # compile excluded from the steady-state histogram
+        assert snap["histograms"]["train.step_s"]["count"] == 2
+        assert snap["gauges"]["train.loss"]["last"] == 4.0
+
+    def test_record_collective_bandwidth(self):
+        from hpc_patterns_tpu.comm.communicator import (
+            record_collective_bandwidth,
+        )
+
+        m = metricslib.configure(enabled=True)
+        record_collective_bandwidth("allreduce.ring", 10**9, 0.5,
+                                    busbw_gbps=1.75)
+        snap = m.snapshot()
+        assert snap["gauges"]["comm.allreduce.ring.bandwidth_gbps"][
+            "last"] == pytest.approx(2.0)
+        assert snap["gauges"]["comm.allreduce.ring.busbw_gbps"][
+            "last"] == 1.75
+        assert snap["histograms"]["comm.allreduce.ring.s"]["count"] == 1
+        # disabled (and degenerate-time) calls record nothing
+        m = metricslib.configure(enabled=False)
+        record_collective_bandwidth("pingpong", 10**9, 0.5)
+        assert m.snapshot()["gauges"] == {}
+
+
+class TestRunLogIntegration:
+    def test_snapshot_roundtrips_through_runlog(self, tmp_path, capsys):
+        from hpc_patterns_tpu.harness import report
+
+        m = metricslib.configure(enabled=True)
+        hist = m.histogram("lat_s")
+        for v in (0.001, 0.002, 0.004, 0.008, 0.5):
+            hist.observe(v)
+        m.counter("reqs").inc(5)
+        log = RunLog(tmp_path / "run.jsonl")
+        log.emit(kind="metrics", **m.snapshot())
+        agg = report.aggregate(
+            report.load_records([tmp_path / "run.jsonl"]))
+        merged = agg["histograms"]["lat_s"]
+        for q in (50, 95, 100):
+            assert merged.percentile(q) == hist.percentile(q)
+        assert agg["counters"]["reqs"] == 5
+        capsys.readouterr()
+
+    def test_runlog_append_mode_preserves_prior_records(self, tmp_path):
+        # the harness-owns-the-log protocol: an app invoked with
+        # --log-append (truncate=False) must not clobber the harness's
+        # earlier records; the default truncates (one log per run)
+        path = tmp_path / "shared.jsonl"
+        RunLog(path).emit(kind="result", name="harness", success=True)
+        RunLog(path, truncate=False).emit(kind="result", name="app",
+                                          success=True)
+        names = [json.loads(l)["name"]
+                 for l in path.read_text().splitlines()]
+        assert names == ["harness", "app"]
+        RunLog(path).emit(kind="result", name="fresh", success=True)
+        names = [json.loads(l)["name"]
+                 for l in path.read_text().splitlines()]
+        assert names == ["fresh"]

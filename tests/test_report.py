@@ -1,0 +1,165 @@
+"""Report CLI smoke (harness/report.py) + the no-op guard.
+
+The fixture is a checked-in two-snapshot sweep log
+(tests/fixtures/report_fixture.jsonl) with known bucket counts, so the
+aggregation rules — counters sum, gauges last-wins with min/max across
+snapshots, histograms merge — are pinned against a stable input, and a
+bucket-layout change cannot slip through unnoticed.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from hpc_patterns_tpu.harness import metrics as metricslib
+from hpc_patterns_tpu.harness import report
+from hpc_patterns_tpu.harness.metrics import bucket_index, bucket_value
+
+FIXTURE = Path(__file__).parent / "fixtures" / "report_fixture.jsonl"
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    yield
+    metricslib.configure(enabled=False)
+
+
+class TestReportFixture:
+    def test_aggregate_merges_snapshots(self):
+        agg = report.aggregate(report.load_records([FIXTURE]))
+        assert agg["n_snapshots"] == 2
+        assert agg["results"] == (1, 1)
+        # counters sum across snapshots
+        assert agg["counters"]["train.steps"] == 30
+        # gauges: last value from the later snapshot, min/max across
+        g = agg["gauges"]["train.loss"]
+        assert g.last == 3.2 and g.min == 3.2 and g.max == 6.9
+        # histograms merge bucket counts: 50x1ms + 45x10ms + 5x100ms
+        h = agg["histograms"]["span.measure.timed"]
+        assert h.count == 100
+        assert h.percentile(50) == bucket_value(bucket_index(0.001))
+        assert h.percentile(95) == bucket_value(bucket_index(0.01))
+        assert h.percentile(100) == 0.1
+
+    def test_cli_smoke(self, capsys):
+        rc = report.main([str(FIXTURE)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "merged 2 metrics snapshot(s)" in out
+        assert "1 SUCCESS / 1 FAILURE" in out
+        assert "train.steps" in out and "30" in out
+        assert "span.measure.timed" in out
+
+    def test_cli_no_metrics_records(self, tmp_path, capsys):
+        # a plain runlog (no --metrics run) still gets a result summary
+        path = tmp_path / "plain.jsonl"
+        path.write_text(json.dumps(
+            {"kind": "result", "name": "x", "success": True}) + "\n")
+        rc = report.main([str(path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "no kind=metrics snapshots" in out
+
+    def test_cli_empty_input_fails(self, tmp_path, capsys):
+        path = tmp_path / "empty.jsonl"
+        path.write_text("")
+        assert report.main([str(path)]) == 2
+        capsys.readouterr()
+
+    def test_layout_mismatch_skips_histograms(self, tmp_path, capsys):
+        # a snapshot written under a different bucket layout cannot have
+        # its bucket counts merged (indices mean different values);
+        # counters/gauges are layout-independent and still merge
+        records = report.load_records([FIXTURE])
+        old = json.loads(json.dumps(
+            next(r for r in records if r.get("kind") == "metrics")))
+        old["bucket_layout"] = {"lo_decade": -6, "hi_decade": 3,
+                                "per_decade": 8}
+        path = tmp_path / "mixed.jsonl"
+        path.write_text("".join(json.dumps(r) + "\n"
+                                for r in records + [old]))
+        agg = report.aggregate(report.load_records([path]))
+        assert agg["n_snapshots"] == 3
+        assert agg["n_layout_skipped"] == 1
+        # histograms hold only the two current-layout snapshots
+        assert agg["histograms"]["span.measure.timed"].count == 100
+        # counters still summed across all three
+        assert agg["counters"]["train.steps"] == 30 + old["counters"][
+            "train.steps"]
+        assert "different bucket layout" in report.format_report(agg)
+        capsys.readouterr()
+
+    def test_load_records_skips_truncated_line(self, tmp_path):
+        # a crashed run can truncate its final record mid-write
+        path = tmp_path / "torn.jsonl"
+        path.write_text(json.dumps({"kind": "result", "success": True})
+                        + '\n{"kind": "metr')
+        records = report.load_records([path])
+        assert len(records) == 1
+
+
+class TestNoopGuard:
+    def test_disabled_metrics_add_zero_records(self, tmp_path, capsys):
+        """The tier-1 protection: without --metrics, an instrumented
+        run writes exactly the records it always wrote — the registry
+        is inert and no kind=metrics snapshot appears."""
+        from hpc_patterns_tpu.harness.runlog import RunLog
+        from hpc_patterns_tpu.harness.timing import measure
+        from hpc_patterns_tpu.models.train import record_step_metrics
+
+        m = metricslib.configure(enabled=False)
+        log = RunLog(tmp_path / "run.jsonl")
+        measure(lambda: None, repetitions=2, warmup=1, label="guard")
+        record_step_metrics(0, 1.0, 0.1, 64)
+        with metricslib.span("phase"):
+            pass
+        log.emit(kind="result", name="guard", success=True)
+        records = [json.loads(l) for l in
+                   (tmp_path / "run.jsonl").read_text().splitlines()]
+        assert [r["kind"] for r in records] == ["result"]
+        snap = m.snapshot()
+        assert snap["counters"] == snap["gauges"] == snap["histograms"] == {}
+        capsys.readouterr()
+
+    def test_run_instrumented_disabled_emits_nothing(self, tmp_path):
+        import argparse
+
+        from hpc_patterns_tpu.apps import common
+        from hpc_patterns_tpu.harness.runlog import RunLog
+
+        path = tmp_path / "app.jsonl"
+        args = argparse.Namespace(metrics=False, log=str(path))
+
+        def fake_app(a):
+            RunLog(a.log).emit(kind="result", name="app", success=True)
+            return 0
+
+        assert common.run_instrumented(fake_app, args) == 0
+        kinds = [json.loads(l)["kind"]
+                 for l in path.read_text().splitlines()]
+        assert kinds == ["result"]
+
+    def test_run_instrumented_enabled_appends_snapshot(self, tmp_path):
+        import argparse
+
+        from hpc_patterns_tpu.apps import common
+        from hpc_patterns_tpu.harness.runlog import RunLog
+
+        path = tmp_path / "app.jsonl"
+        args = argparse.Namespace(metrics=True, log=str(path))
+
+        def fake_app(a):
+            log = RunLog(a.log)
+            metricslib.get_metrics().counter("app.work").inc(7)
+            log.emit(kind="result", name="app", success=True)
+            return 0
+
+        assert common.run_instrumented(fake_app, args) == 0
+        records = [json.loads(l)
+                   for l in path.read_text().splitlines()]
+        assert [r["kind"] for r in records] == ["result", "metrics"]
+        assert records[1]["counters"]["app.work"] == 7
+        # and report aggregates the app log end to end
+        agg = report.aggregate(records)
+        assert agg["counters"]["app.work"] == 7
