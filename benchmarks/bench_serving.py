@@ -1,23 +1,37 @@
 """Continuous batching vs static batching: serving throughput.
 
 Usage: python benchmarks/bench_serving.py [--n=N] [--slots=S] [--chunk=K]
+         [--mix=0|1] [--buckets=auto|none|16,32,...] [--overlap=0|1]
+         [--temp=T] [--topk=K] [--smoke]
 
-The capacity story measured: a stream of N requests with VARIED
-generation budgets served (a) statically — batches of ``slots`` rows
-padded to the longest budget in the batch, every row paying the
-longest row's wall clock — vs (b) the ContinuousBatcher, where a
-finished row's pages free immediately and the next request enters at
-the following chunk boundary.
+The capacity story measured on the REALISTIC stream: N requests with
+VARIED prompt lengths (``--mix``, default on) and varied generation
+budgets, served (a) statically — batches of ``slots`` rows in arrival
+order, rows grouped by prompt length into rectangular sub-batches
+(fragmentation), every row paying the longest budget in its batch
+(padding) — vs (b) the ContinuousBatcher with the production levers
+on: prompt-length BUCKETING (admission prefill compiles bounded by the
+ladder size, not the stream's distinct lengths) and OVERLAPPED
+admission (prefills enqueue behind the in-flight decode chunk).
+
+Reported per engine run: tokens/s, the admission-bubble fraction
+(host admission time exposed with no decode in flight), and the
+prefill compile count with the ladder bound it must respect.
 
 Oracle on every run (benchmark-IS-the-test): the engine's per-sequence
-tokens must equal standalone paged_generate before any number is
-reported. Prints one summary line per mode plus the ratio.
+tokens must equal standalone paged_generate — same per-request key in
+sampled mode — before any number is reported, and the compile count
+must not exceed the bucket ladder size.
+
+``--smoke``: the CI shape (seconds on the 8-device CPU mesh) —
+tests/test_bench_serving.py runs it in tier-1 and asserts the engine
+beats static on the mixed workload.
 
 On-chip protocol note: the engine's host loop pays a tunnel round trip
 per chunk; ``--chunk`` amortizes it (the dispatch-amortization
-discipline of benchmarks/bench_decode.py). Static batching runs its
-whole scan in one dispatch — the comparison is honest serving reality
-for both.
+discipline of benchmarks/bench_decode.py). Static batching runs each
+sub-batch's whole scan in one dispatch — the comparison is honest
+serving reality for both.
 """
 
 import os
@@ -33,100 +47,227 @@ import jax.numpy as jnp
 
 from hpc_patterns_tpu.models import TransformerConfig
 from hpc_patterns_tpu.models.decode import paged_generate
-from hpc_patterns_tpu.models.serving import ContinuousBatcher
+from hpc_patterns_tpu.models.serving import (
+    ContinuousBatcher,
+    pad_to_bucket,
+    prefill_cache_size,
+)
 from hpc_patterns_tpu.models.transformer import init_params
 
 
 def arg(name, default, cast=int):
     for a in sys.argv[1:]:
         if a.startswith(f"--{name}="):
-            return cast(a.split("=", 1)[1])
+            v = a.split("=", 1)[1]
+            if cast is bool:  # bool("0") is True; parse it properly
+                return v.lower() not in ("0", "false", "no", "")
+            return cast(v)
+        if a == f"--{name}":
+            if cast is not bool:
+                raise SystemExit(
+                    f"--{name} needs =VALUE (space-separated values "
+                    "are not supported by this parser)")
+            return True
     return default
 
 
-def main():
-    on_tpu = jax.default_backend() == "tpu"
-    n = arg("n", 32 if on_tpu else 6)
-    slots = arg("slots", 8 if on_tpu else 2)
-    chunk = arg("chunk", 16 if on_tpu else 4)
-    page_size = arg("page", 256 if on_tpu else 8)
-    prompt_len = arg("prompt", 512 if on_tpu else 8)
-    max_budget = arg("budget", 512 if on_tpu else 10)
-    cfg = TransformerConfig(
-        vocab=arg("vocab", 32768 if on_tpu else 64),
-        d_model=arg("d", 1024 if on_tpu else 32),
-        n_heads=arg("heads", 8 if on_tpu else 4),
-        n_layers=arg("layers", 8 if on_tpu else 2),
-        d_ff=arg("ff", 4096 if on_tpu else 64),
-        max_seq=prompt_len + max_budget,
-        dtype="bfloat16" if on_tpu else "float32",
-        kv_cache_dtype=arg("cache", "compute", str),
-    )
-    params = init_params(jax.random.PRNGKey(0), cfg)
+def run_bench(*, n, slots, chunk, page_size, prompt_len, max_budget,
+              cfg, params, mix=True, buckets="auto", overlap=True,
+              temperature=0.0, top_k=0, seed=0, reps=1, quiet=False):
+    """One engine-vs-static comparison; returns the metrics dict.
+    ``buckets``: 'auto' (ladder over prompt_len), 'none', or a tuple.
+    ``reps``: timed repetitions per mode, MIN taken — the shared-host
+    CI box is noisy and min-of-reps is the standard load-spike shield.
+    Raises AssertionError if the oracle or the compile bound fails."""
+    out = print if not quiet else (lambda *a, **k: None)
+    if isinstance(buckets, str):
+        # 'auto' / 'none' / '8,16,32' — the same resolver the CLI
+        # serving surfaces use (harness.cli)
+        from hpc_patterns_tpu.harness.cli import parse_buckets
+
+        buckets = parse_buckets(buckets, prompt_len)
     rng = np.random.RandomState(7)
-    # budgets spread 1/4..4/4 of max: the static batch pays max, the
-    # engine pays each row's own
+    # the production-shaped stream: prompt lengths spread 1/2..1x, and
+    # LONG-TAIL budgets (most requests short, a fifth at the max) —
+    # static pays fragmentation (rectangular length groups) AND padding
+    # (every row pays its batch's longest budget, usually the max);
+    # the engine pays each row's own length and budget
+    lengths = ([prompt_len // 2, (3 * prompt_len) // 4, prompt_len]
+               if mix else [prompt_len])
     reqs = []
     for _ in range(n):
-        prompt = rng.randint(0, cfg.vocab, size=prompt_len).astype(np.int32)
-        budget = int(rng.choice([max(1, max_budget // 4),
-                                 max(1, max_budget // 2), max_budget]))
+        t = int(rng.choice(lengths))
+        prompt = rng.randint(0, cfg.vocab, size=t).astype(np.int32)
+        budget = int(rng.choice(
+            [max(1, max_budget // 8), max(1, max_budget // 4),
+             max_budget],
+            p=[0.5, 0.3, 0.2]))
         reqs.append((prompt, budget))
-    pages_per_seq = -(-(prompt_len + max_budget) // page_size)
     total_tokens = sum(b for _, b in reqs)
 
-    # --- static batching: group into batches of `slots`, pad budgets to
-    # the batch max (the whole batch runs the longest row's scan)
+    pages_per_seq = max(
+        ContinuousBatcher.pages_needed(len(p), b, page_size,
+                                       padded_len=pad_to_bucket(
+                                           buckets, len(p)))
+        for p, b in reqs)
+
+    # --- static batching: batches of `slots` in arrival order; rows
+    # group by prompt length into rectangular sub-batches, every row
+    # pays the batch-max budget
     def run_static():
         outs = {}
         for i in range(0, n, slots):
             batch = reqs[i:i + slots]
-            prompts = jnp.asarray(np.stack([p for p, _ in batch]))
             run_len = max(b for _, b in batch)
-            toks = paged_generate(params, prompts, cfg, run_len,
-                                  page_size=page_size)
-            toks = np.asarray(toks)
-            for j, (_, b) in enumerate(batch):
-                outs[i + j] = toks[j, :b]
+            bylen = {}
+            for j, (p, b) in enumerate(batch):
+                bylen.setdefault(len(p), []).append((i + j, p, b))
+            for group in bylen.values():
+                prompts = jnp.asarray(np.stack([p for _, p, _ in group]))
+                toks = np.asarray(paged_generate(
+                    params, prompts, cfg, run_len, page_size=page_size))
+                for j, (idx, _, b) in enumerate(group):
+                    outs[idx] = toks[j, :b]
         return outs
 
-    def run_engine():
-        eng = ContinuousBatcher(
+    def make_engine():
+        return ContinuousBatcher(
             params, cfg, slots=slots, pool_pages=slots * pages_per_seq,
-            pages_per_seq=pages_per_seq, page_size=page_size, chunk=chunk,
+            pages_per_seq=pages_per_seq, page_size=page_size,
+            chunk=chunk, prompt_buckets=buckets, overlap=overlap,
+            temperature=temperature, top_k=top_k, seed=seed,
         )
+
+    def run_engine():
+        eng = make_engine()
         ids = [eng.submit(p, b) for p, b in reqs]
         got = eng.run()
-        return {i: got[sid] for i, sid in enumerate(ids)}
+        return {i: got[sid] for i, sid in enumerate(ids)}, eng
 
     # warmup (compiles) then timed runs
-    for fn in (run_static, run_engine):
-        fn()
-    t0 = time.perf_counter()
-    static_out = run_static()
-    t_static = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    engine_out = run_engine()
-    t_engine = time.perf_counter() - t0
+    compiles_before = prefill_cache_size()  # other engines, this process
+    run_static()
+    run_engine()
+    compiles_warm = prefill_cache_size()
+    t_static = t_engine = float("inf")
+    bubble = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        static_out = run_static()
+        t_static = min(t_static, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        engine_out, eng = run_engine()
+        te = time.perf_counter() - t0
+        if te < t_engine:
+            # keep the bubble fraction of the rep whose time is
+            # reported — mixing min-time with another rep's bubble
+            # would pair numbers from different runs
+            t_engine, bubble = te, eng.last_bubble_frac
+    compiles = prefill_cache_size()
 
-    # oracle before any number is believed
+    # oracle before any number is believed: engine rows standalone-exact
+    # (same per-request key when sampling), compile count inside the
+    # ladder bound, and a WARM engine added no prefill compiles at all
     for i, (prompt, b) in enumerate(reqs):
         want = np.asarray(paged_generate(
             params, jnp.asarray(prompt)[None], cfg, b,
-            page_size=page_size))[0]
+            page_size=page_size,
+            key=eng.request_key(i) if temperature > 0 else None,
+            temperature=temperature, top_k=top_k))[0]
         np.testing.assert_array_equal(engine_out[i], want,
                                       err_msg=f"engine seq {i}")
-        np.testing.assert_array_equal(static_out[i], want[:len(static_out[i])],
-                                      err_msg=f"static seq {i}")
-    print(f"serving: n={n} slots={slots} chunk={chunk} "
-          f"prompt={prompt_len} budgets<=%d tokens={total_tokens}"
-          % max_budget)
-    print(f"  static  : {t_static:.3f}s  "
-          f"{total_tokens / t_static:,.1f} tok/s")
-    print(f"  engine  : {t_engine:.3f}s  "
-          f"{total_tokens / t_engine:,.1f} tok/s")
-    print(f"  engine/static speedup: {t_static / t_engine:.3f}x "
-          "(oracle-exact)")
+        if temperature <= 0:
+            np.testing.assert_array_equal(
+                static_out[i], want[:len(static_out[i])],
+                err_msg=f"static seq {i}")
+    assert compiles == compiles_warm, (
+        f"warm engine recompiled prefill: {compiles_warm} -> {compiles}")
+    distinct = len({len(p) for p, _ in reqs})
+    compiles = compiles - compiles_before  # this bench's engine only
+    if buckets is not None:
+        assert compiles <= len(buckets), (
+            f"{compiles} prefill compiles > ladder size {len(buckets)}")
+
+    out(f"serving[{'mixed' if mix else 'uniform'}]: n={n} slots={slots} "
+        f"chunk={chunk} prompt<={prompt_len} ({distinct} lengths) "
+        f"budgets<={max_budget} tokens={total_tokens} "
+        f"buckets={buckets if buckets else 'off'} "
+        f"overlap={'on' if overlap else 'off'}")
+    out(f"  static  : {t_static:.3f}s  "
+        f"{total_tokens / t_static:,.1f} tok/s")
+    out(f"  engine  : {t_engine:.3f}s  "
+        f"{total_tokens / t_engine:,.1f} tok/s  "
+        f"bubble {bubble:.1%}  prefill compiles {compiles}"
+        f"{f' (ladder {len(buckets)})' if buckets else ''}")
+    out(f"  engine/static speedup: {t_static / t_engine:.3f}x "
+        "(oracle-exact)")
+    return {
+        "t_static": t_static, "t_engine": t_engine,
+        "tokens": total_tokens,
+        "tokens_per_s_static": total_tokens / t_static,
+        "tokens_per_s_engine": total_tokens / t_engine,
+        "speedup": t_static / t_engine,
+        "bubble_frac": bubble,
+        "prefill_compiles": compiles,
+        "ladder": len(buckets) if buckets else None,
+        "distinct_lengths": distinct,
+    }
+
+
+def smoke_config():
+    """The CI shape: a model big enough that DEVICE work (static's
+    padding + fragmentation waste vs the engine's own-budget rows)
+    dominates host dispatch on the 8-device CPU mesh, with the serving
+    gather route so neither side pays pallas interpret cost — ONE
+    definition shared by the CLI ``--smoke`` and the tier-1 pytest
+    (tests/test_bench_serving.py) so they cannot drift. Engine wins
+    ~2.5x here;
+    the pytest asserts > 1 with that margin as the noise shield."""
+    cfg = TransformerConfig(
+        vocab=256, d_model=256, n_heads=4, n_layers=2, d_ff=1024,
+        max_seq=256, dtype="float32", decode_attn="gather",
+    )
+    return dict(n=16, slots=4, chunk=16, page_size=16, prompt_len=32,
+                max_budget=192, reps=2, cfg=cfg,
+                params=init_params(jax.random.PRNGKey(0), cfg))
+
+
+def main():
+    if arg("smoke", False, bool):
+        run_bench(**smoke_config(),
+                  overlap=bool(arg("overlap", 1)),
+                  buckets=arg("buckets", "auto", str))
+        return
+    on_tpu = jax.default_backend() == "tpu"
+    n = arg("n", 32 if on_tpu else 16)
+    slots = arg("slots", 8 if on_tpu else 4)
+    chunk = arg("chunk", 16)
+    page_size = arg("page", 256 if on_tpu else 16)
+    prompt_len = arg("prompt", 512 if on_tpu else 32)
+    max_budget = arg("budget", 512 if on_tpu else 192)
+    cfg = TransformerConfig(
+        vocab=arg("vocab", 32768 if on_tpu else 256),
+        d_model=arg("d", 1024 if on_tpu else 256),
+        n_heads=arg("heads", 8 if on_tpu else 4),
+        n_layers=arg("layers", 8 if on_tpu else 2),
+        d_ff=arg("ff", 4096 if on_tpu else 1024),
+        max_seq=prompt_len + max_budget,
+        dtype="bfloat16" if on_tpu else "float32",
+        kv_cache_dtype=arg("cache", "compute", str),
+        # off-TPU the serving surfaces take the pure-XLA gather route:
+        # a pallas_call runs in interpret mode there, paying per-grid
+        # host cost that swamps both sides of the comparison
+        decode_attn="flash" if on_tpu else arg("attn", "gather", str),
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    run_bench(n=n, slots=slots, chunk=chunk, page_size=page_size,
+              prompt_len=prompt_len, max_budget=max_budget,
+              cfg=cfg, params=params,
+              mix=bool(arg("mix", 1)),
+              buckets=arg("buckets", "auto", str),
+              overlap=bool(arg("overlap", 1)),
+              temperature=arg("temp", 0.0, float),
+              top_k=arg("topk", 0))
 
 
 if __name__ == "__main__":

@@ -1,17 +1,23 @@
 """Serve app: continuous batching over the paged KV cache, validated.
 
 Completes the lifecycle triad's serving leg as a CLI: a stream of
-requests with varied prompt lengths and budgets served through
-models/serving.ContinuousBatcher (page free-list, admission as pages
-free, per-row completion), then EVERY sequence validated token-exact
-against its standalone ``paged_generate`` — the reference's
-benchmark-IS-the-test discipline (SURVEY.md §4: the binary measures
-its own claim and exits SUCCESS/FAILURE). Reports tokens/s and, with
+requests with varied prompt lengths (``--prompt-mix``) and budgets
+served through models/serving.ContinuousBatcher (page free-list,
+bucketed admission, overlapped prefill, per-row sampling), then EVERY
+sequence validated token-exact against its standalone
+``paged_generate`` — greedy AND sampled (per-request key streams keep
+sampled serving standalone-exact); draft-assisted sampling is the one
+law-only combination (its distribution oracle lives in
+tests/test_serving.py). The reference's benchmark-IS-the-test
+discipline (SURVEY.md §4: the binary measures its own claim and exits
+SUCCESS/FAILURE). Reports tokens/s, the admission-bubble fraction,
+and the prefill compile count (bounded by the bucket ladder); with
 ``--static-compare``, the static-batching baseline wall clock.
 """
 
 from __future__ import annotations
 
+import argparse
 import sys
 import time
 
@@ -24,12 +30,17 @@ from hpc_patterns_tpu import topology
 from hpc_patterns_tpu.apps import common
 from hpc_patterns_tpu.harness import RunLog, Verdict
 from hpc_patterns_tpu.harness import metrics as metricslib
-from hpc_patterns_tpu.harness.cli import base_parser
+from hpc_patterns_tpu.harness.cli import (
+    add_serving_args,
+    base_parser,
+    parse_buckets,
+)
 from hpc_patterns_tpu.models import TransformerConfig, init_params
 
 
 def build_parser():
     p = base_parser(__doc__.splitlines()[0])
+    add_serving_args(p)
     p.add_argument("--requests", type=int, default=8)
     p.add_argument("--slots", type=int, default=2,
                    help="concurrent rows in the pool")
@@ -38,6 +49,10 @@ def build_parser():
                    help="decode steps per jitted dispatch (admission "
                         "granularity)")
     p.add_argument("--prompt-len", type=int, default=12)
+    p.add_argument("--prompt-mix", action="store_true",
+                   help="vary prompt lengths 1/2..1x of --prompt-len "
+                        "(the mixed-length stream the bucket ladder "
+                        "exists for)")
     p.add_argument("--budget", type=int, default=12,
                    help="max new tokens per request (actual budgets "
                         "vary 1/4..1x)")
@@ -79,6 +94,12 @@ def run(args) -> int:
     from hpc_patterns_tpu.models.serving import ContinuousBatcher
 
     need = args.prompt_len + args.budget
+    try:
+        buckets = parse_buckets(args.prompt_buckets, args.prompt_len)
+    except (ValueError, argparse.ArgumentTypeError) as e:
+        log.print(f"ERROR: {e}")
+        log.print("FAILURE")
+        return 1
     draft_params = draft_cfg = None
     if args.draft_pair and args.checkpoint_dir:
         log.print("ERROR: --draft-pair serves the pair's own target "
@@ -86,6 +107,9 @@ def run(args) -> int:
                   "ignored — pass one or the other")
         log.print("FAILURE")
         return 1
+    # off-TPU serving takes the pure-XLA gather route on BOTH branches
+    # (the pallas kernels interpret per grid point there)
+    attn = "flash" if jax.default_backend() == "tpu" else "gather"
     try:
         if args.draft_pair:
             import json
@@ -96,9 +120,11 @@ def run(args) -> int:
             with open(os.path.join(args.draft_pair, "META.json")) as f:
                 meta = json.load(f)
             cfg = TransformerConfig(**{**meta["target_cfg"],
-                                       "max_seq": need})
+                                       "max_seq": need,
+                                       "decode_attn": attn})
             draft_cfg = TransformerConfig(**{**meta["draft_cfg"],
-                                             "max_seq": need})
+                                             "max_seq": need,
+                                             "decode_attn": attn})
             params, _ = restore_params(
                 os.path.join(args.draft_pair, "target"))
             draft_params, _ = restore_params(
@@ -112,6 +138,7 @@ def run(args) -> int:
                 d_ff=4 * args.d_model, max_seq=need,
                 n_kv_heads=args.n_kv_heads, pos_embed=args.pos_embed,
                 kv_cache_dtype=args.kv_cache_dtype,
+                decode_attn=attn,
             )
     except (ValueError, FileNotFoundError, KeyError) as e:
         log.print(f"ERROR: {e}")
@@ -136,20 +163,35 @@ def run(args) -> int:
                 log.print("FAILURE")
                 return 1
 
-    # the engine owns the sizing rule (incl. speculative slack)
+    # the engine owns the sizing rule (incl. speculative slack and the
+    # bucket-padded prefill length — the pool must hold the padded
+    # prompt even when the budget alone would need fewer pages)
+    from hpc_patterns_tpu.models.serving import pad_to_bucket
+
+    try:
+        padded_max = pad_to_bucket(buckets, args.prompt_len)
+    except ValueError as e:
+        log.print(f"ERROR: {e}")
+        log.print("FAILURE")
+        return 1
     pages_per_seq = ContinuousBatcher.pages_needed(
         args.prompt_len, args.budget, args.page_size,
-        gamma=args.gamma if draft_params is not None else None)
+        gamma=args.gamma if draft_params is not None else None,
+        padded_len=padded_max)
     pool_pages = args.pool_pages or args.slots * pages_per_seq
     rng = np.random.RandomState(7)
     reqs = []
     for _ in range(args.requests):
-        prompt = rng.randint(0, cfg.vocab,
-                             size=args.prompt_len).astype(np.int32)
+        plen = (int(rng.randint(max(1, args.prompt_len // 2),
+                                args.prompt_len + 1))
+                if args.prompt_mix else args.prompt_len)
+        prompt = rng.randint(0, cfg.vocab, size=plen).astype(np.int32)
         budget = int(rng.choice([max(1, args.budget // 4),
                                  max(1, args.budget // 2), args.budget]))
         reqs.append((prompt, budget))
     total_budget = sum(b for _, b in reqs)
+    sampled = args.temperature > 0.0
+    spec = draft_params is not None
 
     def serve():
         # constructor/submit ValueErrors (bad gamma, vocab mismatch,
@@ -163,71 +205,132 @@ def run(args) -> int:
                 eos_id=args.eos_id if args.eos_id >= 0 else None,
                 draft_params=draft_params, draft_cfg=draft_cfg,
                 gamma=args.gamma, emit=log.emit,
+                prompt_buckets=buckets, overlap=not args.no_overlap,
+                temperature=args.temperature, top_k=args.top_k,
+                seed=args.seed,
             )
             ids = [eng.submit(p, b) for p, b in reqs]
             got = eng.run()
         except (ValueError, RuntimeError) as e:
-            return None, str(e)
-        return {i: got[sid] for i, sid in enumerate(ids)}, None
+            return None, None, str(e)
+        return {i: got[sid] for i, sid in enumerate(ids)}, eng, None
 
     # warmup (compiles) — keep its records out of the registry: its
     # TTFT would be compile-dominated and its counters would double
     # every request (the warmup-vs-timed discipline of harness.timing)
+    from hpc_patterns_tpu.models.serving import prefill_cache_size
+
     m = metricslib.get_metrics()
     prev_enabled = m.enabled
     m.enabled = False
+    compiles0 = prefill_cache_size()  # other engines, this process
     try:
-        out, err = serve()
+        out, _, err = serve()
     finally:
         m.enabled = prev_enabled
     if err is not None:
         log.print(f"ERROR: {err}")
         log.print("FAILURE")
         return 1
+    # THIS engine's admission-prefill compiles (cold); the measured
+    # run below must add none (warm)
+    compiles_cold = prefill_cache_size() - compiles0
+    compiles_before = prefill_cache_size()
     t0 = time.perf_counter()
     with metricslib.span("serve.measure"):
-        out, _ = serve()
+        out, eng, _ = serve()
     dt = time.perf_counter() - t0
     served = sum(len(v) for v in out.values())
+    bubble = eng.last_bubble_frac
+    compiles_warm = prefill_cache_size() - compiles_before
     metricslib.get_metrics().gauge("serve.tokens_per_s").set(served / dt)
 
     # the oracle: every sequence token-exact vs standalone paged decode
-    # (truncated at eos when enabled — same rule the engine applies)
+    # with the SAME per-request key/temperature (truncated at eos when
+    # enabled — same rule the engine applies). Draft-assisted sampling
+    # is the one law-only combination (the rejection-sampling rounds
+    # preserve the emitted law, not the draws — its distribution
+    # oracle lives in tests/test_serving.py); it gets a bounds check.
     exact = True
     for i, (prompt, budget) in enumerate(reqs):
+        if sampled and spec:
+            ok_i = (1 <= len(out[i]) <= budget
+                    and np.all(out[i] >= 0)
+                    and np.all(out[i] < cfg.vocab))
+            if not ok_i:
+                exact = False
+                log.print(f"OUT-OF-BOUNDS seq {i}: {out[i][:8]}...")
+            continue
         want = np.asarray(paged_generate(
             params, jnp.asarray(prompt)[None, :], cfg, budget,
-            page_size=args.page_size))[0]
+            page_size=args.page_size,
+            key=eng.request_key(i) if sampled else None,
+            temperature=args.temperature, top_k=args.top_k))[0]
         if args.eos_id >= 0 and np.any(want == args.eos_id):
             want = want[:int(np.argmax(want == args.eos_id)) + 1]
         if not np.array_equal(out[i], want):
             exact = False
             log.print(f"MISMATCH seq {i}: engine {out[i][:8]}... vs "
                       f"standalone {want[:8]}...")
-    ok = exact and served > 0
+    # bound: cold compiles ≤ ladder rungs (x2 with a draft pair — the
+    # draft prefill compiles per rung under its own config), and the
+    # warm measured run adds none
+    max_compiles = (len(buckets) * (2 if spec else 1)
+                    if buckets is not None else None)
+    bounded = (compiles_warm == 0 and
+               (max_compiles is None or compiles_cold <= max_compiles))
+    if not bounded:
+        log.print(f"COMPILE-BOUND VIOLATION: {compiles_cold} cold + "
+                  f"{compiles_warm} warm prefill compiles vs ladder "
+                  f"bound {max_compiles} (warm must add none)")
+    ok = exact and bounded and served > 0
     log.emit(kind="result", name="serve", success=ok,
              requests=args.requests, slots=args.slots,
              pool_pages=pool_pages, page_size=args.page_size,
              chunk=args.chunk, served_tokens=served,
-             tokens_per_s=served / dt, oracle_exact=exact)
+             tokens_per_s=served / dt, oracle_exact=exact,
+             bubble_frac=bubble, prefill_compiles=compiles_cold,
+             prefill_compiles_warm=compiles_warm,
+             prompt_buckets=list(buckets) if buckets else None,
+             temperature=args.temperature, top_k=args.top_k,
+             overlap=not args.no_overlap)
+    mode = ("draft+sampled law" if sampled and spec
+            else "sampled exact" if sampled else "exact")
     log.print(f"serve[{args.slots} slots, pool {pool_pages}p x "
               f"{args.page_size}] {args.requests} reqs, {served} tokens "
               f"(budget {total_budget}): {dt:.3f}s, "
-              f"{served / dt:,.1f} tok/s, oracle "
-              f"{'exact' if exact else 'MISMATCH'}")
+              f"{served / dt:,.1f} tok/s, bubble {bubble:.1%}, "
+              f"{compiles_cold} prefill compiles"
+              f"{f' (ladder {len(buckets)})' if buckets else ''}"
+              f"{f' +{compiles_warm} warm' if compiles_warm else ''}, "
+              f"oracle[{mode}] {'ok' if exact else 'MISMATCH'}")
 
     if args.static_compare:
         def run_static():
+            # static batching of a mixed-length stream: batches of
+            # `slots` in arrival order; rows inside a batch group by
+            # prompt length (rectangular batches only) and every row
+            # pays the batch's LONGEST budget — the fragmentation +
+            # padding waste the engine exists to remove
             o = {}
+            skey = jax.random.PRNGKey(args.seed)
             for i in range(0, args.requests, args.slots):
                 batch = reqs[i:i + args.slots]
-                prompts = jnp.asarray(np.stack([p for p, _ in batch]))
                 run_len = max(b for _, b in batch)
-                toks = np.asarray(paged_generate(
-                    params, prompts, cfg, run_len,
-                    page_size=args.page_size))
-                for j, (_, b) in enumerate(batch):
-                    o[i + j] = toks[j, :b]
+                bylen: dict[int, list] = {}
+                for j, (p, b) in enumerate(batch):
+                    bylen.setdefault(len(p), []).append((i + j, p, b))
+                for group in bylen.values():
+                    prompts = jnp.asarray(
+                        np.stack([p for _, p, _ in group]))
+                    toks = np.asarray(paged_generate(
+                        params, prompts, cfg, run_len,
+                        page_size=args.page_size,
+                        key=skey if sampled else None,
+                        temperature=args.temperature,
+                        top_k=args.top_k))
+                    for j, (idx, _, b) in enumerate(group):
+                        o[idx] = toks[j, :b]
             return o
 
         run_static()  # warmup

@@ -33,6 +33,7 @@ from hpc_patterns_tpu.comm.communicator import record_collective_bandwidth
 from hpc_patterns_tpu.harness import RunLog, Verdict, measure
 from hpc_patterns_tpu.harness import metrics as metricslib
 from hpc_patterns_tpu.harness.cli import add_msg_size_args, base_parser
+from hpc_patterns_tpu.topology import shard_map
 from hpc_patterns_tpu.harness.timing import blocking, max_across_processes
 
 
@@ -66,7 +67,7 @@ def run(args) -> int:
         )
 
     stepper = jax.jit(
-        jax.shard_map(local_loop, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+        shard_map(local_loop, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
     )
 
     result = measure(

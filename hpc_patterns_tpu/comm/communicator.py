@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from hpc_patterns_tpu.comm import collectives, ring
 from hpc_patterns_tpu.harness import metrics as metricslib
+from hpc_patterns_tpu.topology import shard_map
 
 Algorithm = Literal["collective", "ring", "ring_chunked"]
 
@@ -113,7 +114,7 @@ class Communicator:
     def _shmap(self, fn, x, out_specs=None):
         spec = P(self.axis, *([None] * (jnp.ndim(x) - 1)))
         out = out_specs if out_specs is not None else spec
-        mapped = jax.shard_map(fn, mesh=self.mesh, in_specs=spec, out_specs=out)
+        mapped = shard_map(fn, mesh=self.mesh, in_specs=spec, out_specs=out)
         return jax.jit(mapped)
 
     # -- collectives over (size, n) arrays --------------------------------
@@ -188,7 +189,7 @@ class Communicator:
         spec = P(self.axis, None)
         token = self.shard(np.zeros((self.size, 1), np.int8))
         return jax.jit(
-            jax.shard_map(init, mesh=self.mesh, in_specs=spec, out_specs=spec)
+            shard_map(init, mesh=self.mesh, in_specs=spec, out_specs=spec)
         )(token)
 
     def expected_allreduce_value(self) -> float:

@@ -31,8 +31,13 @@ from jax import lax
 
 
 def axis_size(axis: str) -> int:
-    """World size of a mesh axis, inside shard_map (MPI_Comm_size analog)."""
-    return lax.axis_size(axis)
+    """World size of a mesh axis, inside shard_map (MPI_Comm_size analog).
+    ``lax.psum(1, axis)`` on builds without ``lax.axis_size`` (0.4.x) —
+    a concrete reduction of a concrete 1, so it stays a Python int
+    (usable in loop bounds/shapes) on both routes."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    return lax.psum(1, axis)
 
 
 def axis_index(axis: str):
@@ -93,7 +98,7 @@ def ring_shift(x, axis: str, shift: int = 1):
     permute), unlike the reference which needs even/odd send/recv
     ordering (:50-58).
     """
-    size = lax.axis_size(axis)
+    size = axis_size(axis)
     perm = _ring_perm(size, shift)
     check_permutation(perm, size)
     return lax.ppermute(x, axis, perm)
@@ -107,7 +112,7 @@ def pairwise_exchange(x, axis: str):
     even axis size, matching the miniapps' even-rank-count precondition
     (allreduce-mpi-sycl.cpp:95-97).
     """
-    size = lax.axis_size(axis)
+    size = axis_size(axis)
     if size % 2:
         raise ValueError(f"pairwise_exchange needs an even axis size, got {size}")
     return lax.ppermute(x, axis, [(i, i ^ 1) for i in range(size)])
@@ -137,7 +142,7 @@ def ring_schedule(
     ``fori_loop`` would also work but hides the unrolled overlap from the
     scheduler at small world sizes.
     """
-    size = lax.axis_size(axis)
+    size = axis_size(axis)
     if steps is None:
         steps = size - 1
     buf = x
@@ -174,7 +179,7 @@ def ring_reduce_scatter(x, axis: str, *, scatter_axis: int = 0):
     Wire cost: n * (size-1)/size per rank — the reason rings win at large
     message sizes (the ring-vs-collective comparison of BASELINE.json).
     """
-    size = lax.axis_size(axis)
+    size = axis_size(axis)
     me = lax.axis_index(axis)
     if x.shape[scatter_axis] % size:
         raise ValueError(
@@ -205,7 +210,7 @@ def ring_all_gather(x, axis: str, *, gather_axis: int = 0, tiled: bool = False):
     ``all_gather`` convention, kept so this is a drop-in for
     ``lax.all_gather``).
     """
-    size = lax.axis_size(axis)
+    size = axis_size(axis)
     me = lax.axis_index(axis)
     pieces = [x]
     buf = x

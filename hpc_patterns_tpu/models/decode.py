@@ -49,6 +49,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from hpc_patterns_tpu.models.sharding_util import mesh_axis_size, resolve_spec
+from hpc_patterns_tpu.topology import shard_map
 from hpc_patterns_tpu.models.transformer import (
     TransformerConfig,
     _rmsnorm,
@@ -201,7 +202,7 @@ def _mlp(x, lp, cfg: TransformerConfig):
 
 
 def prefill(params, prompt, cfg: TransformerConfig, max_len: int,
-            mesh=None):
+            mesh=None, last_pos=None):
     """Run the prompt in one batched pass (MXU-shaped, exactly
     transformer.forward's math) while capturing each layer's K/V into a
     fresh cache. Returns (last_logits (B, V) f32, cache).
@@ -210,7 +211,17 @@ def prefill(params, prompt, cfg: TransformerConfig, max_len: int,
     <= cfg.max_seq). ``mesh``: tp-sharded serving — the flash prefill
     kernel runs shard_mapped over ``cfg.axis_tp`` and the captured
     cache is constrained kv-head-sharded over tp (what the sharded
-    decode steps consume in place)."""
+    decode steps consume in place).
+
+    ``last_pos``: the BUCKETED-prompt route. A prompt right-padded to a
+    bucket length compiles once per bucket instead of once per distinct
+    length; causality makes positions < true length independent of the
+    padding, so the K/V prefix is exact and only the returned logits
+    need redirecting — ``last_pos`` (traced scalar or (B,) int32) picks
+    which position's logits come back (default: the last). Padding K/V
+    is garbage the caller's position cursor masks until generation
+    overwrites it — the same stale-row invariant speculative decoding
+    relies on."""
     B, T = prompt.shape
     use_flash, flash_sharded = _flash_route(mesh, cfg)
     if not 0 < T <= max_len <= cfg.max_seq:
@@ -244,7 +255,7 @@ def prefill(params, prompt, cfg: TransformerConfig, max_len: int,
             if flash_sharded:
                 hspec = resolve_spec(P(None, None, cfg.axis_tp, None),
                                      mesh, cfg.mesh_axes)
-                o = jax.shard_map(
+                o = shard_map(
                     partial(flash_attention, causal=True), mesh=mesh,
                     in_specs=(hspec, hspec, hspec), out_specs=hspec,
                     check_vma=False,  # pallas_call can't declare vma
@@ -264,7 +275,12 @@ def prefill(params, prompt, cfg: TransformerConfig, max_len: int,
 
     x, (ks, vs) = lax.scan(body, x, params["layers"])
     x = _rmsnorm(x, params["ln_f_scale"])
-    logits = jnp.dot(x[:, -1], params["lm_head"].astype(dt))
+    if last_pos is None:
+        x_last = x[:, -1]
+    else:
+        lp = jnp.broadcast_to(jnp.asarray(last_pos, jnp.int32), (B,))
+        x_last = jnp.take_along_axis(x, lp[:, None, None], axis=1)[:, 0]
+    logits = jnp.dot(x_last, params["lm_head"].astype(dt))
     L = cfg.n_layers
     if cfg.kv_cache_dtype == "int8":
         kq, ksc = zip(*(_quantize_rows(ks[l]) for l in range(L)))
@@ -400,7 +416,7 @@ def decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
                         scale=scale,
                     )
 
-                o = jax.shard_map(
+                o = shard_map(
                     local_attn, mesh=mesh,
                     in_specs=tuple(specs), out_specs=spec_q,
                     check_vma=False,  # pallas_call can't declare vma
@@ -680,14 +696,17 @@ def init_paged_cache(cfg: TransformerConfig, batch: int,
 
 
 def paged_prefill(params, prompt, cfg: TransformerConfig, cache,
-                  page_size: int, mesh=None):
+                  page_size: int, mesh=None, last_pos=None):
     """Prompt pass writing into the paged cache: the ordinary prefill
     captures K/V for the prompt (a transient sized to the PROMPT, not
     the serving maximum), then each layer's pages scatter into the pool
     through the table. Returns (last_logits, cache). ``mesh``:
     tp-sharded serving — the prefill kernel runs shard_mapped and the
     page POOLS are constrained kv-head-sharded over tp (the layout
-    :func:`paged_decode_step`'s sharded route consumes in place)."""
+    :func:`paged_decode_step`'s sharded route consumes in place).
+    ``last_pos``: the bucketed-prompt route (see :func:`prefill`) —
+    logits come from this position instead of the last, so a prompt
+    right-padded to a bucket rung still answers for its true end."""
     B, T = prompt.shape
     P = page_size  # shadows the PartitionSpec alias in this scope
     t_pad = -(-T // P) * P
@@ -701,7 +720,8 @@ def paged_prefill(params, prompt, cfg: TransformerConfig, cache,
     # boundary afterwards — asking prefill for t_pad would spuriously
     # trip its max_len <= cfg.max_seq guard for prompts within a page
     # of the model maximum
-    logits, lin = prefill(params, prompt, cfg, T, mesh=mesh)
+    logits, lin = prefill(params, prompt, cfg, T, mesh=mesh,
+                          last_pos=last_pos)
     if t_pad > T:
         # pad the sequence axis of every leaf (values are 4-D, int8
         # scales 3-D)
@@ -792,6 +812,53 @@ def _scale_write(pool, page_ids, page, offset, rows, pages: int,
     return pool.at[page_ids, :, 0, offset].set(rows.astype(pool.dtype))
 
 
+def _paged_attend_gather(q, k_pool, v_pool, ks_pool, vs_pool, table,
+                         pos, cfg: TransformerConfig, scale):
+    """The pure-XLA paged attention step (``cfg.decode_attn ==
+    "gather"``): each row's pages gather through the table into a
+    contiguous (B, Hkv, pages·P, D) view and the step is
+    :func:`decode_step`'s gather block — one fused mask+softmax pass,
+    past-the-fill positions (pad pages, trash entries) masked by the
+    position cursor. This is the serving route off-TPU: a pallas_call
+    runs in INTERPRET mode there, paying per-grid-point host cost that
+    scales with batch × kv_heads (measured ~10x a decode step on the
+    8-device CPU mesh at serving widths); it also partitions via plain
+    GSPMD under tp, where the kernel needs a shard_map. On TPU the
+    kernel remains the default — its clamped index map reads
+    position-proportional bytes; this view reads the full allocation.
+    ``pos``: scalar or ragged (B,); int8 pools dequantize in the einsum
+    stream like the linear gather."""
+    B, pages = table.shape
+    Hkv, g, Dh = cfg.kv_heads, cfg.n_heads // cfg.kv_heads, cfg.head_dim
+    P = k_pool.shape[2]
+    int8 = ks_pool is not None
+
+    def view(pool):  # (pool, Hkv, P, D) -> (B, Hkv, pages*P, D)
+        gat = pool[table]  # (B, pages, Hkv, P, D)
+        return jnp.einsum("bphsd->bhpsd", gat).reshape(
+            B, Hkv, pages * P, Dh).astype(jnp.float32)
+
+    def scale_view(pool):  # (pool, Hkv, 1, P) -> (B, Hkv, pages*P)
+        gat = pool[table][:, :, :, 0, :]  # (B, pages, Hkv, P)
+        return jnp.einsum("bphs->bhps", gat).reshape(B, Hkv, pages * P)
+
+    kd, vd = view(k_pool), view(v_pool)
+    if int8:
+        kd = kd * scale_view(ks_pool)[..., None]
+        vd = vd * scale_view(vs_pool)[..., None]
+    qg = q.reshape(B, Hkv, g, Dh)
+    s = jnp.einsum("bkgd,bksd->bkgs", qg.astype(jnp.float32), kd,
+                   precision=lax.Precision.HIGHEST) * scale
+    idx = lax.broadcasted_iota(jnp.int32, s.shape, 3)
+    visible = idx <= (pos[:, None, None, None] if jnp.ndim(pos)
+                      else pos)
+    s = jnp.where(visible, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkgs,bksd->bkgd", p, vd,
+                   precision=lax.Precision.HIGHEST)
+    return o.reshape(B, cfg.n_heads, Dh)
+
+
 def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
                       identity_layout: bool = False, mesh=None,
                       pages_per_step: int | None = None):
@@ -802,7 +869,12 @@ def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
     cursor (like decode_step) OR a (B,) vector of per-sequence
     positions — RAGGED serving, every sequence at its own length (the
     kernel masks and clamps per row; rope/learned embeddings gather
-    per row; the cache write scatters per-row offsets). ``mesh``:
+    per row; the cache write scatters per-row offsets).
+    ``cfg.decode_attn`` routes the attention like the linear step:
+    "flash" (default) streams live pages through the pallas kernel;
+    "gather" takes :func:`_paged_attend_gather` — the pure-XLA view
+    that serving uses off-TPU (a pallas_call interprets per grid point
+    there) and that partitions via GSPMD under any tp. ``mesh``:
     tp-sharded paged serving — the paged kernel runs under a shard_map
     manual partition over ``cfg.axis_tp`` (whole kv-head blocks per
     rank, like the linear route; tp must divide kv_heads), pools enter
@@ -872,13 +944,14 @@ def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
     ident = identity_layout and not ragged
     pages = table.shape[1]
     tp = _tp_size(mesh, cfg)
-    if tp > 1 and cfg.kv_heads % tp:
+    use_flash = cfg.decode_attn == "flash"
+    if use_flash and tp > 1 and cfg.kv_heads % tp:
         raise ValueError(
             f"paged tp serving needs tp {tp} to divide kv_heads "
-            f"{cfg.kv_heads} (whole kv-head blocks per rank; the paged "
-            "kernel has no gather fallback)"
+            f"{cfg.kv_heads} (whole kv-head blocks per rank) — or "
+            "decode_attn='gather', which partitions via GSPMD"
         )
-    paged_sharded = tp > 1
+    paged_sharded = use_flash and tp > 1
 
     def attend_update(q, k_new, v_new, state):
         k_pool, v_pool, ks_pool, vs_pool = state
@@ -893,7 +966,10 @@ def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
                              pages, ident)
         v_pool = _pool_write(v_pool, page_ids, page, offset, v_new,
                              pages, ident)
-        if paged_sharded:
+        if not use_flash:
+            o = _paged_attend_gather(q, k_pool, v_pool, ks_pool,
+                                     vs_pool, table, pos, cfg, scale)
+        elif paged_sharded:
             # manual partition over tp, mirroring decode_step's linear
             # route: q heads block-shard with their kv heads, pools
             # shard on the kv_heads dim, table/pos ride replicated.
@@ -917,7 +993,7 @@ def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
                     pages_per_step=pages_per_step,
                 )
 
-            o = jax.shard_map(
+            o = shard_map(
                 local_attn, mesh=mesh, in_specs=tuple(specs),
                 out_specs=spec_q,
                 check_vma=False,  # pallas_call can't declare vma

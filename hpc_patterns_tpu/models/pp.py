@@ -68,6 +68,8 @@ from jax.sharding import PartitionSpec as P
 
 import optax
 
+from hpc_patterns_tpu.topology import shard_map
+
 from hpc_patterns_tpu.models.transformer import (
     TransformerConfig,
     _attention,
@@ -568,7 +570,7 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
     head_specs = ({"ln_f_scale": P(), "lm_head": P(None, axis_tp)}
                   if shard_head else P())
     loss_spec = (P((*batch_axes, axis_pp)) if batch_axes else P(axis_pp))
-    loss_r, outer_g, layer_g, head_g = jax.shard_map(
+    loss_r, outer_g, layer_g, head_g = shard_map(
         local,
         mesh=mesh,
         in_specs=(P(), layer_specs, head_specs, tok_spec),

@@ -26,9 +26,36 @@ chunk never writes past a row's allocation. Idle slots point their
 table row at a dedicated TRASH page and their writes land there —
 garbage in, never read, discarded.
 
+Production shape (round 6), three coupled levers:
+
+- **prompt-length bucketing**: prompts pad to a small ladder of
+  lengths (:func:`bucket_ladder`), so admission prefill compiles are
+  bounded by the LADDER size, not the number of distinct prompt
+  lengths in the stream (causality keeps the true-prefix K/V and the
+  last-real-token logits exact — decode.prefill's ``last_pos`` route);
+- **overlapped admission**: the decode chunk is DISPATCHED first and
+  admissions (table upload, prefill, first-token pick) are enqueued
+  behind it — JAX async dispatch keeps the device queue fed while the
+  host does admission work, and the first-token readback is deferred
+  to the next sync point instead of stalling the loop per admission.
+  The admission-bubble fraction (host admission time exposed with no
+  decode work in flight) is measured per ``run()`` and emitted through
+  the metrics registry;
+- **sampling in the engine**: per-row temperature and per-row PRNG key
+  streams (``temperature``/``top_k``/``seed``; per-request overrides
+  via :meth:`ContinuousBatcher.submit`). Each row consumes its key
+  exactly as a standalone ``paged_generate(..., key=request_key(sid))``
+  would, so SAMPLED serving is token-identical to standalone sampling
+  — the same oracle discipline as greedy mode, not a weaker
+  distributional claim. Draft-assisted serving samples through the
+  shared speculative accept/resample (models/speculative.paged_round),
+  which preserves the law but not the draws — its oracle is
+  distributional.
+
 Correctness contract (oracle-tested): every admitted sequence's
-emitted tokens are exactly ``paged_generate``'s for the same prompt
-and budget, regardless of what was scheduled around it.
+emitted tokens are exactly ``paged_generate``'s for the same prompt,
+budget, and (when sampling) per-request key, regardless of what was
+scheduled around it.
 
 Reference lineage: the benchmark-IS-the-test discipline
 (aurora.mpich.miniapps/src/CMakeLists.txt:39-50) — the engine's
@@ -49,6 +76,8 @@ from jax import lax
 
 from hpc_patterns_tpu.harness import metrics as metricslib
 from hpc_patterns_tpu.models.decode import (
+    _pick,
+    _topk_mask,
     init_paged_cache,
     paged_decode_step,
     paged_prefill,
@@ -56,15 +85,57 @@ from hpc_patterns_tpu.models.decode import (
 from hpc_patterns_tpu.models.transformer import TransformerConfig
 
 
+def bucket_ladder(max_len: int, *, lo: int = 16,
+                  growth: float = 2.0) -> tuple[int, ...]:
+    """A power-of-two-ish prompt-length ladder covering 1..``max_len``:
+    rungs ``lo, lo*growth, ...`` with the top rung clamped to
+    ``max_len`` (so no rung pads past the longest legal prompt). The
+    ladder size — not the stream's distinct-length count — bounds the
+    engine's admission-prefill compiles."""
+    if max_len < 1:
+        raise ValueError(f"max_len must be >= 1, got {max_len}")
+    if lo < 1 or growth <= 1.0:
+        raise ValueError(f"need lo >= 1 and growth > 1, got {lo}/{growth}")
+    rungs = []
+    r = lo
+    while r < max_len:
+        rungs.append(r)
+        r = max(int(r * growth), r + 1)
+    rungs.append(max_len)
+    return tuple(rungs)
+
+
+def pad_to_bucket(buckets, prompt_len: int) -> int:
+    """The padded prefill length: the smallest ladder rung that fits
+    (the exact length when ``buckets`` is None). THE single pad rule —
+    the engine pads admissions with it and pool-sizing callers
+    (serve_app, bench_serving) must size with the same function, or
+    ``pages_needed`` desynchronizes from what admission writes."""
+    if buckets is None:
+        return prompt_len
+    for rung in sorted(buckets):
+        if rung >= prompt_len:
+            return int(rung)
+    raise ValueError(
+        f"prompt length {prompt_len} above the bucket-ladder top "
+        f"{max(buckets)}; extend prompt_buckets"
+    )
+
+
 @dataclass
 class Request:
     """One sequence to serve: ``prompt`` (T,) int32, up to ``max_new``
     generated tokens (fewer if ``eos_id`` fires). ``t_submit`` stamps
-    queue entry so admission can attribute time-to-first-token."""
+    queue entry so admission can attribute time-to-first-token.
+    ``temperature``/``key``: per-request sampling overrides (None =
+    the engine's defaults; the default key is
+    ``ContinuousBatcher.request_key(seq_id)``)."""
     prompt: np.ndarray
     max_new: int
     seq_id: int = -1
     t_submit: float = 0.0
+    temperature: float | None = None
+    key: jax.Array | None = None
 
 
 @dataclass
@@ -72,49 +143,71 @@ class _Slot:
     seq_id: int = -1
     pages: list = field(default_factory=list)
     prompt_len: int = 0
+    budget: int = 0
     out: list = field(default_factory=list)
     active: bool = False
+    t_submit: float = 0.0
     t_admit: float = 0.0
+    first_dev: jax.Array | None = None  # pending first-token readback
 
 
-@partial(jax.jit, static_argnames=("cfg", "chunk", "eos_id", "mesh"),
-         donate_argnums=(1, 2, 3, 4))
-def _chunk_step(params, cache, pos, limit, tokens, *, cfg, chunk,
-                eos_id, mesh):
+@partial(jax.jit,
+         static_argnames=("cfg", "chunk", "eos_id", "greedy", "top_k",
+                          "mesh"),
+         donate_argnums=(1, 2, 3, 4, 5))
+def _chunk_step(params, cache, pos, limit, tokens, keys, temps, *, cfg,
+                chunk, eos_id, greedy, top_k, mesh):
     """``chunk`` ragged decode steps in one trace: rows advance while
     ``pos < limit``; an emitted ``eos_id`` pulls the row's limit down
     to its current end. Emits the picked token per step (valid where
     the step was active). eos_id < 0 disables EOS. Module-level jit
     (static config) so every engine instance with the same config
-    shares one compilation."""
+    shares one compilation.
+
+    ``greedy`` (static) picks argmax; otherwise each row samples from
+    its OWN key stream (``keys`` (B, 2) uint32) at its OWN temperature
+    (``temps`` (B,)), advancing the key only on active steps — the
+    exact split/pick sequence of decode._generation_scan per row, which
+    is what makes sampled serving token-identical to standalone
+    ``paged_generate`` with the same per-request key."""
 
     def step(carry, _):
-        cache, pos, limit, tok = carry
+        cache, pos, limit, tok, keys = carry
         active = pos < limit
         logits, cache = paged_decode_step(params, cache, pos, tok, cfg,
                                           mesh=mesh)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if greedy:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            split2 = jax.vmap(jax.random.split)(keys)  # (B, 2, 2)
+            masked = _topk_mask(logits, top_k) / temps[:, None]
+            nxt = jax.vmap(
+                lambda l, k: jax.random.categorical(k, l[None, :],
+                                                    axis=-1)[0]
+            )(masked, split2[:, 1]).astype(jnp.int32)
+            keys = jnp.where(active[:, None], split2[:, 0], keys)
         nxt = jnp.where(active, nxt, tok)
         if eos_id >= 0:
             limit = jnp.where(active & (nxt == eos_id),
                               jnp.minimum(limit, pos + 1), limit)
         pos = jnp.where(active, pos + 1, pos)
-        return (cache, pos, limit, nxt), nxt
+        return (cache, pos, limit, nxt, keys), nxt
 
-    (cache, pos, limit, tokens), out = lax.scan(
-        step, (cache, pos, limit, tokens), None, length=chunk
+    (cache, pos, limit, tokens, keys), out = lax.scan(
+        step, (cache, pos, limit, tokens, keys), None, length=chunk
     )
-    return cache, pos, limit, tokens, out
+    return cache, pos, limit, tokens, keys, out
 
 
 @partial(jax.jit,
          static_argnames=("cfg", "dcfg", "gamma", "rounds", "eos_id",
-                          "mesh"),
-         donate_argnums=(2, 3, 4, 5, 6))
-def _spec_chunk(params, dparams, cache, dcache, pos, limit, cur, *,
-                cfg, dcfg, gamma, rounds, eos_id, mesh=None):
-    """``rounds`` draft-assisted serving rounds in ONE dispatch
-    (greedy): each round is THE shared speculative round body
+                          "greedy", "top_k", "mesh"),
+         donate_argnums=(2, 3, 4, 5, 6, 7))
+def _spec_chunk(params, dparams, cache, dcache, pos, limit, cur, key,
+                temps, *, cfg, dcfg, gamma, rounds, eos_id, greedy,
+                top_k, mesh=None):
+    """``rounds`` draft-assisted serving rounds in ONE dispatch: each
+    round is THE shared speculative round body
     (models/speculative.paged_round — one acceptance/emit definition
     for the engine and speculative_generate_batched) at each row's own
     cursor, advancing 1..gamma+1 tokens per round. Budget and EOS
@@ -123,27 +216,29 @@ def _spec_chunk(params, dparams, cache, dcache, pos, limit, cur, *,
     the host pays one round trip per ``rounds`` — the draft-mode
     counterpart of _chunk_step's dispatch amortization. Rows at their
     limit run at a clamped cursor (garbage lands in pages they own or
-    the trash page). Returns (cache, dcache, pos, limit, cur, emits,
-    advs): per-round tokens (rounds, B, gamma+1) and valid counts
-    (rounds, B) for the host to append."""
+    the trash page).
+
+    ``greedy`` (static) keeps the provably-token-exact acceptance;
+    otherwise the rounds run paged_round's LIVE rejection-sampling path
+    (speculative._accept_resample) from ``key``, one split per round,
+    at per-row ``temps`` — same emitted law as target-only sampling,
+    different draws (the distribution oracle's territory). Returns
+    (cache, dcache, pos, limit, cur, key, emits, advs): per-round
+    tokens (rounds, B, gamma+1) and valid counts (rounds, B) for the
+    host to append."""
     from hpc_patterns_tpu.models.speculative import paged_round
 
     B = pos.shape[0]
     rows = jnp.arange(B)
-    # the engine serves greedily (greedy=True below): paged_round never
-    # reads the key or temperature on that path — these are inert
-    # placeholders filling its sampling signature, NOT live sampling
-    inert_greedy_key = jax.random.PRNGKey(0)
-    inert_temperature = jnp.float32(1.0)
 
     def one_round(carry, _):
-        cache, dcache, pos, limit, cur = carry
+        cache, dcache, pos, limit, cur, key = carry
         active = pos < limit
         pos_eff = jnp.where(active, pos, 0)
+        key, sub = jax.random.split(key)  # greedy: unused, DCE'd
         cache, dcache, a, emit, _ = paged_round(
             params, cfg, dparams, dcfg, cache, dcache, pos_eff, cur,
-            gamma, inert_greedy_key, True, 0, inert_temperature,
-            mesh=mesh)
+            gamma, sub, greedy, top_k, temps, mesh=mesh)
         adv = jnp.where(active,
                         jnp.minimum(a + 1, limit - pos), 0)
         if eos_id >= 0:
@@ -157,23 +252,61 @@ def _spec_chunk(params, dparams, cache, dcache, pos, limit, cur, *,
         pos = pos + adv
         if eos_id >= 0:
             limit = jnp.where(has, pos, limit)
-        return (cache, dcache, pos, limit, cur), (emit, adv)
+        return (cache, dcache, pos, limit, cur, key), (emit, adv)
 
-    (cache, dcache, pos, limit, cur), (emits, advs) = lax.scan(
-        one_round, (cache, dcache, pos, limit, cur), None,
+    (cache, dcache, pos, limit, cur, key), (emits, advs) = lax.scan(
+        one_round, (cache, dcache, pos, limit, cur, key), None,
         length=rounds)
-    return cache, dcache, pos, limit, cur, emits, advs
+    return cache, dcache, pos, limit, cur, key, emits, advs
 
 
 @partial(jax.jit, static_argnames=("cfg", "page_size", "mesh"),
-         donate_argnums=(2,))
-def _prefill_one(params, prompt, cache_one, *, cfg, page_size, mesh):
+         donate_argnums=(3,))
+def _prefill_one(params, prompt, last_pos, cache_one, *, cfg, page_size,
+                 mesh):
     """One-row prefill through the shared pool (jitted; compiles per
-    distinct prompt length — bucket/pad prompts upstream if compile
-    count matters). ``cache_one`` is donated: the pool IS the capacity
-    lever, so admissions must not double it."""
+    distinct PADDED prompt length — the engine's bucket ladder bounds
+    that count, see ``prompt_buckets``). ``last_pos`` (traced) redirects
+    the returned logits to the last REAL token of a padded prompt.
+    ``cache_one`` is donated: the pool IS the capacity lever, so
+    admissions must not double it."""
     return paged_prefill(params, prompt, cfg, cache_one, page_size,
-                         mesh=mesh)
+                         mesh=mesh, last_pos=last_pos)
+
+
+def prefill_cache_size() -> int:
+    """Compiled admission-prefill variants in this process (the jit
+    cache of :func:`_prefill_one`) — THE compile-count observable the
+    bucket-ladder claim is asserted against (tests) and reported by
+    (benchmarks/bench_serving.py). One entry per distinct (padded
+    length, config) pair across every engine in the process."""
+    return _prefill_one._cache_size()
+
+
+@partial(jax.jit, static_argnames=("eos_id", "greedy", "top_k"),
+         donate_argnums=(0, 1, 2, 3, 4))
+def _admit_row(pos, limit, tokens, keys, temps, logits, key, temp, slot,
+               true_len, budget, *, eos_id, greedy, top_k):
+    """All device-side admission bookkeeping in ONE dispatch: pick the
+    first token from the prefill logits (the same split/pick sequence
+    decode._generation_scan opens with, so sampled rows stay
+    standalone-exact), seed the row's cursors, and pull the limit to
+    ``true_len`` when the row is already done (budget 1, or the first
+    token IS eos) — all decided on device, so admission never forces a
+    host readback. ``slot``/``true_len``/``budget`` ride as traced
+    scalars: one compilation serves every admission."""
+    newk, sub = jax.random.split(key)
+    first = _pick(logits, sub, temp, greedy, top_k)[0]
+    # budget b emits 1 token at admit + (lim - true_len) from chunks
+    lim = true_len + budget - 1
+    if eos_id >= 0:
+        lim = jnp.where(first == eos_id, true_len, lim)
+    pos = pos.at[slot].set(true_len)
+    limit = limit.at[slot].set(lim)
+    tokens = tokens.at[slot].set(first)
+    keys = keys.at[slot].set(newk)
+    temps = temps.at[slot].set(temp)
+    return pos, limit, tokens, keys, temps, first
 
 
 class ContinuousBatcher:
@@ -185,10 +318,32 @@ class ContinuousBatcher:
     pages any single sequence may hold (size requests with
     :meth:`pages_needed`). ``chunk``: decode steps per jitted dispatch
     — admission/eviction happen at chunk boundaries (larger amortizes
-    host+dispatch; 1 = immediate). Greedy decoding (the serving
-    oracle); ``eos_id`` optionally ends rows early. ``mesh``:
-    tp-sharded serving — pools/kernel shard exactly like
-    ``paged_generate(..., mesh=...)``.
+    host+dispatch; 1 = immediate). ``eos_id`` optionally ends rows
+    early. ``mesh``: tp-sharded serving — pools/kernel shard exactly
+    like ``paged_generate(..., mesh=...)``.
+
+    ``prompt_buckets``: the prompt-length ladder (sorted ints; see
+    :func:`bucket_ladder`). Prompts right-pad to the smallest rung
+    that fits, so admission-prefill compiles are bounded by the ladder
+    size instead of the stream's distinct lengths (the padding K/V is
+    causally invisible and overwritten as the row generates). None =
+    exact lengths (one compile per distinct length).
+
+    ``overlap``: dispatch the decode chunk BEFORE doing admissions, so
+    table uploads + prefills + first-token picks enqueue behind the
+    in-flight chunk instead of stalling it (JAX async dispatch); the
+    first-token host readback defers to the next sync point. The
+    exposed (un-overlapped) admission time is reported as
+    ``last_bubble_frac`` and the ``serve.admit_bubble_frac`` gauge.
+
+    ``temperature``/``top_k``/``seed``: sampling in the engine.
+    temperature <= 0 (default) is greedy — the token-exact serving
+    oracle. temperature > 0 samples per row from per-request key
+    streams (default ``request_key(seq_id)``); a row's emitted tokens
+    are then EXACTLY ``paged_generate(prompt, budget,
+    key=request_key(sid), temperature=..., top_k=...)``'s — same
+    oracle, sampled mode. Per-request ``temperature``/``key`` override
+    at :meth:`submit` (sampling engines only).
 
     ``draft_params``/``draft_cfg``/``gamma``: draft-assisted serving —
     speculative ROUNDS (draft proposes gamma, target verifies in one
@@ -198,14 +353,20 @@ class ContinuousBatcher:
     admission/eviction happen every chunk·(1..gamma+1) tokens.
     Composes with ``mesh``: draft steps ride the shard_map
     paged-kernel route, the ragged extend partitions via GSPMD (tp
-    must divide BOTH models' kv_heads).
+    must divide BOTH models' kv_heads). With ``temperature > 0`` the
+    rounds run the live rejection-sampling acceptance — emitted law
+    exactly target-only sampling, draws not reproducible row-wise
+    (the distribution oracle covers it).
     """
 
     def __init__(self, params, cfg: TransformerConfig, *, slots: int,
                  pool_pages: int, pages_per_seq: int, page_size: int,
                  chunk: int = 8, eos_id: int | None = None, mesh=None,
                  draft_params=None, draft_cfg: TransformerConfig | None
-                 = None, gamma: int = 4, emit=None):
+                 = None, gamma: int = 4, emit=None,
+                 prompt_buckets=None, overlap: bool = True,
+                 temperature: float = 0.0, top_k: int = 0,
+                 seed: int = 0):
         if cfg.n_experts:
             # paged serving is dense-model territory so far
             raise ValueError("continuous batching: dense models only")
@@ -216,6 +377,26 @@ class ContinuousBatcher:
                 raise ValueError("draft/target vocab mismatch")
             if gamma < 1:
                 raise ValueError(f"gamma must be >= 1, got {gamma}")
+        if not 0 <= top_k <= cfg.vocab:
+            raise ValueError(f"top_k {top_k} outside [0, vocab]")
+        if prompt_buckets is not None:
+            rungs = tuple(sorted({int(b) for b in prompt_buckets}))
+            if not rungs or rungs[0] < 1:
+                raise ValueError(
+                    f"prompt_buckets must be positive ints, {rungs}")
+            if rungs[-1] > cfg.max_seq:
+                raise ValueError(
+                    f"bucket rung {rungs[-1]} exceeds max_seq "
+                    f"{cfg.max_seq} (padded prompts must still fit)")
+            prompt_buckets = rungs
+        self.prompt_buckets = prompt_buckets
+        self.overlap = bool(overlap)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.greedy = self.temperature <= 0.0
+        base, spec = jax.random.split(jax.random.PRNGKey(seed))
+        self._req_key_base = base
+        self._spec_key = spec
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
         self.gamma = gamma
@@ -249,10 +430,14 @@ class ContinuousBatcher:
         self.pos = jnp.zeros((slots,), jnp.int32)
         self.limit = jnp.zeros((slots,), jnp.int32)
         self.tokens = jnp.zeros((slots,), jnp.int32)
+        self.keys = jnp.zeros((slots, 2), jnp.uint32)
+        self.temps = jnp.ones((slots,), jnp.float32)
         self._slots = [_Slot() for _ in range(slots)]
+        self._pending: list[int] = []  # admitted, first token unread
         self._queue: list[Request] = []
         self.finished: dict[int, np.ndarray] = {}
         self._next_id = 0
+        self.last_bubble_frac = 0.0  # of the most recent run()
         # observability hook (the framework's metrics/logging
         # subsystem, SURVEY.md §5): a callable taking keyword fields —
         # pass harness.RunLog.emit for JSONL records of admissions,
@@ -263,38 +448,74 @@ class ContinuousBatcher:
 
     @staticmethod
     def pages_needed(prompt_len: int, max_new: int, page_size: int, *,
-                     gamma: int | None = None) -> int:
+                     gamma: int | None = None,
+                     padded_len: int | None = None) -> int:
         """Pages one request holds in this engine: prompt + budget,
         plus the speculative overshoot slack (gamma+1) when a draft
-        serves — THE sizing rule; callers building their own pools
+        serves, OR the bucket-padded prefill length if that reaches
+        further — THE sizing rule; callers building their own pools
         (serve_app) must use it rather than re-deriving the slack."""
         slack = (gamma + 1) if gamma is not None else 0
-        return -(-(prompt_len + max_new + slack) // page_size)
+        span = max(prompt_len + max_new + slack, padded_len or 0)
+        return -(-span // page_size)
+
+    def _bucket_len(self, prompt_len: int) -> int:
+        return pad_to_bucket(self.prompt_buckets, prompt_len)
 
     def _pages_for(self, prompt_len: int, max_new: int) -> int:
         return self.pages_needed(
             prompt_len, max_new, self.page_size,
-            gamma=self.gamma if self.draft_params is not None else None)
+            gamma=self.gamma if self.draft_params is not None else None,
+            padded_len=self._bucket_len(prompt_len))
 
-    def submit(self, prompt, max_new: int, seq_id: int | None = None) -> int:
+    def request_key(self, seq_id: int) -> jax.Array:
+        """The per-request PRNG key a default (key=None) submit gets:
+        the standalone-reproduction handle. A sampled row's served
+        tokens equal ``paged_generate(prompt, budget,
+        key=request_key(sid), temperature=engine.temperature,
+        top_k=engine.top_k)`` exactly (non-draft engines)."""
+        return jax.random.fold_in(self._req_key_base, seq_id)
+
+    def submit(self, prompt, max_new: int, seq_id: int | None = None, *,
+               temperature: float | None = None, key=None) -> int:
         """Enqueue a sequence; returns its id. Tokens appear in
-        ``finished[id]`` once served."""
+        ``finished[id]`` once served. ``temperature``/``key``: per-row
+        sampling overrides (sampling engines only; key defaults to
+        :meth:`request_key`)."""
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1 or prompt.size == 0:
             raise ValueError(f"prompt must be 1-D nonempty, {prompt.shape}")
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
+        if key is not None and self.greedy:
+            raise ValueError(
+                "per-request key needs a sampling engine (construct "
+                "with temperature > 0); a greedy engine never consumes "
+                "key streams and would silently ignore it"
+            )
+        if temperature is not None:
+            if self.greedy:
+                raise ValueError(
+                    "per-request temperature needs a sampling engine "
+                    "(construct with temperature > 0); greedy engines "
+                    "compile the argmax path only"
+                )
+            if temperature <= 0.0:
+                raise ValueError(
+                    f"per-request temperature must be > 0, got "
+                    f"{temperature}")
+        padded = self._bucket_len(int(prompt.size))  # raises off-ladder
         need = self._pages_for(prompt.size, max_new)
         if need > self.pages_per_seq:
             raise ValueError(
                 f"prompt {prompt.size} + budget {max_new} (+ spec "
-                f"slack {self.spec_slack}) needs {need} pages > "
-                f"pages_per_seq {self.pages_per_seq}"
+                f"slack {self.spec_slack}; bucket pad {padded}) needs "
+                f"{need} pages > pages_per_seq {self.pages_per_seq}"
             )
-        if prompt.size + max_new > self.cfg.max_seq:
+        if max(prompt.size + max_new, padded) > self.cfg.max_seq:
             raise ValueError(
-                f"prompt {prompt.size} + budget {max_new} exceeds "
-                f"max_seq {self.cfg.max_seq}"
+                f"prompt {prompt.size} + budget {max_new} (bucket pad "
+                f"{padded}) exceeds max_seq {self.cfg.max_seq}"
             )
         sid = self._next_id if seq_id is None else seq_id
         if (sid in self.finished
@@ -307,12 +528,13 @@ class ContinuousBatcher:
             )
         self._next_id = max(self._next_id, sid) + 1
         self._queue.append(Request(prompt, max_new, sid,
-                                   t_submit=time.perf_counter()))
+                                   t_submit=time.perf_counter(),
+                                   temperature=temperature, key=key))
         metricslib.get_metrics().gauge("serve.queue_depth").set(
             len(self._queue))
         return sid
 
-    def _try_admit(self) -> bool:
+    def _try_admit(self, overlapped: bool = False) -> bool:
         """Admit the longest-waiting request that fits a free slot and
         the free page list. FCFS with skip: a large request at the head
         does not block a small one behind it (documented head-of-line
@@ -326,29 +548,44 @@ class ContinuousBatcher:
             need = self._pages_for(req.prompt.size, req.max_new)
             if need <= len(self.free_pages):
                 self._queue.pop(qi)
-                self._admit(free_slot, req, need)
+                self._admit(free_slot, req, need, overlapped)
                 return True
         return False
 
-    def _admit(self, slot: int, req: Request, need: int):
+    def _admit(self, slot: int, req: Request, need: int,
+               overlapped: bool):
+        """Dispatch-only admission: every device op (table upload,
+        prefill, first-token pick, cursor seeding) enqueues without a
+        host readback, so an in-flight decode chunk is never stalled.
+        The first token's readback is deferred to
+        :meth:`_resolve_pending` at the loop's next sync point."""
         pages = [self.free_pages.pop() for _ in range(need)]
         row = np.full((self.pages_per_seq,), self.trash, np.int32)
         row[:need] = pages
         self._table[slot] = row
         self.cache["table"] = jnp.asarray(self._table)
         T = int(req.prompt.size)
+        padded = self._bucket_len(T)
+        prompt = req.prompt
+        if padded > T:
+            # right-pad to the bucket rung: causality keeps the true
+            # prefix exact; the pad K/V is cursor-masked garbage inside
+            # pages the row owns, overwritten as the row generates
+            prompt = np.concatenate(
+                [prompt, np.zeros(padded - T, np.int32)])
         # one-row prefill THROUGH the shared pool: the scatter touches
-        # only this row's pages (compiles per distinct prompt length —
-        # bucket/pad prompts upstream if that matters)
+        # only this row's pages (compiles once per bucket rung)
         one = dict(self.cache)
         # fresh upload from the host mirror, NOT a slice of the device
         # table: a full-range slice can alias the same buffer, and
         # _prefill_one donates its table — an alias would delete the
         # engine's live table with it
         one["table"] = jnp.asarray(self._table[slot:slot + 1])
-        with metricslib.span("serve.prefill", prompt_len=T):
+        with metricslib.span("serve.prefill", prompt_len=T,
+                             padded_len=padded):
             logits, out = _prefill_one(
-                self.params, jnp.asarray(req.prompt)[None, :], one,
+                self.params, jnp.asarray(prompt)[None, :],
+                jnp.int32(T - 1), one,
                 cfg=self.cfg, page_size=self.page_size, mesh=self.mesh,
             )
         for k, v in out.items():
@@ -359,38 +596,66 @@ class ContinuousBatcher:
             done = dict(self.dcache)
             done["table"] = jnp.asarray(self._table[slot:slot + 1])
             _, dout = _prefill_one(
-                self.draft_params, jnp.asarray(req.prompt)[None, :],
-                done, cfg=self.draft_cfg, page_size=self.page_size,
-                mesh=self.mesh,
+                self.draft_params, jnp.asarray(prompt)[None, :],
+                jnp.int32(T - 1), done, cfg=self.draft_cfg,
+                page_size=self.page_size, mesh=self.mesh,
             )
             for k, v in dout.items():
                 if k != "table":
                     self.dcache[k] = v
-        first = int(jnp.argmax(logits[0]))
+        key = req.key if req.key is not None else self.request_key(
+            req.seq_id)
+        temp = (req.temperature if req.temperature is not None
+                else self.temperature)
+        (self.pos, self.limit, self.tokens, self.keys, self.temps,
+         first_dev) = _admit_row(
+            self.pos, self.limit, self.tokens, self.keys, self.temps,
+            logits, key, jnp.float32(max(temp, 1e-6)), slot, T,
+            req.max_new, eos_id=self.eos_id, greedy=self.greedy,
+            top_k=self.top_k)
         st = self._slots[slot]
         st.seq_id, st.pages, st.prompt_len = req.seq_id, pages, T
-        st.out, st.active = [first], True
+        st.budget = req.max_new
+        st.out, st.active = [], True
+        st.first_dev = first_dev
+        st.t_submit = req.t_submit
         st.t_admit = time.perf_counter()
+        self._pending.append(slot)
         self._emit(kind="serve_admit", seq_id=req.seq_id, slot=slot,
-                   pages=need, prompt_len=T, budget=req.max_new,
+                   pages=need, prompt_len=T, padded_len=padded,
+                   budget=req.max_new, overlapped=overlapped,
                    free_pages=len(self.free_pages),
                    queued=len(self._queue))
         m = metricslib.get_metrics()
         if m.enabled:
-            # prefill emitted the first token: admit time IS first-token
-            # time for this engine (TTFT counted from submit)
-            m.histogram("serve.ttft_s").observe(
-                st.t_admit - (req.t_submit or st.t_admit))
             m.gauge("serve.queue_depth").set(len(self._queue))
             m.gauge("serve.free_pages").set(len(self.free_pages))
             m.counter("serve.admitted").inc()
-        self.pos = self.pos.at[slot].set(T)
-        done = (self.eos_id >= 0 and first == self.eos_id) or req.max_new == 1
-        self.limit = self.limit.at[slot].set(
-            T if done else T + req.max_new - 1)
-        self.tokens = self.tokens.at[slot].set(first)
-        if done:
-            self._finish(slot)
+            if overlapped:
+                m.counter("serve.admit_overlapped").inc()
+
+    def _resolve_pending(self):
+        """Host bookkeeping deferred from :meth:`_admit`: read back the
+        first tokens (by now computed behind — or overlapped with — the
+        decode chunk), stamp TTFT, and finish rows that were done at
+        admission (budget 1, or eos as the first token; the device-side
+        limit already froze them out of the chunks)."""
+        for slot in self._pending:
+            st = self._slots[slot]
+            first = int(jax.device_get(st.first_dev))
+            st.first_dev = None
+            st.out = [first]
+            m = metricslib.get_metrics()
+            if m.enabled:
+                # prefill emitted the first token: its readback IS
+                # first-token availability (TTFT counted from submit)
+                m.histogram("serve.ttft_s").observe(
+                    time.perf_counter() - (st.t_submit
+                                           or time.perf_counter()))
+            if (self.eos_id >= 0 and first == self.eos_id) \
+                    or st.budget == 1:
+                self._finish(slot)
+        self._pending.clear()
 
     # -- completion --------------------------------------------------------
 
@@ -419,17 +684,33 @@ class ContinuousBatcher:
 
     # -- the loop ----------------------------------------------------------
 
-    def _run_chunk(self):
-        pos_start = np.asarray(self.pos)
-        with metricslib.span("serve.decode_round", chunk=self.chunk):
-            self.cache, self.pos, self.limit, self.tokens, out = _chunk_step(
-                self.params, self.cache, self.pos, self.limit, self.tokens,
+    def _dispatch_chunk(self):
+        """Enqueue one ``chunk`` dispatch for the currently active rows
+        and return the in-flight handle (participants, their start
+        cursors, the un-read token block) — no readback here."""
+        # a true COPY, not np.asarray: on CPU that returns a zero-copy
+        # view of the device buffer, and _chunk_step DONATES it — an
+        # executable that honors the donation (cache-loaded ones do)
+        # overwrites the "snapshot" in place with the post-chunk cursors
+        pos_start = np.array(self.pos)
+        parts = [i for i, s in enumerate(self._slots) if s.active]
+        with metricslib.span("serve.decode_dispatch", chunk=self.chunk):
+            (self.cache, self.pos, self.limit, self.tokens, self.keys,
+             out) = _chunk_step(
+                self.params, self.cache, self.pos, self.limit,
+                self.tokens, self.keys, self.temps,
                 cfg=self.cfg, chunk=self.chunk, eos_id=self.eos_id,
-                mesh=self.mesh,
+                greedy=self.greedy, top_k=self.top_k, mesh=self.mesh,
             )
-            out = np.asarray(out)  # (chunk, slots); readback closes the span
+        return parts, pos_start, out
+
+    def _collect_chunk(self, inflight):
+        parts, pos_start, out = inflight
+        with metricslib.span("serve.decode_round", chunk=self.chunk):
+            out = np.asarray(out)  # (chunk, slots); readback = sync
         limit_new = np.asarray(self.limit)
-        for i, st in enumerate(self._slots):
+        for i in parts:
+            st = self._slots[i]
             if not st.active:
                 continue
             valid = int(np.clip(limit_new[i] - pos_start[i], 0,
@@ -438,27 +719,36 @@ class ContinuousBatcher:
             if pos_start[i] + valid >= limit_new[i]:
                 self._finish(i)
 
-    def _run_spec_round(self):
+    def _dispatch_spec(self):
         """``chunk`` draft-assisted rounds per dispatch: budget/EOS
         truncation happens on device between rounds (_spec_chunk), so
         over-acceptance beyond a limit is discarded there and the
         caches' stale rows get overwritten when the cursor re-crosses
-        them (the speculative invariant). The host just appends each
-        round's valid tokens and finishes exhausted rows."""
-        with metricslib.span("serve.spec_round", rounds=self.chunk,
+        them (the speculative invariant)."""
+        parts = [i for i, s in enumerate(self._slots) if s.active]
+        with metricslib.span("serve.spec_dispatch", rounds=self.chunk,
                              gamma=self.gamma):
             (self.cache, self.dcache, self.pos, self.limit, self.tokens,
-             emits, advs) = _spec_chunk(
+             self._spec_key, emits, advs) = _spec_chunk(
                 self.params, self.draft_params, self.cache, self.dcache,
-                self.pos, self.limit, self.tokens,
+                self.pos, self.limit, self.tokens, self._spec_key,
+                self.temps,
                 cfg=self.cfg, dcfg=self.draft_cfg, gamma=self.gamma,
-                rounds=self.chunk, eos_id=self.eos_id, mesh=self.mesh,
+                rounds=self.chunk, eos_id=self.eos_id,
+                greedy=self.greedy, top_k=self.top_k, mesh=self.mesh,
             )
+        return parts, None, (emits, advs)
+
+    def _collect_spec(self, inflight):
+        parts, _, (emits, advs) = inflight
+        with metricslib.span("serve.spec_round", rounds=self.chunk,
+                             gamma=self.gamma):
             emits = np.asarray(emits)  # (rounds, slots, gamma+1)
             advs = np.asarray(advs)    # (rounds, slots)
         pos_np = np.asarray(self.pos)
         limit_np = np.asarray(self.limit)
-        for i, st in enumerate(self._slots):
+        for i in parts:
+            st = self._slots[i]
             if not st.active:
                 continue
             for k in range(advs.shape[0]):
@@ -471,20 +761,48 @@ class ContinuousBatcher:
     def run(self):
         """Serve until queue and slots drain. Returns ``finished``:
         {seq_id: np.ndarray of emitted tokens (<= max_new; ends at
-        eos_id when enabled)}."""
+        eos_id when enabled)}.
+
+        Loop shape (``overlap=True``): DISPATCH the chunk for the rows
+        already running, then do this round's admissions behind it —
+        the table uploads, bucket-padded prefills, and first-token
+        picks all enqueue while the chunk executes, and the chunk's
+        readback is the sync point that also resolves them. Admission
+        host time with no decode in flight (the first wave, or an
+        admission-only iteration) is the ADMISSION BUBBLE; its fraction
+        of the run lands in ``last_bubble_frac`` and the
+        ``serve.admit_bubble_frac`` gauge. ``overlap=False`` keeps the
+        serial order (admit, then decode) — the measurable baseline."""
+        t_run0 = time.perf_counter()
+        t_exposed = 0.0
+        spec = self.draft_params is not None
+        dispatch = self._dispatch_spec if spec else self._dispatch_chunk
+        collect = self._collect_spec if spec else self._collect_chunk
         while self._queue or any(s.active for s in self._slots):
-            while self._try_admit():
-                pass
-            if not any(s.active for s in self._slots):
-                if self._queue:
-                    raise RuntimeError(
-                        "serving deadlock: waiting requests but no "
-                        "admissible slot/pages (pool too small for the "
-                        "smallest waiting request)"
-                    )
-                break
-            if self.draft_params is not None:
-                self._run_spec_round()
-            else:
-                self._run_chunk()
+            inflight = None
+            if self.overlap and any(s.active for s in self._slots):
+                inflight = dispatch()
+            t0 = time.perf_counter()
+            admitted = 0
+            while self._try_admit(overlapped=inflight is not None):
+                admitted += 1
+            self._resolve_pending()
+            if inflight is None:
+                t_exposed += time.perf_counter() - t0
+                if not any(s.active for s in self._slots):
+                    if self._queue and not admitted:
+                        raise RuntimeError(
+                            "serving deadlock: waiting requests but no "
+                            "admissible slot/pages (pool too small for "
+                            "the smallest waiting request)"
+                        )
+                    continue  # everything admitted finished at admit
+                inflight = dispatch()
+            collect(inflight)
+        total = time.perf_counter() - t_run0
+        self.last_bubble_frac = (t_exposed / total) if total > 0 else 0.0
+        m = metricslib.get_metrics()
+        if m.enabled:
+            m.gauge("serve.admit_bubble_frac").set(self.last_bubble_frac)
+            m.gauge("serve.prefill_compiles").set(prefill_cache_size())
         return self.finished

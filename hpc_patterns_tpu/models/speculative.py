@@ -268,8 +268,16 @@ def paged_round(params, cfg, draft_params, draft_cfg, cache, dcache,
     ``mesh``: tp-sharded rounds — the draft's ragged steps take the
     shard_map paged-kernel route (kv-head blocks), while the ragged
     extend is pure XLA scatter/gather/einsum math and partitions via
-    GSPMD from the sharded params/pools alone."""
+    GSPMD from the sharded params/pools alone.
+
+    ``temperature``: a scalar, or PER-ROW ``(B,)`` temperatures — the
+    serving engine's per-request sampling knob; each row's draft picks
+    and warped accept/resample distributions use its own value."""
     B = pos_eff.shape[0]
+    temperature = jnp.asarray(temperature, jnp.float32)
+    per_row = temperature.ndim == 1
+    t_draft = temperature[:, None] if per_row else temperature
+    t_verify = temperature[:, None, None] if per_row else temperature
     props = []
     qs = []
     tok = cur
@@ -278,11 +286,11 @@ def paged_round(params, cfg, draft_params, draft_cfg, cache, dcache,
         dlogits, dc = paged_decode_step(draft_params, dc, pos_eff + j,
                                         tok, draft_cfg, mesh=mesh)
         key, sub = jax.random.split(key)
-        tok = _pick(dlogits, sub, temperature, greedy, top_k)
+        tok = _pick(dlogits, sub, t_draft, greedy, top_k)
         if j < gamma:
             props.append(tok)
             if not greedy:
-                qs.append(_warp(dlogits, temperature, top_k))
+                qs.append(_warp(dlogits, t_draft, top_k))
     props = jnp.stack(props, axis=1)  # (B, gamma)
 
     chunk = jnp.concatenate([cur[:, None], props], axis=1)
@@ -298,7 +306,7 @@ def paged_round(params, cfg, draft_params, draft_cfg, cache, dcache,
         a, nxt = jax.vmap(_accept_resample)(
             jax.random.split(sub, B), props,
             jnp.stack(qs, axis=1),
-            _warp(vlogits, temperature, top_k),
+            _warp(vlogits, t_verify, top_k),
         )
     props_padded = jnp.concatenate([props, props[:, -1:]], axis=1)
     emit = jnp.where(jnp.arange(gamma + 1)[None, :] < a[:, None],

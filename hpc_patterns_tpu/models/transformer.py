@@ -41,6 +41,7 @@ from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from hpc_patterns_tpu.models.sharding_util import mesh_axis_size, resolve_spec
+from hpc_patterns_tpu.topology import shard_map
 from hpc_patterns_tpu.parallel.ring_attention import full_attention, ring_attention
 from hpc_patterns_tpu.parallel.ulysses import ulysses_attention
 
@@ -340,7 +341,7 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
         # full local sequence
         spec = resolve_spec(P(cfg.batch_axes, None, cfg.axis_tp, None), mesh,
                             cfg.mesh_axes)
-        return jax.shard_map(
+        return shard_map(
             partial(flash_attention, causal=True), mesh=mesh,
             in_specs=(spec, spec, spec), out_specs=spec,
         )(q, k, v)
@@ -352,7 +353,7 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
     local_impl = variant or "dense"
     impl_fn = ulysses_attention if base == "ulysses" else ring_attention
     fn = partial(impl_fn, axis=cfg.axis_sp, causal=True, impl=local_impl)
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)
 
@@ -449,7 +450,7 @@ def _moe_block(h, lp, cfg: TransformerConfig, mesh, with_stats=False):
         if has(ep) and batch_over_ep
         else resolve_spec(P(cfg.batch_axes, sp, None), mesh, cfg.mesh_axes)
     )
-    out = jax.shard_map(
+    out = shard_map(
         local,
         mesh=mesh,
         in_specs=(tok_spec, P(None, None),
@@ -533,7 +534,7 @@ def _mlp_fused(h, lp, cfg: TransformerConfig, mesh):
         y = fused_mlp(h, w1, w2)
         return lax.psum(y, tp) if has_tp else y
 
-    return jax.shard_map(
+    return shard_map(
         local, mesh=mesh, in_specs=(x_spec, w1_spec, w2_spec),
         out_specs=x_spec,
         check_vma=False,  # pallas_call can't declare vma

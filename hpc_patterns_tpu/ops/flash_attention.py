@@ -414,7 +414,12 @@ def _align_vma(*arrays):
     """Bring every array to the union of their varying-mesh-axes sets
     (``lax.pvary``), so the kernels work inside ``shard_map``
     (check_vma=True) even when some inputs — e.g. the constant zero
-    offsets — are replicated. Returns (arrays, union_vma)."""
+    offsets — are replicated. Returns (arrays, union_vma). On jax
+    builds without the varying-axes type machinery (no ``jax.typeof``
+    — e.g. 0.4.x, where shard_map's check is ``check_rep``) there is
+    nothing to align: arrays pass through with an empty vma."""
+    if not hasattr(jax, "typeof"):
+        return arrays, frozenset()
     vma = frozenset().union(*(jax.typeof(x).vma for x in arrays))
     out = tuple(
         lax.pcast(x, tuple(vma - jax.typeof(x).vma), to='varying') if vma - jax.typeof(x).vma
@@ -422,6 +427,14 @@ def _align_vma(*arrays):
         for x in arrays
     )
     return out, vma
+
+
+def _sds(shape, dtype, vma):
+    """``jax.ShapeDtypeStruct`` with the varying-axes set — omitted on
+    jax builds whose ShapeDtypeStruct predates the ``vma`` kwarg."""
+    if hasattr(jax, "typeof"):
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _masked_scores(qr, kr, offs, scale, causal):
@@ -517,7 +530,7 @@ def _forward_impl(q, k, v, offs, *, causal, scale, block_q, block_k,
         out = outr.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
         return out, (qr, kr, vr, outr, lse)
     out_specs = [blk_q]
-    out_shape = [jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype, vma=vma)]
+    out_shape = [_sds((B * H, Tq, D), q.dtype, vma)]
     if need_lse:
         out_specs.append(
             pl.BlockSpec((None, block_q, 1),
@@ -525,7 +538,7 @@ def _forward_impl(q, k, v, offs, *, causal, scale, block_q, block_k,
                          memory_space=pltpu.VMEM)
         )
         out_shape.append(
-            jax.ShapeDtypeStruct((B * H, Tq, 1), jnp.float32, vma=vma)
+            _sds((B * H, Tq, 1), jnp.float32, vma)
         )
 
     results = pl.pallas_call(
@@ -693,10 +706,9 @@ def _backward_impl(qr, kr, vr, outr, lse, offs, g, g_lse, *, causal, scale,
                 ],
             ),
             out_shape=(
-                jax.ShapeDtypeStruct((B * Hkv, Tk, D), kr.dtype, vma=vma),
-                jax.ShapeDtypeStruct((B * Hkv, Tk, D), vr.dtype, vma=vma),
-                jax.ShapeDtypeStruct((n_kv, B * H, Tq_c, D), qr.dtype,
-                                     vma=vma),
+                _sds((B * Hkv, Tk, D), kr.dtype, vma),
+                _sds((B * Hkv, Tk, D), vr.dtype, vma),
+                _sds((n_kv, B * H, Tq_c, D), qr.dtype, vma),
             ),
             interpret=interpret,
         )
@@ -744,7 +756,7 @@ def _backward_impl(qr, kr, vr, outr, lse, offs, g, g_lse, *, causal, scale,
             out_specs=q_on1,
             scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         ),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), qr.dtype, vma=vma),
+        out_shape=_sds((B * H, Tq, D), qr.dtype, vma),
         interpret=interpret,
     )(offs, qr, kr, vr, dor, lse, delta)
 
@@ -761,8 +773,8 @@ def _backward_impl(qr, kr, vr, outr, lse, offs, g, g_lse, *, causal, scale,
             ],
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((B * Hkv, Tk, D), kr.dtype, vma=vma),
-            jax.ShapeDtypeStruct((B * Hkv, Tk, D), vr.dtype, vma=vma),
+            _sds((B * Hkv, Tk, D), kr.dtype, vma),
+            _sds((B * Hkv, Tk, D), vr.dtype, vma),
         ),
         interpret=interpret,
     )(offs, qr, dor, lse, delta, kr, vr)

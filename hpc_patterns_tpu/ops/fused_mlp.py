@@ -38,6 +38,11 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# CompilerParams was TPUCompilerParams before the pallas.tpu rename;
+# bind whichever this jax build exports
+_compiler_params = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
+
 _SQRT_2_OVER_PI = 0.7978845608028654
 _GELU_C = 0.044715
 
@@ -181,7 +186,7 @@ def _forward(x2, w1, w2, block_t, block_f, interpret, save_a=False):
         scratch_shapes=[pltpu.VMEM((bt, D), jnp.float32)],
         # big token blocks (f32 acc + double-buffered panels) can pass
         # Mosaic's 16 MB default scoped limit; physical VMEM is larger
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             vmem_limit_bytes=64 * 1024 * 1024,
         ),
         interpret=interpret,
@@ -226,7 +231,7 @@ def _backward(x2, w1, w2, dy2, block_t, block_f, interpret):
         # block set + f32 dW accumulators legitimately need ~18-24 MB
         # of VMEM at the flagship shape — above Mosaic's 16 MB default
         # scoped limit, well under the physical budget
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             vmem_limit_bytes=64 * 1024 * 1024,
         ),
         interpret=interpret,

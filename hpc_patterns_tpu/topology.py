@@ -34,6 +34,28 @@ import numpy as np
 from jax.sharding import Mesh
 
 
+def shard_map(f, *, mesh, in_specs, out_specs,
+              check_vma: bool | None = None):
+    """``jax.shard_map`` across jax versions — ONE call shape for every
+    site in the tree. Promoted ``jax.shard_map`` (and its ``check_vma``
+    flag) when the build has it; the pre-promotion
+    ``jax.experimental.shard_map`` location otherwise, where the flag
+    was named ``check_rep`` (same meaning: replication/varying-axes
+    checking, which a ``pallas_call`` body cannot declare)."""
+    if hasattr(jax, "shard_map"):
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    # pre-promotion check_rep has NO pallas_call replication rule (the
+    # promoted checker reads the kernels' declared vma instead), so the
+    # old route runs unchecked unless a caller asks explicitly
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs,
+                      check_rep=bool(check_vma) if check_vma else False)
+
+
 class TopologyError(RuntimeError):
     """Raised when no usable device topology exists.
 
@@ -433,17 +455,25 @@ def make_mesh(
     sizes = _factor_axes(len(devices), axes)
     names = tuple(sizes.keys())
     shape = tuple(sizes[k] for k in names)
-    # Auto axis types: the framework uses with_sharding_constraint /
-    # shard_map-style GSPMD, not the Explicit sharding-in-types mode.
-    axis_types = (jax.sharding.AxisType.Auto,) * len(names)
+    kw = _auto_axis_types(len(names))
     try:
         # Let JAX pick an ICI-friendly physical layout when it can.
-        return jax.make_mesh(
-            shape, names, devices=tuple(devices), axis_types=axis_types
-        )
+        return jax.make_mesh(shape, names, devices=tuple(devices), **kw)
     except (ValueError, TypeError):
         dev_array = np.asarray(devices).reshape(shape)
-        return Mesh(dev_array, names, axis_types=axis_types)
+        return Mesh(dev_array, names, **kw)
+
+
+def _auto_axis_types(n: int) -> dict:
+    """Auto axis types for mesh construction: the framework uses
+    with_sharding_constraint / shard_map-style GSPMD, not the Explicit
+    sharding-in-types mode. On jax builds predating sharding-in-types
+    (no ``jax.sharding.AxisType`` — e.g. 0.4.x) GSPMD-auto is the ONLY
+    mode and the kwarg doesn't exist; omit it rather than fail every
+    mesh build."""
+    if hasattr(jax.sharding, "AxisType"):
+        return {"axis_types": (jax.sharding.AxisType.Auto,) * n}
+    return {}
 
 
 def single_device_mesh(axes: Sequence[str] = ("dp",)) -> Mesh:
@@ -504,5 +534,4 @@ def make_hybrid_mesh(
     within (see :func:`hybrid_device_layout`). On a single slice this
     degenerates to ``make_mesh`` with the DCN axes sized 1."""
     arr, names = hybrid_device_layout(dcn_axes, ici_axes, devices)
-    axis_types = (jax.sharding.AxisType.Auto,) * len(names)
-    return Mesh(arr, names, axis_types=axis_types)
+    return Mesh(arr, names, **_auto_axis_types(len(names)))

@@ -53,7 +53,12 @@ def restore_params(path, step: int | None = None):
         step = latest_step(path)
         if step is None:
             raise FileNotFoundError(f"no step_* checkpoints under {path}")
-    tree = _checkpointer().metadata(path / f"step_{step}").item_metadata.tree
+    meta = _checkpointer().metadata(path / f"step_{step}")
+    # newer orbax wraps the saved tree's metadata in
+    # CompositeItemMetadata (.item_metadata.tree); older builds return
+    # the metadata tree (a dict) directly
+    tree = (meta.item_metadata.tree if hasattr(meta, "item_metadata")
+            else meta)
     # request only the params and step subtrees (partial restore): the
     # opt_state (~2x param bytes of Adam moments) is never read off disk
     wanted = {"params": tree["params"], "step": tree["step"]}
@@ -63,13 +68,21 @@ def restore_params(path, step: int | None = None):
         else m,
         wanted,
     )
+    import dataclasses
+
+    # orbax renamed the partial-restore mechanism: newer builds take
+    # partial_restore=True; older ones restore a sub-item iff an empty
+    # transforms dict marks the request as transform-style
+    fields = {f.name for f in dataclasses.fields(ocp.args.PyTreeRestore)}
+    partial = ({"partial_restore": True} if "partial_restore" in fields
+               else {"transforms": {}})
     with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckpt:
         state = ckpt.restore(
             path / f"step_{step}",
             args=ocp.args.PyTreeRestore(
                 item=abstract,
                 restore_args=ocp.checkpoint_utils.construct_restore_args(abstract),
-                partial_restore=True,
+                **partial,
             ),
         )
     return state["params"], int(state["step"])

@@ -51,8 +51,19 @@ def _cpu_fingerprint() -> str:
     return platform.machine() or "unknown"
 
 
+# the jaxlib version joins the key: XLA:CPU loads entries from a
+# different build with only a warning, and version drift risks more
+# than the SIGILLs the CPU-flags fingerprint was added for. (Round 6
+# note: six serving-test failures that vanished with a fresh cache
+# looked like cache corruption but were a serving bug — a zero-copy
+# np.asarray view of a buffer the engine then DONATED; cache-loaded
+# executables honor the donation in place. Fixed in serving.py; the
+# version keying stays as cheap defense-in-depth.)
+import jaxlib  # noqa: E402
+
 _cache_dir = (Path(__file__).resolve().parent.parent / ".cache"
-              / f"jax-{_cpu_fingerprint()}")
+              / f"jax-{_cpu_fingerprint()}-{jax.__version__}"
+                f"-{jaxlib.__version__}")
 jax.config.update("jax_compilation_cache_dir", str(_cache_dir))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 

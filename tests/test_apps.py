@@ -216,7 +216,23 @@ class TestServeApp:
         )
         out = capsys.readouterr().out
         assert code == 0, out
-        assert "oracle exact" in out and "SUCCESS" in out
+        assert "oracle[exact] ok" in out and "SUCCESS" in out
+        assert "bubble" in out and "prefill compiles" in out
+
+    def test_serve_sampled_and_mix(self, capsys):
+        # the production knobs through the CLI: mixed prompt lengths,
+        # sampled decode, bucketed admission — sampled oracle stays
+        # standalone-exact (per-request key streams)
+        from hpc_patterns_tpu.apps import serve_app
+
+        code = serve_app.main(
+            ["--requests", "5", "--slots", "2", "--budget", "6",
+             "--prompt-len", "10", "--chunk", "2", "--prompt-mix",
+             "--temperature", "0.9", "--top-k", "8", "--seed", "4"]
+        )
+        out = capsys.readouterr().out
+        assert code == 0, out
+        assert "oracle[sampled exact] ok" in out and "SUCCESS" in out
 
     def test_serve_eos_and_int8(self, capsys):
         from hpc_patterns_tpu.apps import serve_app

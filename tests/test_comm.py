@@ -7,6 +7,8 @@ reference's: allreduce of rank-valued buffers == size(size-1)/2
 """
 
 import jax
+
+from hpc_patterns_tpu.topology import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -118,7 +120,7 @@ def test_shard_rejects_bad_leading_dim(comm):
 def shmap(fn, mesh, n_in=1):
     spec = P("x", None)
     return jax.jit(
-        jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * n_in, out_specs=spec)
+        shard_map(fn, mesh=mesh, in_specs=(spec,) * n_in, out_specs=spec)
     )
 
 
@@ -159,7 +161,7 @@ def test_pairwise_exchange_needs_even_world():
         return ring.pairwise_exchange(local, "x")
 
     with pytest.raises(ValueError, match="even axis size"):
-        jax.shard_map(
+        shard_map(
             per_rank, mesh=mesh_odd, in_specs=P("x", None), out_specs=P("x", None)
         )(jnp.ones((3, 4)))
 

@@ -4,6 +4,8 @@ import numpy as np
 import pytest
 
 import jax
+
+from hpc_patterns_tpu.topology import shard_map
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -169,7 +171,7 @@ class TestPipelineTraining:
                 mine = jnp.where(me == 7, jnp.mean(jnp.square(outs)), 0.0)
                 return jax.lax.psum(mine, "x")[None]
 
-            per_rank = jax.shard_map(
+            per_rank = shard_map(
                 local, mesh=mesh8,
                 in_specs=(P(), P("x", None, None)),
                 out_specs=P("x"),

@@ -4,6 +4,8 @@ import numpy as np
 import pytest
 
 import jax
+
+from hpc_patterns_tpu.topology import shard_map
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -15,7 +17,7 @@ class TestHaloExchange:
         n = 32  # 4 rows per rank
         x = jnp.arange(n, dtype=jnp.float32)
         padded = jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda u: halo.halo_exchange(u, "x")[None],
                 mesh=mesh8, in_specs=P("x"), out_specs=P("x", None),
             )

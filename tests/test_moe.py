@@ -5,6 +5,8 @@ import numpy as np
 import pytest
 
 import jax
+
+from hpc_patterns_tpu.topology import shard_map
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -30,7 +32,7 @@ class TestMoE:
         x = jax.random.normal(jax.random.PRNGKey(3), (8 * N_LOCAL, D), jnp.float32)
 
         y_ep, aux_ep = jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda xl, wa, wb: moe.moe_ep(
                     xl, router, wa, wb, axis="x", capacity=cap
                 ),
@@ -126,7 +128,7 @@ class TestTopK:
         x = jax.random.normal(jax.random.PRNGKey(7), (8 * N_LOCAL, D),
                               jnp.float32)
         y_ep, aux_ep, kept_ep = jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda xl, wa, wb: moe.moe_ep(
                     xl, router, wa, wb, axis="x", capacity=cap, top_k=2,
                     with_stats=True,
@@ -202,7 +204,7 @@ class TestScatterDispatch:
         x = jax.random.normal(jax.random.PRNGKey(11), (8 * N_LOCAL, D),
                               jnp.float32)
         y_ep, aux_ep = jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda xl, wa, wb: moe.moe_ep(
                     xl, router, wa, wb, axis="x", capacity=cap,
                     dispatch="scatter",

@@ -8,6 +8,8 @@ import numpy as np
 import pytest
 
 import jax
+
+from hpc_patterns_tpu.topology import shard_map
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -26,7 +28,7 @@ def _qkv(key, dtype=jnp.float32):
 def _shmap_seq(mesh, fn, *arrays, axis="x"):
     """Run a rank-local attention fn over sequence-sharded (dim 1) inputs."""
     spec = P(None, axis, None, None)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         fn, mesh=mesh, in_specs=(spec,) * len(arrays), out_specs=spec
     )
     return jax.jit(mapped)(*arrays)
@@ -71,7 +73,7 @@ class TestRingAttention:
     def test_flash_impl_grad_matches_oracle(self, mesh8):
         q, k, v = _qkv(jax.random.PRNGKey(3))
         spec = P(None, "x", None, None)
-        ringed = jax.shard_map(
+        ringed = shard_map(
             lambda q, k, v: parallel.ring_attention(
                 q, k, v, "x", causal=True, impl="flash"
             ),
@@ -89,7 +91,7 @@ class TestRingAttention:
 
     def test_rejects_bad_impl(self, mesh8):
         with pytest.raises(ValueError, match="impl"):
-            jax.shard_map(
+            shard_map(
                 lambda q: parallel.ring_attention(q, q, q, "x", impl="nope"),
                 mesh=mesh8,
                 in_specs=P(None, "x", None, None),
@@ -98,7 +100,7 @@ class TestRingAttention:
 
     def test_rejects_bad_rank(self, mesh8):
         with pytest.raises(ValueError, match="head_dim"):
-            jax.shard_map(
+            shard_map(
                 lambda q: parallel.ring_attention(q, q, q, "x"),
                 mesh=mesh8, in_specs=P("x"), out_specs=P("x"),
             )(jnp.zeros((8, D)))
@@ -133,7 +135,7 @@ class TestUlysses:
         # flash's custom VJP composed with the all-to-all backward
         q, k, v = _qkv(jax.random.PRNGKey(5))
         spec = P(None, "x", None, None)
-        mapped = jax.shard_map(
+        mapped = shard_map(
             lambda q, k, v: parallel.ulysses_attention(
                 q, k, v, "x", causal=True, impl="flash"
             ),
@@ -206,7 +208,7 @@ class TestGQANarrowKV:
     def test_ring_narrow_kv_grad(self, mesh8):
         q, k, v = self._gqa_qkv(jax.random.PRNGKey(12), hkv=2)
         spec = P(None, "x", None, None)
-        ringed = jax.shard_map(
+        ringed = shard_map(
             lambda q, k, v: parallel.ring_attention(
                 q, k, v, "x", causal=True, impl="flash"
             ),
@@ -270,7 +272,7 @@ class TestTensorParallel:
 
         for algorithm in ("collective", "ring"):
             got = jax.jit(
-                jax.shard_map(
+                shard_map(
                     lambda x, a, b: parallel.tp_mlp(x, a, b, axis="x",
                                                     algorithm=algorithm),
                     mesh=mesh8,
@@ -292,7 +294,7 @@ class TestTensorParallel:
         want = jnp.dot(x, w)  # then sharded on last dim
 
         got = jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda xl, wl: parallel.tensor.row_parallel_scatter(
                     xl, wl, axis="x"
                 ),
@@ -321,7 +323,7 @@ class TestPipeline:
             return jnp.tanh(jnp.dot(h, w))
 
         got_all = jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda x, w: parallel.pipeline_forward(
                     stage, w[0], x, "x"
                 )[None],
@@ -386,7 +388,7 @@ class TestPipeline1F1B:
             return loss[None], grads[None]
 
         loss, grads = jax.jit(
-            jax.shard_map(
+            shard_map(
                 local,
                 mesh=mesh8,
                 in_specs=(P(), P(), P("x", None, None)),

@@ -1,8 +1,12 @@
 """Continuous batching (models/serving.py): every sequence admitted
 through the shared-pool engine must emit exactly the tokens its
 standalone paged_generate emits — regardless of what was scheduled
-around it, what chunk size amortized the dispatch, or how often its
-pages were recycled."""
+around it, what chunk size amortized the dispatch, how often its pages
+were recycled, what bucket rung padded its prompt, or (in sampled
+mode) what its neighbors drew from their own key streams. Draft-
+assisted SAMPLING is the one law-only surface: the rejection-sampling
+rounds preserve the emitted distribution, not the draws — its oracle
+is distributional."""
 
 import numpy as np
 import pytest
@@ -12,7 +16,11 @@ import jax.numpy as jnp
 
 from hpc_patterns_tpu.models import TransformerConfig, init_params
 from hpc_patterns_tpu.models.decode import paged_generate
-from hpc_patterns_tpu.models.serving import ContinuousBatcher
+from hpc_patterns_tpu.models.serving import (
+    ContinuousBatcher,
+    bucket_ladder,
+    prefill_cache_size,
+)
 
 BASE = dict(vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
             max_seq=64, dtype="float32")
@@ -24,10 +32,10 @@ def _setup(**over):
     return cfg, params
 
 
-def _standalone(params, cfg, prompt, max_new):
+def _standalone(params, cfg, prompt, max_new, **kw):
     return np.asarray(paged_generate(
         params, jnp.asarray(prompt, jnp.int32)[None, :], cfg, max_new,
-        page_size=8))[0]
+        page_size=8, **kw))[0]
 
 
 def _requests(cfg, n, seed=1):
@@ -266,3 +274,269 @@ class TestContinuousBatching:
         eng.submit(np.arange(10, dtype=np.int32), 8)
         with pytest.raises(RuntimeError, match="deadlock"):
             eng.run()
+
+
+class TestBucketedAdmission:
+    def test_ladder(self):
+        assert bucket_ladder(12, lo=4) == (4, 8, 12)
+        assert bucket_ladder(100, lo=16) == (16, 32, 64, 100)
+        assert bucket_ladder(8) == (8,)  # lo above max: one rung
+        with pytest.raises(ValueError, match="max_len"):
+            bucket_ladder(0)
+        with pytest.raises(ValueError, match="growth"):
+            bucket_ladder(64, growth=1.0)
+
+    def test_compile_count_bounded_and_exact(self):
+        # TEN distinct prompt lengths through a THREE-rung ladder: the
+        # admission-prefill jit cache (prefill_cache_size — one entry
+        # per distinct padded length x config) may grow by at most the
+        # ladder size, and every bucket-padded sequence must still be
+        # token-exact vs standalone (causality keeps the true prefix
+        # independent of the padding; last_pos redirects the logits).
+        # d_ff=68 makes the config unique in this process, so the
+        # cache delta belongs to THIS engine alone.
+        cfg, params = _setup(d_ff=68)
+        ladder = bucket_ladder(12, lo=4)
+        eng = ContinuousBatcher(params, cfg, slots=2, pool_pages=8,
+                                pages_per_seq=4, page_size=8, chunk=4,
+                                prompt_buckets=ladder)
+        rng = np.random.RandomState(2)
+        reqs = [(rng.randint(0, cfg.vocab, size=t).astype(np.int32), 5)
+                for t in range(1, 11)]  # every length 1..10
+        before = prefill_cache_size()
+        ids = [eng.submit(p, m) for p, m in reqs]
+        got = eng.run()
+        assert prefill_cache_size() - before <= len(ladder)
+        for sid, (prompt, max_new) in zip(ids, reqs):
+            np.testing.assert_array_equal(
+                got[sid], _standalone(params, cfg, prompt, max_new),
+                err_msg=f"seq {sid} len {len(prompt)}")
+        # a SECOND wave re-uses the warm rungs: zero new compiles
+        before = prefill_cache_size()
+        ids2 = [eng.submit(p, m, seq_id=100 + i)
+                for i, (p, m) in enumerate(reqs)]
+        got = eng.run()
+        assert prefill_cache_size() == before
+        for sid, (prompt, max_new) in zip(ids2, reqs):
+            np.testing.assert_array_equal(
+                got[sid], _standalone(params, cfg, prompt, max_new))
+
+    def test_bucketed_draft_assisted_exact(self):
+        # bucket padding composes with speculative rounds: the draft
+        # prefill pads to the same rung, and greedy draft-assisted
+        # serving stays token-exact
+        from hpc_patterns_tpu.models.transformer import init_params as ip
+
+        cfg, params = _setup()
+        dcfg = TransformerConfig(**{**BASE, "d_model": 16, "d_ff": 32,
+                                    "n_layers": 1, "n_heads": 2})
+        dparams = ip(jax.random.PRNGKey(42), dcfg)
+        eng = ContinuousBatcher(params, cfg, slots=2, pool_pages=10,
+                                pages_per_seq=5, page_size=8,
+                                draft_params=dparams, draft_cfg=dcfg,
+                                gamma=2, prompt_buckets=(4, 8, 12))
+        reqs = _requests(cfg, 4, seed=19)
+        ids = [eng.submit(p, m) for p, m in reqs]
+        got = eng.run()
+        for sid, (prompt, max_new) in zip(ids, reqs):
+            np.testing.assert_array_equal(
+                got[sid], _standalone(params, cfg, prompt, max_new))
+
+    def test_ladder_guards(self):
+        cfg, params = _setup()
+        with pytest.raises(ValueError, match="max_seq"):
+            ContinuousBatcher(params, cfg, slots=1, pool_pages=4,
+                              pages_per_seq=4, page_size=8,
+                              prompt_buckets=(8, 100))
+        eng = ContinuousBatcher(params, cfg, slots=1, pool_pages=4,
+                                pages_per_seq=4, page_size=8,
+                                prompt_buckets=(8,))
+        with pytest.raises(ValueError, match="ladder"):
+            eng.submit(np.arange(9, dtype=np.int32), 4)  # above top rung
+
+    def test_pages_cover_padded_prefill(self):
+        # a 1-token prompt padded to rung 8 with budget 1 needs a page
+        # for the PAD region too — pages_needed must size for the
+        # padded length, or the prefill would scatter past the row's
+        # pages
+        assert ContinuousBatcher.pages_needed(1, 1, 8, padded_len=8) == 1
+        assert ContinuousBatcher.pages_needed(1, 1, 8, padded_len=16) == 2
+        assert ContinuousBatcher.pages_needed(9, 8, 8, padded_len=16) == 3
+
+
+class TestSampledServing:
+    def test_sampled_token_exact_vs_standalone(self):
+        # sampling in the engine is NOT a weaker distributional claim:
+        # each row consumes its own key stream exactly as standalone
+        # paged_generate(key=request_key(sid)) does, so served tokens
+        # are identical draw-for-draw — scheduling independence holds
+        # for sampled serving too
+        cfg, params = _setup()
+        eng = ContinuousBatcher(params, cfg, slots=2, pool_pages=6,
+                                pages_per_seq=3, page_size=8, chunk=3,
+                                temperature=0.8, top_k=8, seed=3)
+        reqs = _requests(cfg, 6, seed=23)
+        ids = [eng.submit(p, m) for p, m in reqs]
+        got = eng.run()
+        for sid, (prompt, max_new) in zip(ids, reqs):
+            want = _standalone(params, cfg, prompt, max_new,
+                               key=eng.request_key(sid),
+                               temperature=0.8, top_k=8)
+            np.testing.assert_array_equal(got[sid], want,
+                                          err_msg=f"seq {sid}")
+
+    def test_sampled_with_buckets_exact(self):
+        cfg, params = _setup()
+        eng = ContinuousBatcher(params, cfg, slots=2, pool_pages=8,
+                                pages_per_seq=4, page_size=8, chunk=4,
+                                temperature=1.1, top_k=0, seed=5,
+                                prompt_buckets=(4, 8, 12))
+        reqs = _requests(cfg, 5, seed=29)
+        ids = [eng.submit(p, m) for p, m in reqs]
+        got = eng.run()
+        for sid, (prompt, max_new) in zip(ids, reqs):
+            want = _standalone(params, cfg, prompt, max_new,
+                               key=eng.request_key(sid),
+                               temperature=1.1)
+            np.testing.assert_array_equal(got[sid], want)
+
+    def test_per_request_overrides(self):
+        # a per-request temperature/key overrides the engine defaults,
+        # and the standalone reproduction uses exactly those
+        cfg, params = _setup()
+        eng = ContinuousBatcher(params, cfg, slots=2, pool_pages=6,
+                                pages_per_seq=3, page_size=8,
+                                temperature=0.7, top_k=0, seed=9)
+        prompt = np.arange(6, dtype=np.int32)
+        my_key = jax.random.PRNGKey(777)
+        sid_default = eng.submit(prompt, 7)
+        sid_custom = eng.submit(prompt, 7, temperature=1.5, key=my_key)
+        got = eng.run()
+        np.testing.assert_array_equal(
+            got[sid_default],
+            _standalone(params, cfg, prompt, 7,
+                        key=eng.request_key(sid_default),
+                        temperature=0.7))
+        np.testing.assert_array_equal(
+            got[sid_custom],
+            _standalone(params, cfg, prompt, 7, key=my_key,
+                        temperature=1.5))
+
+    def test_greedy_engine_rejects_per_request_temperature(self):
+        cfg, params = _setup()
+        eng = ContinuousBatcher(params, cfg, slots=1, pool_pages=3,
+                                pages_per_seq=3, page_size=8)
+        with pytest.raises(ValueError, match="sampling engine"):
+            eng.submit(np.arange(4, dtype=np.int32), 4, temperature=0.9)
+        with pytest.raises(ValueError, match="sampling engine"):
+            eng.submit(np.arange(4, dtype=np.int32), 4,
+                       key=jax.random.PRNGKey(1))
+        with pytest.raises(ValueError, match="> 0"):
+            ContinuousBatcher(params, cfg, slots=1, pool_pages=3,
+                              pages_per_seq=3, page_size=8,
+                              temperature=0.9).submit(
+                np.arange(4, dtype=np.int32), 4, temperature=-1.0)
+
+
+class TestOverlappedAdmission:
+    def test_overlap_output_identical_to_serial(self):
+        # overlapped admission is a SCHEDULING change only: the same
+        # stream through overlap=True and overlap=False engines emits
+        # identical tokens, and the exposed-admission (bubble) fraction
+        # is recorded on both
+        cfg, params = _setup()
+        reqs = _requests(cfg, 8, seed=31)
+        outs, bubbles = [], []
+        for overlap in (True, False):
+            eng = ContinuousBatcher(params, cfg, slots=2, pool_pages=6,
+                                    pages_per_seq=3, page_size=8,
+                                    chunk=2, overlap=overlap)
+            ids = [eng.submit(p, m) for p, m in reqs]
+            got = eng.run()
+            outs.append([got[sid] for sid in ids])
+            bubbles.append(eng.last_bubble_frac)
+        for a, b in zip(*outs):
+            np.testing.assert_array_equal(a, b)
+        assert all(0.0 <= b <= 1.0 for b in bubbles)
+
+    def test_admit_telemetry_has_overlap_fields(self):
+        # first wave admits with nothing in flight (exposed — the
+        # bubble); a request admitted into a freed slot while the OTHER
+        # row's chunk is dispatched records overlapped=True
+        cfg, params = _setup()
+        events = []
+        eng = ContinuousBatcher(params, cfg, slots=2, pool_pages=8,
+                                pages_per_seq=4, page_size=8, chunk=2,
+                                prompt_buckets=(8, 12),
+                                emit=lambda **kw: events.append(kw))
+        eng.submit(np.arange(5, dtype=np.int32), 2)   # finishes fast
+        eng.submit(np.arange(5, dtype=np.int32), 8)   # keeps running
+        eng.submit(np.arange(5, dtype=np.int32), 2)   # admitted mid-run
+        eng.run()
+        admits = [e for e in events if e["kind"] == "serve_admit"]
+        assert [e["seq_id"] for e in admits] == [0, 1, 2]
+        for e in admits:
+            assert e["padded_len"] == 8 and e["prompt_len"] == 5
+        assert admits[0]["overlapped"] is False
+        assert admits[1]["overlapped"] is False
+        assert admits[2]["overlapped"] is True
+
+
+class TestDraftSampledDistribution:
+    def test_draft_assisted_sampling_preserves_law(self):
+        # the distribution oracle for the one law-only serving mode:
+        # draft-assisted SAMPLED serving emits tokens whose law equals
+        # target-only sampling (Leviathan accept/resample), though the
+        # draws differ. Protocol: N requests, same prompt, budget 2 —
+        # token[0] comes from the prefill pick (per-request key: its
+        # law is trivially exact), token[1] from a LIVE rejection-
+        # sampling round against an INDEPENDENT draft (low acceptance,
+        # so the resample branch is exercised). The empirical
+        # distribution of token[1] must match the exact mixture law
+        # q = mean_i p_warped(. | prompt, t0_i) computed from the
+        # target's own logits. Deterministic given the seeds.
+        from hpc_patterns_tpu.models import forward
+        from hpc_patterns_tpu.models.decode import _topk_mask
+        from hpc_patterns_tpu.models.transformer import init_params as ip
+
+        temp, top_k, n_req = 1.0, 4, 160
+        cfg, params = _setup()
+        dcfg = TransformerConfig(**{**BASE, "d_model": 16, "d_ff": 32,
+                                    "n_layers": 1, "n_heads": 2})
+        dparams = ip(jax.random.PRNGKey(1234), dcfg)
+        prompt = np.arange(5, dtype=np.int32)
+        pps = ContinuousBatcher.pages_needed(5, 2, 8, gamma=2)
+        eng = ContinuousBatcher(params, cfg, slots=4,
+                                pool_pages=4 * pps, pages_per_seq=pps,
+                                page_size=8, chunk=2,
+                                draft_params=dparams, draft_cfg=dcfg,
+                                gamma=2, temperature=temp, top_k=top_k,
+                                seed=11)
+        ids = [eng.submit(prompt, 2) for _ in range(n_req)]
+        got = eng.run()
+        firsts = np.array([got[sid][0] for sid in ids])
+        seconds = np.array([got[sid][1] for sid in ids])
+
+        def warped_next(seq):
+            logits = np.asarray(forward(
+                params, jnp.asarray(seq, jnp.int32)[None, :], cfg))[0, -1]
+            masked = np.asarray(_topk_mask(jnp.asarray(logits), top_k))
+            z = (masked / temp) - masked.max()
+            p = np.exp(z)
+            p[~np.isfinite(p)] = 0.0
+            return p / p.sum()
+
+        law = {}
+        q = np.zeros(cfg.vocab)
+        for t0 in firsts:
+            t0 = int(t0)
+            if t0 not in law:
+                law[t0] = warped_next(np.append(prompt, t0))
+            q += law[t0]
+        q /= n_req
+        emp = np.bincount(seconds, minlength=cfg.vocab) / n_req
+        tv = 0.5 * np.abs(emp - q).sum()
+        assert tv < 0.2, (
+            f"draft-assisted sampled law diverged: TV {tv:.3f} "
+            f"(support emp {np.count_nonzero(emp)}, "
+            f"law {np.count_nonzero(q > 1e-6)})")

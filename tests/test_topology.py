@@ -2,6 +2,8 @@
 fission fallback, mesh construction)."""
 
 import jax
+
+from hpc_patterns_tpu.topology import shard_map
 import pytest
 
 from hpc_patterns_tpu import topology
@@ -180,7 +182,7 @@ class TestHybridMesh:
             assert list(mesh.devices[d]) == list(fake_groups[d])
 
         x = jnp.arange(8.0)
-        got = jax.jit(jax.shard_map(
+        got = jax.jit(shard_map(
             lambda v: jax.lax.psum(v, "tp"),
             mesh=mesh, in_specs=P(("dp", "tp")), out_specs=P(("dp", "tp")),
         ))(x)
