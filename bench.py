@@ -19,6 +19,13 @@ Prints ONE JSON line:
   {"metric": "onchip_overlap_speedup", "value": <speedup>, "unit": "x",
    "vs_baseline": <speedup / (theoretical_max / 1.3)>}
 vs_baseline >= 1.0 means the overlap beats the reference's own PASS bar.
+
+``--gate``: capture as usual, write the result as the next
+``BENCH_rNN.json`` round, then run the regression gate
+(``python -m hpc_patterns_tpu.harness.regress``) over the trajectory —
+exit nonzero if the new round degrades a headline metric beyond
+tolerance. The re-grounding sequence (benchmarks/reground_r5.sh) ends
+with this, so a perf regression can no longer land silently.
 """
 
 import json
@@ -74,6 +81,24 @@ def per_pass_seconds(x, mode, tripcount, cal_passes=CAL_PASSES):
                                      cal_passes=cal_passes)
 
 
+def _unavailable_line(err: BaseException) -> str:
+    """Degenerate-capture verdict line for a backend that won't even
+    initialize (value 0.0, never a pass, the error preserved)."""
+    return json.dumps(
+        {
+            "metric": "onchip_overlap_speedup",
+            "value": 0.0,
+            "unit": "x",
+            "vs_baseline": 0.0,
+            "detail": {
+                "degenerate": True,
+                "backend": "unavailable",
+                "error": f"{type(err).__name__}: {err}",
+            },
+        }
+    )
+
+
 def _emit_unavailable(err: BaseException) -> int:
     """Degenerate capture for a backend that won't even initialize.
 
@@ -85,31 +110,28 @@ def _emit_unavailable(err: BaseException) -> int:
     "unavailable", the error preserved in detail.
     """
     print(
-        json.dumps(
-            {
-                "metric": "onchip_overlap_speedup",
-                "value": 0.0,
-                "unit": "x",
-                "vs_baseline": 0.0,
-                "detail": {
-                    "degenerate": True,
-                    "backend": "unavailable",
-                    "error": f"{type(err).__name__}: {err}",
-                },
-            }
-        ),
+        _unavailable_line(err),
         flush=True,  # must reach the pipe before any teardown hang
     )
     return 0
 
 
 def _supervise() -> int:
+    """Print the supervised capture's one verdict line; always rc 0
+    (the verdict itself carries failure as a degenerate capture)."""
+    print(_supervised_capture())
+    return 0
+
+
+def _supervised_capture() -> str:
     """Run the measurement in a child process, enforcing timeouts from
     outside — the only guard that works when jax-import/backend-attach
     blocks inside the plugin's C code. ``HPCPAT_BENCH_INIT_TIMEOUT``
     (default 600 s) bounds import+attach; ``HPCPAT_BENCH_TOTAL_TIMEOUT``
     (default 3600 s) bounds the whole capture — round 4's session died
     MID-measurement, so both phases need a deadline. 0 disables either.
+    Returns the one JSON verdict line (a degenerate ``_unavailable_line``
+    when the child hung or died with no capture).
     """
     init_t = int(os.environ.get("HPCPAT_BENCH_INIT_TIMEOUT", "600"))
     total_t = int(os.environ.get("HPCPAT_BENCH_TOTAL_TIMEOUT", "3600"))
@@ -187,18 +209,84 @@ def _supervise() -> int:
     except (BlockingIOError, OSError, ValueError):
         pass
     if json_line is not None:
-        print(json_line)
-        return 0
+        return json_line
     if timed_out is not None:
-        return _emit_unavailable(timed_out)
-    return _emit_unavailable(
+        return _unavailable_line(timed_out)
+    return _unavailable_line(
         RuntimeError(f"measurement child exited rc={proc.returncode} "
                      "with no capture"))
+
+
+def _run_gate(argv) -> int:
+    """``bench.py --gate``: capture a new round, write it as the next
+    ``BENCH_rNN.json``, then run the regression gate
+    (hpc_patterns_tpu.harness.regress) over the whole trajectory and
+    exit with ITS status — so a re-grounding sequence fails loudly when
+    the newest measured round degrades a headline metric.
+
+    The gate subprocess runs with ``JAX_PLATFORMS=cpu``: regress itself
+    is pure JSON math, but importing the package initializes jax, and
+    this supervisor must never touch the chip tunnel (a dead tunnel
+    hangs ``import jax`` in C — the whole reason the supervisor
+    exists).
+    """
+    import argparse
+    import glob
+
+    p = argparse.ArgumentParser(
+        description="bench capture + regression gate")
+    p.add_argument("--gate", action="store_true")
+    p.add_argument("--rounds-glob", default="BENCH_r*.json",
+                   help="trajectory files to gate against")
+    p.add_argument("--out", default=None,
+                   help="round file to write (default: next BENCH_rNN)")
+    p.add_argument("--tolerance", type=float, default=None,
+                   help="passed through to harness.regress")
+    args = p.parse_args(argv)
+
+    line = _supervised_capture()
+    print(line, flush=True)
+    try:
+        parsed = json.loads(line)
+    except ValueError:
+        parsed = None
+    here = os.path.dirname(os.path.abspath(__file__))
+    prior = sorted(glob.glob(os.path.join(here, args.rounds_glob)))
+    n = 0
+    for path in prior:
+        try:
+            with open(path) as f:
+                n = max(n, int(json.load(f).get("n", 0)))
+        except (OSError, ValueError):
+            continue
+    n += 1
+    # absolute: the gate subprocess runs with cwd=here, so a relative
+    # --out from another cwd would otherwise point it at the wrong file
+    out = os.path.abspath(args.out) if args.out else os.path.join(
+        here, f"BENCH_r{n:02d}.json")
+    with open(out, "w") as f:
+        json.dump({"n": n, "cmd": "python bench.py --gate",
+                   "rc": 0 if parsed is not None else 1,
+                   "tail": line + "\n", "parsed": parsed}, f, indent=2)
+    print(f"wrote round {n} -> {out}", flush=True)
+    cmd = [sys.executable, "-m", "hpc_patterns_tpu.harness.regress",
+           *prior, out]
+    if args.tolerance is not None:
+        cmd += ["--tolerance", str(args.tolerance)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        return subprocess.run(cmd, env=env, cwd=here,
+                              timeout=300).returncode
+    except subprocess.TimeoutExpired:
+        print("ERROR: regression gate timed out", flush=True)
+        return 1
 
 
 def main() -> int:
     # Supervised by default; HPCPAT_BENCH_CHILD marks the measurement
     # child, HPCPAT_BENCH_SUPERVISE=0 opts out (e.g. under a debugger).
+    if os.environ.get("HPCPAT_BENCH_CHILD") != "1" and "--gate" in sys.argv:
+        return _run_gate(sys.argv[1:])
     if (os.environ.get("HPCPAT_BENCH_CHILD") != "1"
             and os.environ.get("HPCPAT_BENCH_SUPERVISE", "1") != "0"):
         return _supervise()

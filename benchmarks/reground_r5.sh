@@ -86,6 +86,10 @@ import sys; sys.argv = ["b", "--seq=131072", "--batch=1", "--remat=1", "--rp=not
 sys.path.insert(0, "benchmarks"); import bench_train as bt; bt.main()
 EOF
 
-# 8. final health check — did the risky jobs degrade the session?
-run "bench.py post-check" python bench.py
+# 8. final health check + REGRESSION GATE: capture the closing round,
+#    write it as the next BENCH_rNN.json, and compare its headline
+#    numbers against the best prior round (harness.regress) — a
+#    sequence that degraded the fast path now fails loudly instead of
+#    appending a silently-worse round
+run "bench.py post-check + regression gate" python bench.py --gate
 echo "DONE $(date +%H:%M:%S)" | tee -a "$LOG"

@@ -16,27 +16,37 @@ from hpc_patterns_tpu.comm import Communicator
 
 
 def run_instrumented(run_fn: Callable[[object], int], args) -> int:
-    """The shared ``--metrics`` session every app main() runs through:
-    install a fresh process-wide registry from the flags (a no-op
-    registry without ``--metrics`` — the disabled fast path), run the
-    app, and on ANY exit path append one ``kind=metrics`` snapshot
-    record to ``--log``, the record `python -m
-    hpc_patterns_tpu.harness.report` aggregates. Appending (never
-    truncating) keeps the app's own records: the snapshot is the log's
-    closing record, like run.sh's trailing grep summary."""
-    from hpc_patterns_tpu.harness import metrics
+    """The shared ``--metrics``/``--trace`` session every app main()
+    runs through: install a fresh process-wide metrics registry AND
+    flight recorder from the flags (both no-ops without their flag —
+    the disabled fast path), run the app, and on ANY exit path append
+    the closing snapshot records to ``--log``: one ``kind=metrics``
+    (aggregated by `python -m hpc_patterns_tpu.harness.report`) and one
+    ``kind=trace`` (exported to Chrome-trace JSON by `python -m
+    hpc_patterns_tpu.harness.trace`). Appending (never truncating)
+    keeps the app's own records: the snapshots are the log's closing
+    records, like run.sh's trailing grep summary."""
+    from hpc_patterns_tpu.harness import metrics, trace
     from hpc_patterns_tpu.harness.runlog import RunLog
 
     # mirror_traces stays off here: profiling.maybe_trace toggles it
     # (and restores it) around the actual traced region, so spans only
     # pay for TraceAnnotation while a trace is live
     m = metrics.configure(enabled=getattr(args, "metrics", False))
+    trace_kw = {}
+    if getattr(args, "trace_capacity", None):
+        trace_kw["capacity"] = args.trace_capacity
+    rec = trace.configure(enabled=getattr(args, "trace", False),
+                          **trace_kw)
     try:
         return run_fn(args)
     finally:
-        if m.enabled and getattr(args, "log", None):
-            RunLog(args.log, truncate=False).emit(
-                kind="metrics", **m.snapshot())
+        if getattr(args, "log", None) and (m.enabled or rec.enabled):
+            log = RunLog(args.log, truncate=False)
+            if m.enabled:
+                log.emit(kind="metrics", **m.snapshot())
+            if rec.enabled:
+                log.emit(kind="trace", **rec.snapshot())
 
 
 def make_communicator(

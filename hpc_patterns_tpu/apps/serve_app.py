@@ -84,6 +84,11 @@ def build_parser():
     p.add_argument("--static-compare", action="store_true",
                    help="also time static batching (batches of "
                         "--slots padded to the batch max budget)")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="enable the flight recorder (implies --trace) "
+                        "and write the Chrome-trace JSON timeline here "
+                        "at exit — one flag from serving run to "
+                        "Perfetto-loadable timeline")
     return p
 
 
@@ -347,7 +352,23 @@ def run(args) -> int:
 
 
 def main(argv=None) -> int:
-    return common.run_instrumented(run, build_parser().parse_args(argv))
+    args = build_parser().parse_args(argv)
+    if args.trace_out:
+        args.trace = True
+    try:
+        return common.run_instrumented(run, args)
+    finally:
+        # ANY exit path writes the timeline (run_instrumented leaves
+        # the per-run recorder installed): a crashed serving run still
+        # produces a loadable artifact showing where it died
+        if args.trace_out:
+            from hpc_patterns_tpu.harness import trace as tracelib
+
+            rec = tracelib.get_tracer()
+            if rec is not None and rec.enabled:
+                out = rec.export(args.trace_out)
+                print(f"trace timeline: {out} (open in Perfetto / "
+                      "chrome://tracing)")
 
 
 if __name__ == "__main__":

@@ -24,18 +24,29 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from hpc_patterns_tpu.comm import collectives, ring
 from hpc_patterns_tpu.harness import metrics as metricslib
+from hpc_patterns_tpu.harness import trace as tracelib
 from hpc_patterns_tpu.topology import shard_map
 
 Algorithm = Literal["collective", "ring", "ring_chunked"]
 
 
-def _ready_in_span(result):
+def _ready_in_span(result, op: str = "collective"):
     """Block before an open span exits so it measures collective
     completion, not async dispatch — the shard_map call returns an
-    unready array. Only when a span actually records (metrics or trace
-    mirroring on); the disabled path stays fully async."""
+    unready array. Only when a span actually records (metrics, trace
+    mirroring, or the flight recorder); the disabled path stays fully
+    async. With a recorder, the dispatch→completion window also lands
+    as a ``comm.<op>`` slice on the device track, separating wire time
+    from the host time around it."""
     m = metricslib.get_metrics()
-    if m.enabled or m.mirror_traces:
+    rec = tracelib.active()
+    if not (m.enabled or m.mirror_traces or rec is not None):
+        return result
+    if rec is not None:
+        t_disp = rec.mark_dispatch(f"comm.{op}")
+        jax.block_until_ready(result)
+        rec.mark_complete(f"comm.{op}", t_disp)
+    else:
         jax.block_until_ready(result)
     return result
 
@@ -127,7 +138,8 @@ class Communicator:
         impl = _ALLREDUCE[algorithm]
         with metricslib.span("comm.allreduce", algorithm=algorithm):
             return _ready_in_span(
-                self._shmap(lambda local: impl(local, self.axis), x)(x))
+                self._shmap(lambda local: impl(local, self.axis), x)(x),
+                op=f"allreduce.{algorithm}")
 
     def jit_allreduce(self, x, algorithm: Algorithm = "collective"):
         """The compiled allreduce closure for ``x``'s shape — what a
@@ -139,7 +151,8 @@ class Communicator:
         """Pairwise even/odd exchange: row r swaps with row r^1 — the
         pt2pt ping-pong config of BASELINE.json."""
         with metricslib.span("comm.pingpong"):
-            return _ready_in_span(self.jit_pingpong(x)(x))
+            return _ready_in_span(self.jit_pingpong(x)(x),
+                                  op="pingpong")
 
     def jit_pingpong(self, x):
         """Compiled pairwise-exchange closure (for timing loops)."""
@@ -150,21 +163,25 @@ class Communicator:
         (SendRecvRing, allreduce-mpi-sycl.cpp:43-59)."""
         with metricslib.span("comm.sendrecv_ring", shift=shift):
             return _ready_in_span(self._shmap(
-                lambda l: ring.ring_shift(l, self.axis, shift), x)(x))
+                lambda l: ring.ring_shift(l, self.axis, shift), x)(x),
+                op="sendrecv_ring")
 
     def all_gather(self, x) -> jax.Array:
         """Every rank receives every row: (size, n) -> (size, size, n)."""
         fn = lambda l: collectives.all_gather(l, self.axis, tiled=False).squeeze(1)[None]
         spec = P(self.axis, None, *([None] * (jnp.ndim(x) - 1)))
         with metricslib.span("comm.all_gather"):
-            return _ready_in_span(self._shmap(fn, x, out_specs=spec)(x))
+            return _ready_in_span(self._shmap(fn, x, out_specs=spec)(x),
+                                  op="all_gather")
 
     def reduce_scatter(self, x) -> jax.Array:
         """(size, size*n) rows -> (size, n): rank r gets chunk r of the sum."""
         fn = lambda l: collectives.reduce_scatter(l, self.axis, scatter_axis=jnp.ndim(x) - 1)
         with metricslib.span("comm.reduce_scatter"):
             return _ready_in_span(self._shmap(
-                fn, x, out_specs=P(self.axis, *([None] * (jnp.ndim(x) - 1))))(x))
+                fn, x,
+                out_specs=P(self.axis, *([None] * (jnp.ndim(x) - 1))))(x),
+                op="reduce_scatter")
 
     def all_to_all(self, x) -> jax.Array:
         """Row r's chunk c goes to row c's chunk r (MPI_Alltoall)."""
@@ -172,7 +189,8 @@ class Communicator:
             l, self.axis, split_axis=jnp.ndim(x) - 1, concat_axis=jnp.ndim(x) - 1
         )
         with metricslib.span("comm.all_to_all"):
-            return _ready_in_span(self._shmap(fn, x)(x))
+            return _ready_in_span(self._shmap(fn, x)(x),
+                                  op="all_to_all")
 
     # -- miniapp-style buffer init ---------------------------------------
 

@@ -53,6 +53,24 @@ def base_parser(description: str) -> argparse.ArgumentParser:
              "--log, one final kind=metrics snapshot record is appended — "
              "aggregate with `python -m hpc_patterns_tpu.harness.report`",
     )
+    p.add_argument(
+        "--trace",
+        action="store_true",
+        help="enable the flight recorder (harness/trace.py): spans, "
+             "device dispatch/completion markers, compile events, and "
+             "memory samples land in a bounded ring buffer; with --log, "
+             "one kind=trace snapshot record is appended — export to "
+             "Chrome-trace JSON with "
+             "`python -m hpc_patterns_tpu.harness.trace <log>`",
+    )
+    p.add_argument(
+        "--trace-capacity",
+        type=int,
+        default=None,
+        metavar="N",
+        help="flight-recorder ring size in events (default 16384; "
+             "oldest events evicted beyond it)",
+    )
     return p
 
 

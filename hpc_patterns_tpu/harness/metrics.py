@@ -199,6 +199,14 @@ class _Noop:
 _NOOP = _Noop()
 _NULL_SPAN = contextlib.nullcontext()
 
+# Flight-recorder hookup (harness/trace.py installs itself here via
+# trace.configure): when a sink is present, every span begin/end also
+# lands as a timestamped event in the ring buffer — the histograms say
+# HOW LONG a phase takes, the recorder says WHEN each instance ran.
+# None (the default) keeps span() on the no-op fast path: the check is
+# one module-global read, no import of trace.py, still jax-free.
+_trace_sink = None
+
 
 class Metrics:
     """One registry per process (installed by :func:`configure`).
@@ -251,8 +259,11 @@ class Metrics:
         ``/``-joined path per thread; the elapsed wall time lands in
         the ``span.<path>`` histogram. With ``mirror_traces``, the
         span body also runs under a ``jax.profiler.TraceAnnotation``
-        of the same name, so XProf shows the identical phase tree."""
-        if not (self.enabled or self.mirror_traces):
+        of the same name, so XProf shows the identical phase tree.
+        With a flight recorder installed (``--trace``), begin/end also
+        land as ring-buffer events carrying the same path."""
+        if not (self.enabled or self.mirror_traces
+                or _trace_sink is not None):
             return _NULL_SPAN
         return self._span(name, attrs)
 
@@ -272,16 +283,21 @@ class Metrics:
                     path, **{k: str(v) for k, v in attrs.items()})
             except Exception:  # noqa: BLE001 — tracing is best-effort
                 pass
+        sink = _trace_sink
         t0 = time.perf_counter()
+        if sink is not None:
+            sink.span_begin(path, attrs, t0)
         try:
             with annotation:
                 yield
         finally:
-            dt = time.perf_counter() - t0
+            t1 = time.perf_counter()
             stack.pop()
+            if sink is not None:
+                sink.span_end(path, t1)
             if self.enabled:
                 self._get(self._histograms, f"span.{path}",
-                          Histogram).observe(dt)
+                          Histogram).observe(t1 - t0)
 
     # -- snapshot ----------------------------------------------------------
 

@@ -32,14 +32,28 @@ def maybe_trace(enabled: bool, logdir: str | None = None):
     if not enabled:
         yield None
         return
-    from hpc_patterns_tpu.harness import metrics
+    from hpc_patterns_tpu.harness import metrics, trace
 
     logdir = logdir or tempfile.mkdtemp(prefix="hpcpat_trace_")
     m = metrics.get_metrics()
     prev = m.mirror_traces
     m.mirror_traces = True
+    rec = trace.active()
+    t0 = rec.mark_dispatch("profiler.trace",
+                           {"logdir": logdir}) if rec else 0.0
     try:
         with jax.profiler.trace(logdir):
             yield logdir
     finally:
+        # restore in a finally so an exception inside the traced
+        # region can't leave the registry permanently mirroring every
+        # span into TraceAnnotations (tested by
+        # tests/test_trace.py::test_maybe_trace_restores_on_raise).
+        # Restored on the CAPTURED registry object: if the region
+        # installed a fresh one (metrics.configure), that registry
+        # owns its own mirror_traces and is left alone.
         m.mirror_traces = prev
+        if rec:
+            # the profiler region lands on the flight-recorder device
+            # track too, so a timeline shows when XProf was active
+            rec.mark_complete("profiler.trace", t0, {"logdir": logdir})

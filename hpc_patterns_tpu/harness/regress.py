@@ -1,0 +1,259 @@
+"""Bench regression gate over the checked-in ``BENCH_r*.json`` rounds.
+
+The bench trajectory was write-only: every round appends a capture
+(``bench.py``'s one-line JSON verdict wrapped in the driver's round
+schema — ``{"n", "cmd", "rc", "tail", "parsed"}``), and nothing ever
+reads it back, so a perf regression lands silently and is only noticed
+rounds later by a human eyeballing RESULTS.md. This module is the
+machine check: parse the trajectory, compare the NEWEST comparable
+round's headline numbers against the BEST prior round, and exit
+nonzero with a readable table when any gated metric degrades beyond
+tolerance.
+
+What is compared (when present in a round's ``parsed`` payload):
+
+- ``value`` / ``vs_baseline`` — the capture's headline (the on-chip
+  overlap speedup today; any future ``bench.py`` headline rides the
+  same keys);
+- serving numbers under ``detail`` (``serving_tok_s`` higher-better,
+  ``serving_bubble_frac`` / ``serving_prefill_compiles`` lower-better)
+  and ``allreduce_busbw_gbps`` — the production-serving headline set;
+- ``detail.dma_gbps`` is reported but NOT gated: bench.py's own
+  session-health telemetry (NOMINAL_DMA_GBPS) established that DMA
+  rate tracks chip/tunnel session quality, not code — a slow session
+  must down-weight the ratio's interpretation, not fail the gate.
+
+Rounds that measured nothing are excluded, not failed: ``parsed`` null
+(the round-4 rc=1 traceback) or ``detail.degenerate`` true (the
+round-5 tunnel timeout) mean the ENVIRONMENT broke, and a gate that
+fails on a dead chip session would train everyone to ignore it. They
+are listed as skipped; the newest round that actually measured is what
+gates.
+
+Usage::
+
+    python -m hpc_patterns_tpu.harness.regress BENCH_r0*.json
+    python bench.py --gate        # capture a new round, then gate it
+
+Exit 0: no regression (or nothing to compare). 1: regression, table on
+stdout names the metric. 2: unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from pathlib import Path
+from typing import Any
+
+DEFAULT_TOLERANCE = 0.10  # 10% relative
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricSpec:
+    """One headline metric: where it lives in ``parsed`` (dot path),
+    which direction is good, whether it gates (vs. informational), and
+    an absolute slack added to the relative tolerance band (so
+    near-zero lower-better values like bubble fractions don't turn a
+    0.001 → 0.002 wobble into a 2x 'regression')."""
+    path: str
+    direction: str  # "higher" | "lower"
+    gated: bool = True
+    abs_slack: float = 0.0
+    label: str | None = None
+
+    @property
+    def name(self) -> str:
+        return self.label or self.path
+
+
+SPECS: tuple[MetricSpec, ...] = (
+    MetricSpec("value", "higher", label="headline value"),
+    MetricSpec("vs_baseline", "higher"),
+    MetricSpec("detail.dma_gbps", "higher", gated=False,
+               label="dma_gbps (session health)"),
+    MetricSpec("detail.serving_tok_s", "higher"),
+    MetricSpec("detail.serving_bubble_frac", "lower", abs_slack=0.05),
+    MetricSpec("detail.serving_prefill_compiles", "lower", abs_slack=1),
+    MetricSpec("detail.allreduce_busbw_gbps", "higher"),
+)
+
+
+def _dig(obj: Any, path: str):
+    for part in path.split("."):
+        if not isinstance(obj, dict) or part not in obj:
+            return None
+        obj = obj[part]
+    return obj
+
+
+def load_round(path: str | Path) -> dict[str, Any]:
+    with open(path) as f:
+        rec = json.load(f)
+    rec["_path"] = str(path)
+    return rec
+
+
+def comparable(rec: dict[str, Any]) -> bool:
+    """A round that actually measured something: parsed verdict present
+    and not self-declared degenerate (dead backend / tunnel timeout)."""
+    parsed = rec.get("parsed")
+    if not isinstance(parsed, dict):
+        return False
+    detail = parsed.get("detail")
+    if isinstance(detail, dict) and detail.get("degenerate"):
+        return False
+    return True
+
+
+def extract_metrics(rec: dict[str, Any]) -> dict[str, tuple[MetricSpec, float]]:
+    """{metric name: (spec, value)} for every spec present in the
+    round. Keyed by the capture's metric name too, so trajectories that
+    change headline metric (onchip overlap -> something else) never
+    compare apples to oranges."""
+    parsed = rec["parsed"]
+    prefix = parsed.get("metric", "?")
+    out: dict[str, tuple[MetricSpec, float]] = {}
+    for spec in SPECS:
+        v = _dig(parsed, spec.path)
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        out[f"{prefix}:{spec.name}"] = (spec, float(v))
+    return out
+
+
+@dataclasses.dataclass
+class Row:
+    name: str
+    best_prior: float
+    best_round: int
+    newest: float
+    delta_frac: float  # signed: + means improved in the good direction
+    gated: bool
+    failed: bool
+
+
+def compare(rounds: list[dict[str, Any]],
+            tolerance: float = DEFAULT_TOLERANCE) -> dict[str, Any]:
+    """Newest comparable round vs the best prior comparable round,
+    metric by metric. Returns {rows, newest, skipped, n_prior}; rows is
+    empty when fewer than two rounds measured anything."""
+    rounds = sorted(rounds, key=lambda r: r.get("n", 0))
+    usable = [r for r in rounds if comparable(r)]
+    skipped = [r for r in rounds if not comparable(r)]
+    if len(usable) < 2:
+        return {"rows": [], "newest": usable[-1] if usable else None,
+                "skipped": skipped, "n_prior": max(0, len(usable) - 1)}
+    newest, prior = usable[-1], usable[:-1]
+    # same-backend rounds only: a CPU-fallback capture gated against
+    # the TPU trajectory would always "regress" — that is a backend
+    # mismatch, not a perf change, so those priors are set aside (and
+    # an all-mismatched history gates nothing rather than lying)
+    backend = _dig(newest["parsed"], "detail.backend")
+    if backend is not None:
+        mismatched = [r for r in prior
+                      if _dig(r["parsed"], "detail.backend")
+                      not in (None, backend)]
+        if mismatched:
+            skipped = skipped + mismatched
+            prior = [r for r in prior if r not in mismatched]
+    if not prior:
+        return {"rows": [], "newest": newest, "skipped": skipped,
+                "n_prior": 0}
+    new_metrics = extract_metrics(newest)
+    rows: list[Row] = []
+    for name, (spec, new_v) in sorted(new_metrics.items()):
+        prior_vals = []
+        for r in prior:
+            got = extract_metrics(r).get(name)
+            if got is not None:
+                prior_vals.append((got[1], r.get("n", 0)))
+        if not prior_vals:
+            continue
+        if spec.direction == "higher":
+            best, best_n = max(prior_vals)
+            floor = best * (1.0 - tolerance) - spec.abs_slack
+            failed = spec.gated and new_v < floor
+            delta = (new_v - best) / abs(best) if best else 0.0
+        else:
+            best, best_n = min(prior_vals)
+            ceil = best * (1.0 + tolerance) + spec.abs_slack
+            failed = spec.gated and new_v > ceil
+            delta = (best - new_v) / abs(best) if best else 0.0
+        rows.append(Row(name, best, best_n, new_v, delta, spec.gated,
+                        failed))
+    return {"rows": rows, "newest": newest, "skipped": skipped,
+            "n_prior": len(prior)}
+
+
+def format_table(result: dict[str, Any], tolerance: float) -> str:
+    lines = []
+    newest = result["newest"]
+    if result["skipped"]:
+        names = ", ".join(
+            f"r{r.get('n', '?')}" for r in result["skipped"])
+        lines.append("skipped (degenerate/unparsed/backend-mismatched "
+                     f"capture): {names}")
+    if newest is None:
+        lines.append("no comparable rounds — nothing to gate")
+        return "\n".join(lines)
+    if not result["rows"]:
+        lines.append(
+            f"newest comparable round r{newest.get('n', '?')} "
+            f"({newest['_path']}) has no prior round to compare "
+            "against — nothing to gate")
+        return "\n".join(lines)
+    lines.append(
+        f"newest comparable round r{newest.get('n', '?')} "
+        f"({newest['_path']}) vs best of {result['n_prior']} prior "
+        f"round(s), tolerance {tolerance:.0%}:")
+    lines.append("")
+    lines.append(f"{'metric':<44} {'best prior':>12} {'newest':>12} "
+                 f"{'delta':>8}  status")
+    for row in result["rows"]:
+        status = ("REGRESSION" if row.failed
+                  else "ok" if row.gated else "info")
+        lines.append(
+            f"{row.name:<44} {row.best_prior:>12.4g} "
+            f"(r{row.best_round}) {row.newest:>12.4g} "
+            f"{row.delta_frac:>+7.1%}  {status}")
+    n_fail = sum(r.failed for r in result["rows"])
+    lines.append("")
+    lines.append("GATE: " + (f"FAIL ({n_fail} regression(s))" if n_fail
+                             else "PASS"))
+    return "\n".join(lines)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("rounds", nargs="+",
+                   help="bench round files, e.g. BENCH_r0*.json")
+    p.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                   help="relative degradation allowed before the gate "
+                        f"fails (default {DEFAULT_TOLERANCE:.0%} — wide "
+                        "enough for session-to-session chip noise, "
+                        "narrow enough to catch a real fast-path "
+                        "regression)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if not 0 <= args.tolerance < 1:
+        print(f"ERROR: --tolerance must be in [0, 1), got "
+              f"{args.tolerance}", file=sys.stderr)
+        return 2
+    try:
+        rounds = [load_round(p) for p in args.rounds]
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 2
+    result = compare(rounds, tolerance=args.tolerance)
+    print(format_table(result, args.tolerance))
+    return 1 if any(r.failed for r in result["rows"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,6 +57,7 @@ def aggregate(records: list[dict[str, Any]]) -> dict[str, Any]:
     gauges: dict[str, Gauge] = {}
     histograms: dict[str, Histogram] = {}
     kinds: dict[str, int] = {}
+    traces: list[dict[str, Any]] = []
     n_ok = n_bad = n_snapshots = n_layout_skipped = 0
     for rec in records:
         kind = rec.get("kind", "?")
@@ -66,6 +67,18 @@ def aggregate(records: list[dict[str, Any]]) -> dict[str, Any]:
                 n_ok += 1
             else:
                 n_bad += 1
+        if kind == "trace":
+            # flight-recorder snapshot (harness/trace.py): summarize
+            # the rollups here; the full timeline is the trace CLI's
+            # job (`python -m hpc_patterns_tpu.harness.trace`)
+            traces.append({
+                "n_events": rec.get("n_events", 0),
+                "n_dropped": rec.get("n_dropped", 0),
+                "by_cat": rec.get("by_cat", {}),
+                "compile": rec.get("compile", {}),
+                "peak_live_bytes": rec.get("mem", {}).get(
+                    "peak_live_bytes", 0),
+            })
         if kind != "metrics":
             continue
         n_snapshots += 1
@@ -97,6 +110,7 @@ def aggregate(records: list[dict[str, Any]]) -> dict[str, Any]:
         "gauges": gauges,
         "histograms": histograms,
         "kinds": kinds,
+        "traces": traces,
         "n_snapshots": n_snapshots,
         "n_layout_skipped": n_layout_skipped,
         "results": (n_ok, n_bad),
@@ -123,6 +137,17 @@ def format_report(agg: dict[str, Any], source: str = "") -> str:
     lines.append(head)
     if ok or bad:
         lines.append(f"results: {ok} SUCCESS / {bad} FAILURE")
+    for t in agg.get("traces", []):
+        cats = ", ".join(f"{k}={n}" for k, n in sorted(t["by_cat"].items()))
+        comp = t.get("compile", {})
+        mem = t.get("peak_live_bytes", 0)
+        lines.append(
+            f"trace: {t['n_events']} events ({cats}; "
+            f"{t['n_dropped']} evicted), "
+            f"{comp.get('count', 0)} compiles "
+            f"totalling {_fmt(comp.get('total_s', 0.0))}s"
+            + (f", peak live {mem / 1e6:.1f} MB" if mem else "")
+            + " — export: python -m hpc_patterns_tpu.harness.trace")
     if not agg["n_snapshots"]:
         lines.append("no kind=metrics snapshots (run apps with "
                      "--metrics --log to record them)")

@@ -6,6 +6,15 @@ stdout contract and *additionally* writes one JSON object per record, so
 sweeps are machine-readable (SURVEY.md section 5 "metrics/observability"
 upgrade). The native sweep driver (native/sweep.cpp) consumes the same
 format.
+
+Closing-record convention: instrumented runs append their snapshots as
+the log's final records — one ``kind=metrics`` (the registry tables,
+harness/metrics.py; aggregated by harness.report) and, under
+``--trace``, one ``kind=trace`` (the flight-recorder ring,
+harness/trace.py; exported to a Chrome-trace timeline by
+``python -m hpc_patterns_tpu.harness.trace``). Both append (never
+truncate), so the app's own records survive — the structured analog of
+run.sh's trailing grep summary.
 """
 
 from __future__ import annotations

@@ -78,7 +78,11 @@ def measure(
     if repetitions < 1:
         raise ValueError("repetitions must be >= 1")
     m = metricslib.get_metrics()
-    if not (m.enabled or m.mirror_traces):
+    # the instrumented path also engages when a flight recorder is
+    # installed (--trace): the warmup/timed spans then land on the
+    # timeline even without --metrics (the histogram writes stay no-ops)
+    if not (m.enabled or m.mirror_traces
+            or metricslib._trace_sink is not None):
         for _ in range(warmup):
             fn()
         times = []

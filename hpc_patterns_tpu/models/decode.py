@@ -48,6 +48,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from hpc_patterns_tpu.harness import trace as tracelib
 from hpc_patterns_tpu.models.sharding_util import mesh_axis_size, resolve_spec
 from hpc_patterns_tpu.topology import shard_map
 from hpc_patterns_tpu.models.transformer import (
@@ -629,9 +630,13 @@ def generate(params, prompt, cfg: TransformerConfig, new_tokens: int, *,
         raise ValueError(f"top_k {top_k} outside [0, vocab]")
     if key is None:
         key = jax.random.PRNGKey(0)  # unused in greedy mode
-    return _generate_jit(params, prompt, cfg, new_tokens, key,
-                         jnp.float32(max(temperature, 1e-6)),
-                         temperature <= 0.0, int(top_k), mesh)
+    with tracelib.compile_watch("decode.generate", _generate_jit,
+                                batch=prompt.shape[0],
+                                prompt_len=prompt.shape[1],
+                                new_tokens=new_tokens):
+        return _generate_jit(params, prompt, cfg, new_tokens, key,
+                             jnp.float32(max(temperature, 1e-6)),
+                             temperature <= 0.0, int(top_k), mesh)
 
 
 def greedy_generate(params, prompt, cfg: TransformerConfig,
@@ -1197,8 +1202,13 @@ def paged_generate(params, prompt, cfg: TransformerConfig,
         raise ValueError("sampling (temperature > 0) needs a PRNG key")
     if key is None:
         key = jax.random.PRNGKey(0)
-    return _paged_generate_jit(
-        params, prompt, cfg, new_tokens, page_size, pages_per_seq, key,
-        jnp.float32(max(temperature, 1e-6)), temperature <= 0.0,
-        int(top_k), mesh,
-    )
+    with tracelib.compile_watch("decode.paged_generate",
+                                _paged_generate_jit,
+                                batch=B, prompt_len=T,
+                                new_tokens=new_tokens,
+                                page_size=page_size):
+        return _paged_generate_jit(
+            params, prompt, cfg, new_tokens, page_size, pages_per_seq,
+            key, jnp.float32(max(temperature, 1e-6)),
+            temperature <= 0.0, int(top_k), mesh,
+        )

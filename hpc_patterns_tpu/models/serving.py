@@ -75,6 +75,7 @@ import numpy as np
 from jax import lax
 
 from hpc_patterns_tpu.harness import metrics as metricslib
+from hpc_patterns_tpu.harness import trace as tracelib
 from hpc_patterns_tpu.models.decode import (
     _pick,
     _topk_mask,
@@ -148,6 +149,7 @@ class _Slot:
     active: bool = False
     t_submit: float = 0.0
     t_admit: float = 0.0
+    t_dispatch: float = 0.0  # admission-dispatch trace stamp
     first_dev: jax.Array | None = None  # pending first-token readback
 
 
@@ -279,8 +281,13 @@ def prefill_cache_size() -> int:
     cache of :func:`_prefill_one`) — THE compile-count observable the
     bucket-ladder claim is asserted against (tests) and reported by
     (benchmarks/bench_serving.py). One entry per distinct (padded
-    length, config) pair across every engine in the process."""
-    return _prefill_one._cache_size()
+    length, config) pair across every engine in the process. A
+    consumer of the flight recorder's shared probe
+    (harness.trace.jit_cache_size), which compile_watch diffs to stamp
+    per-compile events on the trace timeline — strict mode, because
+    the ladder-bound assertions gate on this number and a silently
+    missing probe would read as the passing value 0."""
+    return tracelib.jit_cache_size(_prefill_one, strict=True)
 
 
 @partial(jax.jit, static_argnames=("eos_id", "greedy", "top_k"),
@@ -582,7 +589,9 @@ class ContinuousBatcher:
         # engine's live table with it
         one["table"] = jnp.asarray(self._table[slot:slot + 1])
         with metricslib.span("serve.prefill", prompt_len=T,
-                             padded_len=padded):
+                             padded_len=padded), \
+                tracelib.compile_watch("serving._prefill_one",
+                                       _prefill_one, padded_len=padded):
             logits, out = _prefill_one(
                 self.params, jnp.asarray(prompt)[None, :],
                 jnp.int32(T - 1), one,
@@ -595,11 +604,14 @@ class ContinuousBatcher:
             self.dcache["table"] = jnp.asarray(self._table)
             done = dict(self.dcache)
             done["table"] = jnp.asarray(self._table[slot:slot + 1])
-            _, dout = _prefill_one(
-                self.draft_params, jnp.asarray(prompt)[None, :],
-                jnp.int32(T - 1), done, cfg=self.draft_cfg,
-                page_size=self.page_size, mesh=self.mesh,
-            )
+            with tracelib.compile_watch("serving._prefill_one[draft]",
+                                        _prefill_one,
+                                        padded_len=padded):
+                _, dout = _prefill_one(
+                    self.draft_params, jnp.asarray(prompt)[None, :],
+                    jnp.int32(T - 1), done, cfg=self.draft_cfg,
+                    page_size=self.page_size, mesh=self.mesh,
+                )
             for k, v in dout.items():
                 if k != "table":
                     self.dcache[k] = v
@@ -620,6 +632,19 @@ class ContinuousBatcher:
         st.first_dev = first_dev
         st.t_submit = req.t_submit
         st.t_admit = time.perf_counter()
+        rec = tracelib.active()
+        if rec is not None:
+            # all admission device work (table upload, prefill, first-
+            # token pick) is now enqueued; the first-token readback in
+            # _resolve_pending closes this window. Per-slot SUBTRACK
+            # (track=slot+1): overlapped admissions run concurrently
+            # with the decode chunk (track 0) by design, and Chrome
+            # sync slices on one track must nest
+            st.t_dispatch = rec.mark_dispatch(
+                "serve.admit", {"seq_id": req.seq_id, "slot": slot,
+                                "padded_len": padded,
+                                "overlapped": overlapped},
+                track=slot + 1)
         self._pending.append(slot)
         self._emit(kind="serve_admit", seq_id=req.seq_id, slot=slot,
                    pages=need, prompt_len=T, padded_len=padded,
@@ -645,6 +670,14 @@ class ContinuousBatcher:
             first = int(jax.device_get(st.first_dev))
             st.first_dev = None
             st.out = [first]
+            rec = tracelib.active()
+            if rec is not None and st.t_dispatch:
+                # the readback IS completion: the admission's device
+                # work (prefill + first-token pick) is done by now
+                rec.mark_complete("serve.admit", st.t_dispatch,
+                                  {"seq_id": st.seq_id, "slot": slot},
+                                  track=slot + 1)
+                st.t_dispatch = 0.0
             m = metricslib.get_metrics()
             if m.enabled:
                 # prefill emitted the first token: its readback IS
@@ -694,7 +727,9 @@ class ContinuousBatcher:
         # overwrites the "snapshot" in place with the post-chunk cursors
         pos_start = np.array(self.pos)
         parts = [i for i, s in enumerate(self._slots) if s.active]
-        with metricslib.span("serve.decode_dispatch", chunk=self.chunk):
+        with metricslib.span("serve.decode_dispatch", chunk=self.chunk), \
+                tracelib.compile_watch("serving._chunk_step",
+                                       _chunk_step, chunk=self.chunk):
             (self.cache, self.pos, self.limit, self.tokens, self.keys,
              out) = _chunk_step(
                 self.params, self.cache, self.pos, self.limit,
@@ -702,12 +737,23 @@ class ContinuousBatcher:
                 cfg=self.cfg, chunk=self.chunk, eos_id=self.eos_id,
                 greedy=self.greedy, top_k=self.top_k, mesh=self.mesh,
             )
-        return parts, pos_start, out
+        rec = tracelib.active()
+        t_disp = (rec.mark_dispatch(
+            "serve.chunk", {"chunk": self.chunk, "rows": len(parts)})
+            if rec is not None else 0.0)
+        return parts, pos_start, out, t_disp
 
     def _collect_chunk(self, inflight):
-        parts, pos_start, out = inflight
+        parts, pos_start, out, t_disp = inflight
         with metricslib.span("serve.decode_round", chunk=self.chunk):
             out = np.asarray(out)  # (chunk, slots); readback = sync
+        rec = tracelib.active()
+        if rec is not None and t_disp:
+            # readback resolved: the dispatch→completion window is the
+            # chunk's device time + queueing, a slice on the device
+            # track; host gaps between slices are admission bubbles
+            rec.mark_complete("serve.chunk", t_disp,
+                              {"chunk": self.chunk, "rows": len(parts)})
         limit_new = np.asarray(self.limit)
         for i in parts:
             st = self._slots[i]
@@ -727,7 +773,10 @@ class ContinuousBatcher:
         them (the speculative invariant)."""
         parts = [i for i, s in enumerate(self._slots) if s.active]
         with metricslib.span("serve.spec_dispatch", rounds=self.chunk,
-                             gamma=self.gamma):
+                             gamma=self.gamma), \
+                tracelib.compile_watch("serving._spec_chunk",
+                                       _spec_chunk, rounds=self.chunk,
+                                       gamma=self.gamma):
             (self.cache, self.dcache, self.pos, self.limit, self.tokens,
              self._spec_key, emits, advs) = _spec_chunk(
                 self.params, self.draft_params, self.cache, self.dcache,
@@ -737,14 +786,24 @@ class ContinuousBatcher:
                 rounds=self.chunk, eos_id=self.eos_id,
                 greedy=self.greedy, top_k=self.top_k, mesh=self.mesh,
             )
-        return parts, None, (emits, advs)
+        rec = tracelib.active()
+        t_disp = (rec.mark_dispatch(
+            "serve.spec_chunk",
+            {"rounds": self.chunk, "gamma": self.gamma,
+             "rows": len(parts)}) if rec is not None else 0.0)
+        return parts, None, (emits, advs), t_disp
 
     def _collect_spec(self, inflight):
-        parts, _, (emits, advs) = inflight
+        parts, _, (emits, advs), t_disp = inflight
         with metricslib.span("serve.spec_round", rounds=self.chunk,
                              gamma=self.gamma):
             emits = np.asarray(emits)  # (rounds, slots, gamma+1)
             advs = np.asarray(advs)    # (rounds, slots)
+        rec = tracelib.active()
+        if rec is not None and t_disp:
+            rec.mark_complete("serve.spec_chunk", t_disp,
+                              {"rounds": self.chunk,
+                               "rows": len(parts)})
         pos_np = np.asarray(self.pos)
         limit_np = np.asarray(self.limit)
         for i in parts:

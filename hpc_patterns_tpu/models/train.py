@@ -25,6 +25,7 @@ import optax
 from jax import lax
 
 from hpc_patterns_tpu.harness import metrics as metricslib
+from hpc_patterns_tpu.harness import trace as tracelib
 from hpc_patterns_tpu.models import sharding as shardlib
 from hpc_patterns_tpu.models.transformer import TransformerConfig, init_params, loss_fn
 
@@ -177,12 +178,21 @@ def make_train_step(cfg: TransformerConfig, mesh=None, optimizer=None,
     if host_sh is not None:
         # declare the host residency of the opt-state input/output so
         # donation pairs host buffers with host buffers
-        return jax.jit(
+        jitted = jax.jit(
             step, donate_argnums=(0, 1),
             in_shardings=(None, host_sh, None),
             out_shardings=(None, None, host_sh),
         )
-    return jax.jit(step, donate_argnums=(0, 1))
+    else:
+        jitted = jax.jit(step, donate_argnums=(0, 1))
+    # under --trace, the flight recorder stamps a compile event (with
+    # the triggering batch shapes) every time a call grows the jit
+    # cache — a recompiling training loop is visible on the timeline
+    # instead of showing up only as a slow step; without a recorder
+    # the wrapper is a passthrough call. exec_memory stays off: the
+    # AOT memory_analysis pass is a second full compile of the step
+    # (use trace.record_executable_memory at an explicit AOT site)
+    return tracelib.instrument_jit(jitted, "train.step")
 
 
 def init_train_state(key, cfg: TransformerConfig, mesh=None, optimizer=None):

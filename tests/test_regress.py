@@ -1,0 +1,181 @@
+"""Tier-1 smoke for the bench regression gate (harness/regress.py).
+
+Runs over the REAL checked-in ``BENCH_r0*.json`` trajectory: the gate
+must pass on history as it stands (r04/r05 are degenerate captures —
+dead chip sessions — and must be skipped, not failed), and must fail
+with a table naming the metric when the newest round is synthetically
+degraded beyond tolerance. This is the machine check that keeps
+``bench.py --gate`` honest without a chip.
+"""
+
+import glob
+import json
+import shutil
+from pathlib import Path
+
+import pytest
+
+from hpc_patterns_tpu.harness import regress
+
+REPO = Path(__file__).resolve().parent.parent
+ROUNDS = sorted(glob.glob(str(REPO / "BENCH_r0*.json")))
+
+
+@pytest.fixture()
+def trajectory(tmp_path):
+    """A scratch copy of the checked-in rounds (tests never mutate the
+    real artifacts)."""
+    paths = []
+    for p in ROUNDS:
+        dst = tmp_path / Path(p).name
+        shutil.copy(p, dst)
+        paths.append(str(dst))
+    return paths
+
+
+class TestCheckedInTrajectory:
+    def test_rounds_exist(self):
+        # the gate's acceptance claim is about the real files
+        assert len(ROUNDS) >= 3
+
+    def test_gate_passes_on_current_trajectory(self, capsys):
+        assert regress.main(ROUNDS) == 0
+        out = capsys.readouterr().out
+        assert "GATE: PASS" in out
+        # the degenerate rounds are skipped by name, not silently
+        assert "skipped" in out
+
+    def test_degenerate_rounds_are_skipped(self):
+        recs = [regress.load_round(p) for p in ROUNDS]
+        usable = [r for r in recs if regress.comparable(r)]
+        skipped = [r for r in recs if not regress.comparable(r)]
+        # r04 (parsed null) and r05 (detail.degenerate) must be out
+        assert {r["n"] for r in skipped} >= {4, 5}
+        assert all(isinstance(r["parsed"], dict) for r in usable)
+
+    def test_synthetic_degradation_fails_naming_the_metric(
+            self, trajectory, capsys):
+        # degrade the newest COMPARABLE round's headline value beyond
+        # tolerance; the gate must exit nonzero and name the metric
+        recs = [(p, regress.load_round(p)) for p in trajectory]
+        newest = max((pr for pr in recs if regress.comparable(pr[1])),
+                     key=lambda pr: pr[1]["n"])
+        path, rec = newest
+        rec["parsed"]["value"] *= 0.7  # -30%, well past 10%
+        rec.pop("_path")
+        Path(path).write_text(json.dumps(rec))
+        assert regress.main(trajectory) == 1
+        out = capsys.readouterr().out
+        assert "GATE: FAIL" in out
+        assert "REGRESSION" in out
+        assert "headline value" in out
+
+    def test_dma_rate_is_informational_not_gated(self, capsys):
+        # the checked-in r03 ran on a known ~11%-slow chip session
+        # (dma 512.6 vs 579.5): session health must be REPORTED but
+        # must not fail the gate — bench.py's own telemetry rule
+        assert regress.main(ROUNDS) == 0
+        out = capsys.readouterr().out
+        assert "session health" in out
+        assert "info" in out
+
+
+class TestGateMechanics:
+    def _round(self, tmp_path, n, value, vs_baseline=1.0, detail=None,
+               parsed=True):
+        rec = {"n": n, "cmd": "test", "rc": 0, "tail": ""}
+        rec["parsed"] = (
+            {"metric": "m", "value": value, "unit": "x",
+             "vs_baseline": vs_baseline, "detail": detail or {}}
+            if parsed else None)
+        p = tmp_path / f"BENCH_r{n:02d}.json"
+        p.write_text(json.dumps(rec))
+        return str(p)
+
+    def test_within_tolerance_passes(self, tmp_path, capsys):
+        files = [self._round(tmp_path, 1, 2.0),
+                 self._round(tmp_path, 2, 1.85)]  # -7.5% < 10%
+        assert regress.main(files) == 0
+        capsys.readouterr()
+
+    def test_beyond_tolerance_fails(self, tmp_path, capsys):
+        files = [self._round(tmp_path, 1, 2.0),
+                 self._round(tmp_path, 2, 1.7)]  # -15%
+        assert regress.main(files) == 1
+        capsys.readouterr()
+
+    def test_tolerance_flag(self, tmp_path, capsys):
+        files = [self._round(tmp_path, 1, 2.0),
+                 self._round(tmp_path, 2, 1.7)]
+        assert regress.main(files + ["--tolerance", "0.2"]) == 0
+        capsys.readouterr()
+
+    def test_newest_degenerate_falls_back_to_prior(self, tmp_path,
+                                                   capsys):
+        files = [self._round(tmp_path, 1, 2.0),
+                 self._round(tmp_path, 2, 1.95),
+                 self._round(tmp_path, 3, 0.0,
+                             detail={"degenerate": True})]
+        # r3 measured nothing: r2 vs r1 is the comparison, and passes
+        assert regress.main(files) == 0
+        out = capsys.readouterr().out
+        assert "r3" in out and "skipped" in out
+
+    def test_improvement_against_best_not_last(self, tmp_path, capsys):
+        # best prior is r1 (2.0), not the weaker r2: a slow newest
+        # round must be judged against the trajectory's best
+        files = [self._round(tmp_path, 1, 2.0),
+                 self._round(tmp_path, 2, 1.0),
+                 self._round(tmp_path, 3, 1.7)]
+        assert regress.main(files) == 1
+        capsys.readouterr()
+
+    def test_lower_better_metric(self, tmp_path, capsys):
+        files = [
+            self._round(tmp_path, 1, 2.0,
+                        detail={"serving_bubble_frac": 0.10}),
+            self._round(tmp_path, 2, 2.0,
+                        detail={"serving_bubble_frac": 0.30}),
+        ]
+        # 0.10 -> 0.30 is past 10% relative + 0.05 absolute slack
+        assert regress.main(files) == 1
+        out = capsys.readouterr().out
+        assert "serving_bubble_frac" in out
+
+    def test_backend_mismatch_gates_nothing(self, tmp_path, capsys):
+        # a CPU-fallback capture must not "regress" against the TPU
+        # trajectory — mismatched-backend priors are set aside
+        files = [self._round(tmp_path, 1, 2.0,
+                             detail={"backend": "tpu"}),
+                 self._round(tmp_path, 2, 0.9,
+                             detail={"backend": "cpu"})]
+        assert regress.main(files) == 0
+        out = capsys.readouterr().out
+        assert "nothing to gate" in out
+
+    def test_same_backend_still_gates(self, tmp_path, capsys):
+        files = [self._round(tmp_path, 1, 2.0,
+                             detail={"backend": "tpu"}),
+                 self._round(tmp_path, 2, 0.9,
+                             detail={"backend": "cpu"}),
+                 self._round(tmp_path, 3, 1.5,
+                             detail={"backend": "tpu"})]
+        # r3 gates against r1 (tpu), r2 is set aside: -25% fails
+        assert regress.main(files) == 1
+        capsys.readouterr()
+
+    def test_single_comparable_round_passes(self, tmp_path, capsys):
+        files = [self._round(tmp_path, 1, 2.0),
+                 self._round(tmp_path, 2, 0.0, parsed=False)]
+        assert regress.main(files) == 0
+        capsys.readouterr()
+
+    def test_unreadable_input_exits_2(self, tmp_path, capsys):
+        bad = tmp_path / "nope.json"
+        assert regress.main([str(bad)]) == 2
+        capsys.readouterr()
+
+    def test_bad_tolerance_exits_2(self, tmp_path, capsys):
+        f = self._round(tmp_path, 1, 2.0)
+        assert regress.main([f, "--tolerance", "1.5"]) == 2
+        capsys.readouterr()
