@@ -92,4 +92,14 @@ EOF
 #    sequence that degraded the fast path now fails loudly instead of
 #    appending a silently-worse round
 run "bench.py post-check + regression gate" python bench.py --gate
+
+# 9. STATIC GATE: jaxlint over the package (hpc_patterns_tpu.analysis)
+#    — the review-time counterpart of the bench gate. The round's
+#    verdict lands as a kind=analysis record in the run log, where
+#    harness.report surfaces it next to the metrics/trace rollups. A
+#    dirty tree fails the sequence: donation-alias was the bug class
+#    that cost round 6 its cache, and it is cheaper to catch here than
+#    on a chip session.
+run "jaxlint static gate" python -m hpc_patterns_tpu.analysis --ci \
+  --log "${LOG%.log}_analysis.jsonl"
 echo "DONE $(date +%H:%M:%S)" | tee -a "$LOG"

@@ -1,0 +1,49 @@
+"""jaxlint: static hazard analysis for the JAX patterns this repo has
+been burned by — donation aliasing, dispatch-path host syncs, per-call
+re-jits, PRNG key reuse, and tracer leaks.
+
+Run it over the package (CI mode exits nonzero on any unsuppressed
+finding)::
+
+    python -m hpc_patterns_tpu.analysis --ci
+
+The motivating incident is PR 2's "poisoned cache": a zero-copy
+``np.asarray`` host view of a buffer that a donated jit arg later
+mutated in place (``serving._dispatch_chunk``). The flight recorder
+(harness/trace.py) can show that bug only *after* it burns a chip
+session; the ``donation-alias`` rule catches it at review time. The
+recorder shows you the bubble; jaxlint stops the next one.
+
+Public surface:
+
+- :func:`run_paths` / :class:`Report` / :class:`Finding` — the engine
+  (hpc_patterns_tpu.analysis.core; rules in .rules self-register);
+- :func:`dispatch_critical` — no-op marker decorator: the
+  ``host-sync-in-dispatch`` rule treats any function carrying it as
+  dispatch-critical, in addition to the configured name list;
+- :func:`poison_donated` (hpc_patterns_tpu.analysis.runtime) — the
+  RUNTIME complement: wraps a jitted fn and clobbers donated inputs
+  after each call, so an aliasing bug the analyzer missed fails loudly
+  in tests instead of silently on a chip.
+"""
+
+from __future__ import annotations
+
+from hpc_patterns_tpu.analysis.core import (  # noqa: F401
+    AnalysisConfig,
+    DEFAULT_DISPATCH_CRITICAL,
+    Finding,
+    Report,
+    analyze_file,
+    registered_rules,
+    run_paths,
+)
+
+
+def dispatch_critical(fn):
+    """Marker decorator: this function is on a dispatch-critical path
+    (its job is to ENQUEUE device work, never to wait for it). Purely
+    declarative — the wrapped function is returned unchanged — but the
+    ``host-sync-in-dispatch`` rule audits every function carrying it,
+    so the marker turns a design intention into a checked invariant."""
+    return fn

@@ -1,0 +1,579 @@
+"""The jaxlint rule set: five hazard classes this repo has hit or is
+one typo away from.
+
+Each rule is a pure-``ast`` visitor over one module (cross-module
+resolution is deliberately out of scope: every hazard below is visible
+— and was introduced — within a single file). Canonical-name matching
+goes through :meth:`ModuleInfo.resolve`, so ``np``/``numpy`` and
+``jnp``/``jax.numpy`` spellings are equivalent.
+
+Catalog (docs/analysis.md has the worked examples):
+
+- ``donation-alias``       — zero-copy host view live across a call
+                             that donates the viewed buffer (the PR 2
+                             ``_dispatch_chunk`` bug, verbatim)
+- ``host-sync-in-dispatch``— host readback/sync inside a
+                             dispatch-critical function
+- ``recompile-hazard``     — ``jax.jit`` built per call / per loop
+                             iteration; fresh containers as static args
+- ``prng-key-reuse``       — one key consumed by two traced uses with
+                             no ``split``/``fold_in`` between
+- ``tracer-leak``          — traced intermediates assigned to
+                             ``self.*``/globals inside a jitted body
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from hpc_patterns_tpu.analysis.core import (
+    AnalysisConfig,
+    Finding,
+    ModuleInfo,
+    Rule,
+    register,
+)
+
+# calls returning a zero-copy host view of their argument (on CPU, and
+# for np.asarray/__array__ whenever XLA can hand back the host buffer)
+_VIEW_CALLS = frozenset({"numpy.asarray", "memoryview"})
+# jax.random calls that CONSUME the key passed as their first argument.
+# fold_in is exempt: folding distinct data into one base key is the
+# documented fan-out pattern (serving.request_key); PRNGKey/key CREATE.
+_KEY_EXEMPT = frozenset({
+    "fold_in", "PRNGKey", "key", "clone", "key_data", "wrap_key_data",
+    "key_impl", "default_prng_impl",
+})
+_JIT_NAMES = frozenset({
+    "jax.jit", "jax.pjit", "jax.experimental.pjit.pjit",
+})
+
+
+def _func_name(mod: ModuleInfo, call: ast.Call) -> str | None:
+    return mod.resolve(call.func)
+
+
+def _is_jit_constructor(mod: ModuleInfo, call: ast.Call) -> bool:
+    """``jax.jit(...)`` or ``partial(jax.jit, ...)`` (pjit included)."""
+    name = _func_name(mod, call)
+    if name in _JIT_NAMES:
+        return True
+    if name == "functools.partial" and call.args:
+        return mod.resolve(call.args[0]) in _JIT_NAMES
+    return False
+
+
+def _int_tuple(node: ast.AST) -> tuple[int, ...] | None:
+    """Literal int / tuple-or-list-of-ints, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, int)):
+                return None
+            out.append(elt.value)
+        return tuple(out)
+    return None
+
+
+def _str_tuple(node: ast.AST) -> tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            elt.value for elt in node.elts
+            if isinstance(elt, ast.Constant)
+            and isinstance(elt.value, str)
+        )
+    return ()
+
+
+def _jit_call_config(mod: ModuleInfo, call: ast.Call
+                     ) -> dict[str, tuple]:
+    """donate_argnums/donate_argnames/static_argnames literals from a
+    jit constructor call (works for the ``partial(jax.jit, ...)`` form
+    too — keywords live on the partial)."""
+    out: dict[str, tuple] = {}
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            nums = _int_tuple(kw.value)
+            if nums is not None:
+                out["donate_argnums"] = nums
+        elif kw.arg == "donate_argnames":
+            out["donate_argnames"] = _str_tuple(kw.value)
+        elif kw.arg == "static_argnames":
+            out["static_argnames"] = _str_tuple(kw.value)
+    return out
+
+
+def _donor_table(mod: ModuleInfo) -> dict[str, dict[str, tuple]]:
+    """name -> jit config for every donating callable visible in this
+    module: decorated defs and ``name = jax.jit(f, donate_...)``."""
+    donors: dict[str, dict[str, tuple]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and _is_jit_constructor(
+                        mod, dec):
+                    cfg = _jit_call_config(mod, dec)
+                    if "donate_argnums" in cfg or "donate_argnames" in cfg:
+                        donors[node.name] = cfg
+        elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call) and _is_jit_constructor(
+                    mod, node.value):
+            cfg = _jit_call_config(mod, node.value)
+            if "donate_argnums" in cfg or "donate_argnames" in cfg:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        donors[tgt.id] = cfg
+    return donors
+
+
+def _functions(tree: ast.AST) -> Iterable[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _loop_ancestors(mod: ModuleInfo, node: ast.AST) -> set[int]:
+    """ids of the For/While nodes enclosing ``node``."""
+    out: set[int] = set()
+    cur = mod.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.For, ast.While)):
+            out.add(id(cur))
+        cur = mod.parents.get(cur)
+    return out
+
+
+@register
+class DonationAliasRule(Rule):
+    """The PR 2 bug class: ``v = np.asarray(x)`` is (on CPU, and
+    whenever XLA can avoid the copy) a zero-copy HOST VIEW of ``x``'s
+    device buffer. If ``x`` is then passed to a call that DONATES it,
+    any executable honoring the donation (cache-loaded ones do, round
+    6) reuses the buffer for the output — and the "snapshot" silently
+    mutates under the host's feet."""
+
+    name = "donation-alias"
+    summary = ("zero-copy host view of a buffer that a later call "
+               "donates")
+    hint = ("snapshot with np.array(x) (a real copy) before the "
+            "donating call, or defer the host read past it")
+
+    def check(self, mod: ModuleInfo, config: AnalysisConfig
+              ) -> Iterable[Finding]:
+        donors = _donor_table(mod)
+        if not donors:
+            return
+        for fn in _functions(mod.tree):
+            # views: var -> (source-expr dump, assign line)
+            views: dict[str, tuple[str, int, ast.AST]] = {}
+            donating: list[tuple[int, str, ast.Call]] = []
+            loads: dict[str, list[int]] = {}
+            returns: list[tuple[int, ast.Return]] = []
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and isinstance(node.value, ast.Call)):
+                    call = node.value
+                    cname = _func_name(mod, call)
+                    is_view = cname in _VIEW_CALLS
+                    if (cname == "numpy.array" and any(
+                            kw.arg == "copy"
+                            and isinstance(kw.value, ast.Constant)
+                            and kw.value.value is False
+                            for kw in call.keywords)):
+                        is_view = True  # np.array(x, copy=False)
+                    src: ast.AST | None = None
+                    if (is_view and call.args and isinstance(
+                            call.args[0], (ast.Name, ast.Attribute,
+                                           ast.Subscript))):
+                        src = call.args[0]
+                    elif (isinstance(call.func, ast.Attribute)
+                            and call.func.attr == "__array__"
+                            and isinstance(
+                                call.func.value,
+                                (ast.Name, ast.Attribute,
+                                 ast.Subscript))):
+                        src = call.func.value  # x.__array__()
+                    if src is not None:
+                        views[node.targets[0].id] = (
+                            ast.dump(src), node.lineno, node)
+                elif isinstance(node, ast.Call):
+                    cname = _func_name(mod, node)
+                    donor = donors.get((cname or "").split(".")[-1]) \
+                        if cname else None
+                    if donor is not None:
+                        for i in donor.get("donate_argnums", ()):
+                            if i < len(node.args):
+                                donating.append(
+                                    (node.lineno,
+                                     ast.dump(node.args[i]), node))
+                        names = donor.get("donate_argnames", ())
+                        for kw in node.keywords:
+                            if kw.arg in names:
+                                donating.append(
+                                    (node.lineno, ast.dump(kw.value),
+                                     node))
+                elif isinstance(node, ast.Name) and isinstance(
+                        node.ctx, ast.Load):
+                    loads.setdefault(node.id, []).append(node.lineno)
+                elif isinstance(node, ast.Return):
+                    returns.append((node.lineno, node))
+            for var, (src_dump, vline, vnode) in views.items():
+                for dline, arg_dump, call in donating:
+                    if arg_dump != src_dump:
+                        continue
+                    if dline > vline:
+                        # textual order: view taken, THEN donated
+                        used_after = any(
+                            ln > dline for ln in loads.get(var, ()))
+                    elif _loop_ancestors(mod, vnode) & _loop_ancestors(
+                            mod, call):
+                        # shared loop: iteration N's view is still live
+                        # when iteration N+1's donation (textually
+                        # earlier) clobbers the buffer
+                        used_after = any(
+                            ln > vline for ln in loads.get(var, ()))
+                    else:
+                        continue
+                    if used_after:
+                        yield self.finding(
+                            mod, vnode,
+                            f"{var!r} is a zero-copy host view of a "
+                            f"buffer donated by the call at line "
+                            f"{dline}; an executable honoring the "
+                            f"donation mutates the view in place",
+                        )
+                        break
+
+
+@register
+class HostSyncRule(Rule):
+    """Dispatch-critical functions (the overlapped serving path, eager
+    collective bodies — ``AnalysisConfig.dispatch_critical``, or any
+    function decorated ``@dispatch_critical``) exist to keep the device
+    queue fed. A host readback (``np.asarray``/``np.array`` of a device
+    value, ``.item()``, ``float()`` of a device result,
+    ``block_until_ready``, ``device_get``) stalls exactly the pipeline
+    they implement."""
+
+    name = "host-sync-in-dispatch"
+    summary = "host readback/sync inside a dispatch-critical function"
+    hint = ("defer the readback to the loop's sync point (the "
+            "serving pattern: _resolve_pending / _collect_chunk), or "
+            "keep the decision on device")
+
+    _SYNC_CALLS = frozenset({
+        "jax.block_until_ready", "jax.device_get",
+        "numpy.asarray", "numpy.array",
+    })
+    _SYNC_METHODS = frozenset({"item", "block_until_ready"})
+    _SYNC_CASTS = frozenset({"float", "int", "bool"})
+
+    def _is_critical(self, fn: ast.FunctionDef,
+                     config: AnalysisConfig) -> bool:
+        if fn.name in config.dispatch_critical:
+            return True
+        for dec in fn.decorator_list:
+            node = dec.func if isinstance(dec, ast.Call) else dec
+            name = node.attr if isinstance(node, ast.Attribute) else (
+                node.id if isinstance(node, ast.Name) else "")
+            if name == "dispatch_critical":
+                return True
+        return False
+
+    def check(self, mod: ModuleInfo, config: AnalysisConfig
+              ) -> Iterable[Finding]:
+        for fn in _functions(mod.tree):
+            if not self._is_critical(fn, config):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = _func_name(mod, node)
+                if cname in self._SYNC_CALLS:
+                    yield self.finding(
+                        mod, node,
+                        f"{cname}() forces a host sync inside "
+                        f"dispatch-critical {fn.name!r}",
+                    )
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in self._SYNC_METHODS):
+                    yield self.finding(
+                        mod, node,
+                        f".{node.func.attr}() forces a host sync "
+                        f"inside dispatch-critical {fn.name!r}",
+                    )
+                elif (cname in self._SYNC_CASTS and node.args
+                        and isinstance(node.args[0], ast.Call)):
+                    # float(f(...)): materializes the device result —
+                    # the cast-of-a-call form only, so host-side
+                    # int(x.size) bookkeeping stays legal
+                    yield self.finding(
+                        mod, node,
+                        f"{cname}() of a call result reads back a "
+                        f"device value inside dispatch-critical "
+                        f"{fn.name!r}",
+                    )
+
+
+@register
+class RecompileRule(Rule):
+    """``jax.jit`` keys its trace cache on the wrapper object: a
+    wrapper constructed per call (or per loop iteration) re-traces and
+    re-compiles every time — the silent 1000x slowdown. Static args
+    add the variant: a fresh unhashable container as a static arg
+    fails (or, for exotic __eq__ types, recompiles) on every call."""
+
+    name = "recompile-hazard"
+    summary = ("jit constructed per call/iteration, or fresh "
+               "containers as static args")
+    hint = ("hoist the jit to module level (or memoize the wrapper); "
+            "pass static args as hashable constants")
+
+    def check(self, mod: ModuleInfo, config: AnalysisConfig
+              ) -> Iterable[Finding]:
+        # static-arg tables for same-module jitted defs
+        statics: dict[str, frozenset[str]] = {}
+        for fn in _functions(mod.tree):
+            for dec in fn.decorator_list:
+                if isinstance(dec, ast.Call) and _is_jit_constructor(
+                        mod, dec):
+                    names = _jit_call_config(mod, dec).get(
+                        "static_argnames", ())
+                    if names:
+                        statics[fn.name] = frozenset(names)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_jit_constructor(mod, node):
+                loop = self._enclosing(mod, node, (ast.For, ast.While))
+                fn = self._enclosing(
+                    mod, node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                parent = mod.parents.get(node)
+                called_now = (isinstance(parent, ast.Call)
+                              and parent.func is node)
+                if loop is not None:
+                    yield self.finding(
+                        mod, node,
+                        "jax.jit constructed inside a loop: a fresh "
+                        "wrapper per iteration re-traces and "
+                        "re-compiles every time",
+                    )
+                elif fn is not None and called_now:
+                    yield self.finding(
+                        mod, node,
+                        f"jax.jit(...)(...) inside {fn.name!r}: the "
+                        f"wrapper is rebuilt — and re-jitted — on "
+                        f"every call of {fn.name!r}",
+                    )
+            else:
+                cname = _func_name(mod, node)
+                static = statics.get((cname or "").split(".")[-1]) \
+                    if cname else None
+                if not static:
+                    continue
+                for kw in node.keywords:
+                    if kw.arg in static and isinstance(
+                            kw.value, (ast.List, ast.Dict, ast.Set)):
+                        yield self.finding(
+                            mod, kw.value,
+                            f"fresh {type(kw.value).__name__.lower()} "
+                            f"literal passed as static arg "
+                            f"{kw.arg!r} of jitted "
+                            f"{(cname or '').split('.')[-1]!r}",
+                            hint="static args are hashed into the "
+                                 "compile cache key; pass a tuple / "
+                                 "frozen constant",
+                        )
+
+    @staticmethod
+    def _enclosing(mod: ModuleInfo, node: ast.AST, kinds) -> ast.AST | None:
+        cur = mod.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, kinds):
+                return cur
+            cur = mod.parents.get(cur)
+        return None
+
+
+@register
+class PrngReuseRule(Rule):
+    """A PRNG key is an affine resource: every ``jax.random`` consumer
+    (including ``split``) must see a key exactly once, or two "random"
+    draws are bit-identical. ``fold_in`` is the sanctioned fan-out
+    (distinct data into one base — serving.request_key) and is exempt."""
+
+    name = "prng-key-reuse"
+    summary = "one key consumed by two traced uses without a re-split"
+    hint = ("thread the key: `key, sub = jax.random.split(key)` before "
+            "each consumer, or fold_in distinct stream ids")
+
+    def check(self, mod: ModuleInfo, config: AnalysisConfig
+              ) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        for fn in _functions(mod.tree):
+            state: dict[str, int] = {}  # var -> first-consumption line
+            self._scan_block(mod, fn.body, state, findings, fn)
+        seen = set()
+        for f in findings:
+            if (f.line, f.col) not in seen:
+                seen.add((f.line, f.col))
+                yield f
+
+    # -- helpers ---------------------------------------------------------
+
+    def _consumptions(self, mod: ModuleInfo, expr: ast.AST
+                      ) -> list[tuple[str, ast.Call]]:
+        out = []
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # separate scope, scanned on its own
+            stack.extend(ast.iter_child_nodes(node))
+            if not (isinstance(node, ast.Call) and node.args
+                    and isinstance(node.args[0], ast.Name)):
+                continue
+            cname = _func_name(mod, node) or ""
+            if (cname.startswith("jax.random.")
+                    and cname.rsplit(".", 1)[1] not in _KEY_EXEMPT):
+                out.append((node.args[0].id, node))
+        return out
+
+    def _targets(self, node: ast.AST) -> set[str]:
+        names: set[str] = set()
+        for t in ast.walk(node):
+            if isinstance(t, ast.Name) and isinstance(
+                    t.ctx, (ast.Store, ast.Del)):
+                names.add(t.id)
+        return names
+
+    def _scan_block(self, mod, stmts, state, findings, fn):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # separate scope, scanned on its own
+            if isinstance(stmt, (ast.For, ast.While)):
+                # a key consumed in a loop body that never re-splits it
+                # draws the SAME bits every iteration, whether the key
+                # is a param, an outer local, or pre-loop state
+                assigned = self._targets(stmt)
+                body = stmt.body + stmt.orelse
+                for sub in body:
+                    for var, call in self._consumptions(mod, sub):
+                        if var not in assigned:
+                            findings.append(self.finding(
+                                mod, call,
+                                f"key {var!r} consumed inside a loop "
+                                f"without a re-split in the loop body "
+                                f"(every iteration sees the same "
+                                f"key)",
+                            ))
+                self._scan_block(mod, body, state, findings, fn)
+                continue
+            if isinstance(stmt, ast.If):
+                self._consume_expr(mod, stmt.test, state, findings)
+                s1, s2 = dict(state), dict(state)
+                self._scan_block(mod, stmt.body, s1, findings, fn)
+                self._scan_block(mod, stmt.orelse, s2, findings, fn)
+                # conservative merge: consumed in either branch counts
+                state.clear()
+                for d in (s1, s2):
+                    for k, v in d.items():
+                        state.setdefault(k, v)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._consume_expr(mod, item.context_expr, state,
+                                       findings)
+                self._scan_block(mod, stmt.body, state, findings, fn)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._scan_block(mod, stmt.body, state, findings, fn)
+                for h in stmt.handlers:
+                    self._scan_block(mod, h.body, dict(state),
+                                     findings, fn)
+                self._scan_block(mod, stmt.finalbody, state, findings,
+                                 fn)
+                continue
+            # plain statement: consumptions in the value happen BEFORE
+            # the rebinding takes effect (`key, sub = split(key)`)
+            self._consume_expr(mod, stmt, state, findings)
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign,
+                                 ast.AugAssign)):
+                for name in self._targets(stmt):
+                    state.pop(name, None)
+
+    def _consume_expr(self, mod, expr, state, findings):
+        for var, call in self._consumptions(mod, expr):
+            if var in state:
+                findings.append(self.finding(
+                    mod, call,
+                    f"key {var!r} already consumed at line "
+                    f"{state[var]}; reusing it makes both draws "
+                    f"bit-identical",
+                ))
+            else:
+                state[var] = call.lineno
+
+
+@register
+class TracerLeakRule(Rule):
+    """Assigning a traced intermediate to ``self.*`` or a global inside
+    a jit-traced function smuggles a tracer out of the trace: the
+    attribute holds a tracer (crashing later uses), or — with a
+    concrete-looking value — silently pins stale state from trace
+    time."""
+
+    name = "tracer-leak"
+    summary = ("traced value assigned to self.*/globals inside a "
+               "jitted function")
+    hint = ("return the value and let the CALLER store it (the engine "
+            "pattern: `self.pos, ... = _chunk_step(...)`)")
+
+    def check(self, mod: ModuleInfo, config: AnalysisConfig
+              ) -> Iterable[Finding]:
+        jitted: list[ast.FunctionDef] = []
+        for fn in _functions(mod.tree):
+            for dec in fn.decorator_list:
+                dec_call = dec if isinstance(dec, ast.Call) else None
+                if (dec_call and _is_jit_constructor(mod, dec_call)) \
+                        or mod.resolve(dec) in _JIT_NAMES:
+                    jitted.append(fn)
+                    break
+        for fn in jitted:
+            # nested defs (scan bodies) trace under the same jit
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global) and node.names:
+                    yield self.finding(
+                        mod, node,
+                        f"global statement inside jit-traced "
+                        f"{fn.name!r}: assignments leak trace-time "
+                        f"values (or tracers) out of the trace",
+                    )
+                if not isinstance(node, (ast.Assign, ast.AugAssign,
+                                         ast.AnnAssign)):
+                    continue
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    for sub in ast.walk(tgt):
+                        if (isinstance(sub, ast.Attribute)
+                                and isinstance(sub.ctx, ast.Store)
+                                and isinstance(sub.value, ast.Name)
+                                and sub.value.id == "self"):
+                            yield self.finding(
+                                mod, node,
+                                f"assignment to self.{sub.attr} "
+                                f"inside jit-traced {fn.name!r} "
+                                f"leaks a traced intermediate",
+                            )

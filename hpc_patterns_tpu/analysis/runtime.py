@@ -1,0 +1,134 @@
+"""Runtime donation poisoning: make aliasing bugs fail loudly in tests.
+
+The static ``donation-alias`` rule catches the shapes it can see; this
+is the belt-and-braces RUNTIME check for the ones it can't. The hazard
+(round 6's "poisoned cache"): on CPU a freshly-built executable often
+does NOT honor a donation, so a zero-copy host view of a donated input
+keeps reading stable values and the bug passes every test — until a
+cache-loaded (or TPU) executable honors the donation and mutates the
+view in place, corrupting whatever bookkeeping was built on it.
+
+:func:`poison_donated` removes the luck: it wraps a jitted function
+and, after each call completes, overwrites every donated input buffer
+that the executable did NOT alias into an output with a sentinel byte
+pattern. Any host view (or late host read) of a donated input now sees
+garbage on EVERY backend — the same observable behavior a
+donation-honoring executable produces, minus the chip session.
+
+Wiring: ``tests/conftest.py`` installs the wrappers around the serving
+engine's jitted entry points for ``tests/test_serving.py`` (always)
+and for the whole suite under ``HPC_PATTERNS_POISON_DONATED=1``.
+
+The buffer writes go through ``unsafe_buffer_pointer`` + ctypes —
+test-harness territory, kept out of library code on purpose.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+
+import jax
+
+#: sentinel byte: 0xAB patterns decode to huge-magnitude garbage in
+#: every dtype we serve (int32 -1414812757, implausible floats), so a
+#: poisoned read corrupts comparisons instead of looking plausible
+SENTINEL_BYTE = 0xAB
+
+
+def _buffer_ptrs(leaf) -> list[tuple[int, int]]:
+    """(pointer, nbytes) per addressable shard; [] when the backend
+    hides them (the helper is then inert, never wrong)."""
+    out = []
+    try:
+        for shard in leaf.addressable_shards:
+            db = shard.data
+            out.append((db.unsafe_buffer_pointer(), db.nbytes))
+    except Exception:  # noqa: BLE001 - best-effort probe
+        return []
+    return out
+
+
+def poison_donated(fn, donate_argnums, *, sentinel: int = SENTINEL_BYTE):
+    """Wrap jitted ``fn`` so donated inputs die loudly after each call.
+
+    After ``fn(*args)`` completes (outputs blocked on), every jax leaf
+    of each ``args[i]`` for ``i in donate_argnums`` is overwritten with
+    ``sentinel`` bytes — unless the executable aliased that buffer into
+    an output (donation honored: poisoning would corrupt the result;
+    the aliasing itself already invalidates stale host views) or jax
+    deleted it. The wrapper forwards ``__wrapped__``, so
+    ``harness.trace.jit_cache_size`` / ``compile_watch`` (and through
+    them ``serving.prefill_cache_size``) keep probing the real jit.
+
+    ``wrapper.poison_count`` accumulates poisoned buffers — tests
+    assert on it to prove the hook engaged rather than silently
+    no-op'ing.
+    """
+    donate_argnums = tuple(donate_argnums)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        leaves_out = jax.tree_util.tree_leaves(out)
+        for leaf in leaves_out:
+            jax.block_until_ready(leaf)
+        out_ptrs = {
+            ptr
+            for leaf in leaves_out
+            if isinstance(leaf, jax.Array)
+            for ptr, _ in _buffer_ptrs(leaf)
+        }
+        for i in donate_argnums:
+            if i >= len(args):
+                continue
+            for leaf in jax.tree_util.tree_leaves(args[i]):
+                if not isinstance(leaf, jax.Array):
+                    continue
+                try:
+                    if leaf.is_deleted():
+                        continue
+                except Exception:  # noqa: BLE001
+                    continue
+                for ptr, nbytes in _buffer_ptrs(leaf):
+                    if ptr in out_ptrs or nbytes == 0:
+                        continue
+                    ctypes.memset(ptr, sentinel, nbytes)
+                    wrapper.poison_count += 1
+        return out
+
+    wrapper.poison_count = 0
+    # functools.wraps already set __wrapped__ = fn; make the contract
+    # explicit since the trace probe depends on it
+    wrapper.__wrapped__ = fn
+    return wrapper
+
+
+#: the serving engine's donating jit entry points and their donated
+#: positions — MUST mirror the donate_argnums in models/serving.py
+#: (tests/test_analysis.py asserts they stay in sync)
+SERVING_POISON_TARGETS: dict[str, tuple[int, ...]] = {
+    "_chunk_step": (1, 2, 3, 4, 5),
+    "_spec_chunk": (2, 3, 4, 5, 6, 7),
+    "_prefill_one": (3,),
+    "_admit_row": (0, 1, 2, 3, 4),
+}
+
+
+def install_serving_poison():
+    """Swap the serving module's jitted entry points for poisoned
+    wrappers; returns an ``uninstall()`` restoring the originals.
+    Import stays local so merely importing this module never drags the
+    models package in."""
+    from hpc_patterns_tpu.models import serving
+
+    originals = {}
+    for name, argnums in SERVING_POISON_TARGETS.items():
+        originals[name] = getattr(serving, name)
+        setattr(serving, name, poison_donated(originals[name], argnums))
+
+    def uninstall():
+        for name, fn in originals.items():
+            setattr(serving, name, fn)
+
+    return uninstall

@@ -46,6 +46,12 @@ from hpc_patterns_tpu.harness import metrics as metricslib
 from hpc_patterns_tpu.harness.cli import base_parser
 from hpc_patterns_tpu.interop import native, zero_copy
 
+# module-level jits: run() is re-entrant (tests, sweeps), and a
+# jax.jit built inside it would re-trace on every invocation
+# (jaxlint: recompile-hazard)
+_double = jax.jit(lambda x: x * 2.0)
+_triple = jax.jit(lambda x: x * 3.0)
+
 
 def build_parser():
     p = base_parser(__doc__.splitlines()[0])
@@ -115,7 +121,7 @@ def run(args) -> int:
 
     # 2. JAX compute -> torch -> JAX, zero-copy both hops (≙ SYCL alloc,
     #    OMP kernel read). Result validated by the C oracle.
-    doubled = jax.jit(lambda x: x * 2.0)(
+    doubled = _double(
         jax.device_put(jnp.ones((n,), jnp.float32), jax.devices("cpu")[0])
     )
     doubled = jax.block_until_ready(doubled)
@@ -136,7 +142,7 @@ def run(args) -> int:
     # 3. native memory -> accelerator and back (staged: DMA by physics)
     dev = jax.devices(args.backend)[0] if args.backend else jax.devices()[0]
     staged = jax.device_put(buf.as_numpy(), dev)
-    tripled = np.asarray(jax.jit(lambda x: x * 3.0)(staged))
+    tripled = np.asarray(_triple(staged))
     # compare in f32 with tolerance: exact f64 equality would fail for
     # n past 2^24 purely from float32 rounding
     expect_last = np.float32(3.0) * np.float32(n - 1)

@@ -84,6 +84,8 @@ def run(args) -> int:
         return (1 - 2 * alpha) * v + alpha * (jnp.roll(v, 1) + jnp.roll(v, -1))
 
     want = np.asarray(
+        # jaxlint: disable=recompile-hazard — one-shot dense oracle per
+        # run(); closes over the run's steps/alpha args
         jax.jit(lambda v: lax.fori_loop(0, steps, lambda _, w: dense_step(w), v))(u0)
     )
     shards = out.addressable_shards

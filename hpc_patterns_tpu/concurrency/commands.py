@@ -9,7 +9,10 @@ The reference's three command kinds (sycl_con.cpp:84-99):
 Each command here has MPI-queue-like async semantics: :meth:`submit`
 enqueues the work and returns immediately (JAX async dispatch ≙ an
 out-of-order queue submit), :meth:`block` waits for completion (≙
-``Q.wait()``). A command owns its buffers, like each reference command
+``Q.wait()``). The ``submit`` paths carry the ``@dispatch_critical``
+marker: jaxlint (hpc_patterns_tpu.analysis) audits them for host
+readbacks, so "submit never blocks" is a checked invariant, not a
+comment. A command owns its buffers, like each reference command
 owning its USM allocation (sycl_con.cpp:64-73), so independent commands
 share no data dependencies and the runtime is free to overlap them.
 
@@ -27,6 +30,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from hpc_patterns_tpu.analysis import dispatch_critical
 from hpc_patterns_tpu.concurrency import kernels
 
 
@@ -68,9 +72,11 @@ def _memory_kind_transfers_work(device) -> bool:
             tiny = jax.device_put(
                 jnp.zeros((8,), jnp.float32), _kind_sharding(device, "pinned_host")
             )
-            moved = jax.jit(
-                lambda x: x, out_shardings=_kind_sharding(device, "device")
-            )(tiny)
+            # the probe executes the SAME cached transfer program real
+            # copy commands use (a fresh jax.jit here would re-trace on
+            # every probe — jaxlint: recompile-hazard — and prove a
+            # different executable than the one commands dispatch)
+            moved = _move_to_kind(device, "device")(tiny)
             jax.block_until_ready(moved)
             _MEMORY_KIND_PROBE[key] = True
         except Exception:
@@ -111,6 +117,7 @@ class ComputeCommand(Command):
         self.tripcount = int(tripcount)
         self._pending = None
 
+    @dispatch_critical
     def submit(self) -> None:
         self._pending = kernels.busy_wait(self.x, self.tripcount)
 
@@ -147,6 +154,7 @@ class CopyM2DCommand(Command):
             self._host = np.zeros((self.n_elements,), dtype)
             self._submit = lambda: jax.device_put(self._host, self.device)
 
+    @dispatch_critical
     def submit(self) -> None:
         self._pending = self._submit()
 
@@ -182,6 +190,7 @@ class CopyD2MCommand(Command):
             self._fresh = _fresh_copy
             self._mode = "host_async"
 
+    @dispatch_critical
     def submit(self) -> None:
         if self._mode == "memory_kind":
             self._pending = self._move(self._dev)

@@ -45,6 +45,10 @@ def _busy_wait_kernel(trip_ref, x_ref, o_ref):
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _busy_wait_call(x, tripcount, *, interpret=False):
+    # tripcount arrives as a raw host scalar and is wrapped to its
+    # (1,) SMEM shape HERE, under the trace — wrapping at the call
+    # site (`jnp.int32(tripcount)`, the pre-jaxlint form) was an extra
+    # eager dispatch on the submit path per command
     return pl.pallas_call(
         _busy_wait_kernel,
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
@@ -54,7 +58,7 @@ def _busy_wait_call(x, tripcount, *, interpret=False):
         ],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         interpret=interpret,
-    )(jnp.asarray([tripcount], jnp.int32), x)
+    )(jnp.asarray(tripcount, jnp.int32).reshape(1), x)
 
 
 def busy_wait(x, tripcount, *, interpret: bool | None = None):
@@ -67,7 +71,7 @@ def busy_wait(x, tripcount, *, interpret: bool | None = None):
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _busy_wait_call(x, jnp.int32(tripcount), interpret=interpret)
+    return _busy_wait_call(x, tripcount, interpret=interpret)
 
 
 def compute_buffer(n_elements: int, device=None):

@@ -58,6 +58,7 @@ def aggregate(records: list[dict[str, Any]]) -> dict[str, Any]:
     histograms: dict[str, Histogram] = {}
     kinds: dict[str, int] = {}
     traces: list[dict[str, Any]] = []
+    analyses: list[dict[str, Any]] = []
     n_ok = n_bad = n_snapshots = n_layout_skipped = 0
     for rec in records:
         kind = rec.get("kind", "?")
@@ -67,6 +68,18 @@ def aggregate(records: list[dict[str, Any]]) -> dict[str, Any]:
                 n_ok += 1
             else:
                 n_bad += 1
+        if kind == "analysis":
+            # jaxlint verdict (python -m hpc_patterns_tpu.analysis
+            # --log): surface the static-gate outcome next to the
+            # runtime rollups
+            analyses.append({
+                "ok": rec.get("ok", False),
+                "findings": rec.get("findings", 0),
+                "suppressed": rec.get("suppressed", 0),
+                "baselined": rec.get("baselined", 0),
+                "files": rec.get("files", 0),
+                "by_rule": rec.get("by_rule", {}),
+            })
         if kind == "trace":
             # flight-recorder snapshot (harness/trace.py): summarize
             # the rollups here; the full timeline is the trace CLI's
@@ -111,6 +124,7 @@ def aggregate(records: list[dict[str, Any]]) -> dict[str, Any]:
         "histograms": histograms,
         "kinds": kinds,
         "traces": traces,
+        "analyses": analyses,
         "n_snapshots": n_snapshots,
         "n_layout_skipped": n_layout_skipped,
         "results": (n_ok, n_bad),
@@ -137,6 +151,16 @@ def format_report(agg: dict[str, Any], source: str = "") -> str:
     lines.append(head)
     if ok or bad:
         lines.append(f"results: {ok} SUCCESS / {bad} FAILURE")
+    for a in agg.get("analyses", []):
+        rules = ", ".join(f"{k}={n}"
+                          for k, n in sorted(a["by_rule"].items()))
+        lines.append(
+            f"analysis: {'CLEAN' if a['ok'] else 'FINDINGS'} — "
+            f"{a['findings']} finding(s)"
+            + (f" [{rules}]" if rules else "")
+            + f", {a['suppressed']} suppressed"
+            + (f", {a['baselined']} baselined" if a["baselined"] else "")
+            + f" across {a['files']} file(s) (jaxlint)")
     for t in agg.get("traces", []):
         cats = ", ".join(f"{k}={n}" for k, n in sorted(t["by_cat"].items()))
         comp = t.get("compile", {})

@@ -684,6 +684,8 @@ def init_pp_train_state(key, cfg: TransformerConfig, optimizer=None,
             jax.eval_shape(lambda k: init_params(k, cfg), key),
         )
         full["layers"] = shardings["layers"]
+        # jaxlint: disable=recompile-hazard — init-time one-shot (once
+        # per pp train state); out_shardings close over the runtime mesh
         params = jax.jit(
             lambda k: init_params(k, cfg), out_shardings=full
         )(key)

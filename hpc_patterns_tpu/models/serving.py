@@ -725,6 +725,9 @@ class ContinuousBatcher:
         # view of the device buffer, and _chunk_step DONATES it — an
         # executable that honors the donation (cache-loaded ones do)
         # overwrites the "snapshot" in place with the post-chunk cursors
+        # jaxlint: disable=host-sync-in-dispatch — the copy is the PR 2
+        # donation-alias fix; it syncs only on the PREVIOUS chunk's
+        # cursors, which _collect_chunk already resolved
         pos_start = np.array(self.pos)
         parts = [i for i, s in enumerate(self._slots) if s.active]
         with metricslib.span("serve.decode_dispatch", chunk=self.chunk), \

@@ -207,6 +207,8 @@ def init_train_state(key, cfg: TransformerConfig, mesh=None, optimizer=None):
     if mesh is None:
         params = init_params(key, cfg)
     else:
+        # jaxlint: disable=recompile-hazard — init-time one-shot (once
+        # per train state); out_shardings close over the runtime mesh
         params = jax.jit(
             lambda k: init_params(k, cfg),
             out_shardings=shardlib.param_shardings(mesh, cfg),

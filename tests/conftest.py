@@ -131,6 +131,30 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(skip)
 
 
+@pytest.fixture(autouse=True)
+def _poison_donated_serving(request):
+    """Donation-poison harness (analysis/runtime.py): wraps the serving
+    engine's donating jit entry points so a zero-copy host view of a
+    donated buffer — the PR 2 "poisoned cache" bug class — fails
+    LOUDLY on the CPU mesh instead of passing by backend luck (fresh
+    CPU executables don't honor donations; cache-loaded ones do).
+
+    Always on for tests/test_serving.py (the engine's oracle suite is
+    exactly where an aliasing regression would otherwise hide);
+    ``HPC_PATTERNS_POISON_DONATED=1`` extends it to the whole suite."""
+    if not (os.environ.get("HPC_PATTERNS_POISON_DONATED") == "1"
+            or request.node.module.__name__ == "test_serving"):
+        yield
+        return
+    from hpc_patterns_tpu.analysis.runtime import install_serving_poison
+
+    uninstall = install_serving_poison()
+    try:
+        yield
+    finally:
+        uninstall()
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     from hpc_patterns_tpu import topology

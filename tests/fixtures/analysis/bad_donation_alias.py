@@ -1,0 +1,55 @@
+"""Known-bad: the PR 2 ``_dispatch_chunk`` donation-alias bug, minimized.
+
+``pos_start`` is a zero-copy host view of ``self.pos``; ``_chunk_step``
+DONATES ``self.pos``, so an executable honoring the donation (round 6:
+cache-loaded CPU executables, and TPU always) reuses the buffer for the
+post-chunk cursors — the "snapshot" mutates under the host's feet and
+the collect bookkeeping built on it corrupts.
+
+Lines carrying ``EXPECT: <rule>[, <rule>]`` markers are the golden
+findings tests/test_analysis.py asserts, line-exact.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, donate_argnums=(1, 2))
+def _chunk_step(params, cache, pos):
+    return cache * params, pos + 1
+
+
+class Engine:
+    def __init__(self):
+        self.params = jnp.ones((4,))
+        self.cache = jnp.zeros((4,))
+        self.pos = jnp.zeros((4,), jnp.int32)
+
+    def _dispatch_chunk(self):
+        pos_start = np.asarray(self.pos)  # EXPECT: donation-alias, host-sync-in-dispatch
+        self.cache, self.pos = _chunk_step(
+            self.params, self.cache, self.pos)
+        return pos_start
+
+
+def dunder_array_form(engine):
+    snap = engine.pos.__array__()  # EXPECT: donation-alias
+    engine.cache, engine.pos = _chunk_step(
+        engine.params, engine.cache, engine.pos)
+    return snap
+
+
+def loop_carried(engine, xs):
+    # the donation is TEXTUALLY before the view, but they share the
+    # loop: iteration N's view is still live when iteration N+1's
+    # donation clobbers the buffer — the serving-loop shape
+    snaps = []
+    for _ in xs:
+        engine.cache, engine.pos = _chunk_step(
+            engine.params, engine.cache, engine.pos)
+        snap = np.asarray(engine.pos)  # EXPECT: donation-alias
+        snaps.append(snap)
+    return snaps

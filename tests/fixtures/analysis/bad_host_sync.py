@@ -1,0 +1,27 @@
+"""Known-bad: host readbacks inside dispatch-critical functions —
+both the configured-name form (``_dispatch_chunk``) and the
+``@dispatch_critical`` marker form."""
+
+import jax
+import numpy as np
+
+from hpc_patterns_tpu.analysis import dispatch_critical
+
+
+def _dispatch_chunk(engine):
+    out = engine.step()
+    jax.block_until_ready(out)  # EXPECT: host-sync-in-dispatch
+    val = out.item()  # EXPECT: host-sync-in-dispatch
+    snap = np.asarray(engine.pos)  # EXPECT: host-sync-in-dispatch
+    return val, snap
+
+
+@dispatch_critical
+def enqueue_next(engine):
+    return float(engine.step())  # EXPECT: host-sync-in-dispatch
+
+
+def _admit(engine, req):
+    got = jax.device_get(engine.logits)  # EXPECT: host-sync-in-dispatch
+    engine.table = np.array(engine.table)  # EXPECT: host-sync-in-dispatch
+    return got

@@ -1,0 +1,22 @@
+"""Known-bad: one key, two draws — linear reuse and loop reuse."""
+
+import jax
+
+
+def double_sample(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))  # EXPECT: prng-key-reuse
+    return a + b
+
+
+def split_then_reuse_parent(key):
+    sub1, sub2 = jax.random.split(key)
+    noise = jax.random.normal(key, (2,))  # EXPECT: prng-key-reuse
+    return sub1, sub2, noise
+
+
+def loop_reuse(key, n):
+    outs = []
+    for _ in range(n):
+        outs.append(jax.random.normal(key, (2,)))  # EXPECT: prng-key-reuse
+    return outs

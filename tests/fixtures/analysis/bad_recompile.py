@@ -1,0 +1,27 @@
+"""Known-bad: jit wrappers built per call / per iteration, and a fresh
+container as a static arg."""
+
+from functools import partial
+
+import jax
+
+
+def per_call(x):
+    return jax.jit(lambda v: v + 1)(x)  # EXPECT: recompile-hazard
+
+
+def per_iteration(xs):
+    out = []
+    for x in xs:
+        f = jax.jit(lambda v: v * 2)  # EXPECT: recompile-hazard
+        out.append(f(x))
+    return out
+
+
+@partial(jax.jit, static_argnames=("sizes",))
+def bucketed(x, *, sizes):
+    return x
+
+
+def fresh_static_container(x):
+    return bucketed(x, sizes=[16, 32])  # EXPECT: recompile-hazard

@@ -1,0 +1,31 @@
+"""Known-bad: traced intermediates smuggled out of the trace through
+``self.*`` and module globals."""
+
+from functools import partial
+
+import jax
+
+_LAST = None
+
+
+@partial(jax.jit, static_argnames=("scale",))
+def leaky_method(self, x, *, scale):
+    self.cache = x * scale  # EXPECT: tracer-leak
+    return x
+
+
+@jax.jit
+def leaky_global(x):
+    global _LAST  # EXPECT: tracer-leak
+    _LAST = x + 1
+    return x
+
+
+@jax.jit
+def leaky_nested(x):
+    def inner(v):
+        # nested defs trace under the same jit
+        inner.owner.state = v  # not self/global: allowed by the rule
+        return v
+
+    return inner(x)

@@ -1,0 +1,38 @@
+"""Known-clean: the blessed snapshot patterns around a donating call.
+
+``np.array`` is a REAL copy (the shipped ``_dispatch_chunk`` fix), and
+a view of a buffer the call does NOT donate is fine.
+"""
+
+from functools import partial
+
+import jax
+import numpy as np
+
+
+@partial(jax.jit, donate_argnums=(1,))
+def _step(params, cache):
+    return cache * params
+
+
+def snapshot_with_copy(engine):
+    # real copy: safe to hold across the donating call
+    pos_start = np.array(engine.pos)
+    engine.cache = _step(engine.params, engine.cache)
+    return pos_start
+
+
+def view_of_undonated(engine):
+    # zero-copy view of params — which _step does NOT donate
+    p = np.asarray(engine.params)
+    engine.cache = _step(engine.params, engine.cache)
+    return p
+
+
+def view_not_used_after(engine):
+    # view dies before the donating call's result can alias into it
+    # being observed: nothing reads it afterwards
+    peek = np.asarray(engine.cache)
+    total = float(peek.sum())
+    engine.cache = _step(engine.params, engine.cache)
+    return total

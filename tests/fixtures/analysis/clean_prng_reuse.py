@@ -1,0 +1,26 @@
+"""Known-clean: the blessed key disciplines — thread the key through
+split, fold_in distinct stream ids, re-split inside loops."""
+
+import jax
+
+
+def threaded(key):
+    key, sub = jax.random.split(key)
+    a = jax.random.normal(sub, (4,))
+    key, sub = jax.random.split(key)
+    b = jax.random.uniform(sub, (4,))
+    return a + b
+
+
+def fanout(base, n):
+    # fold_in of distinct data into one base key is the sanctioned
+    # fan-out (serving.request_key)
+    return [jax.random.fold_in(base, i) for i in range(n)]
+
+
+def loop_resplit(key, n):
+    outs = []
+    for _ in range(n):
+        key, sub = jax.random.split(key)
+        outs.append(jax.random.normal(sub, (2,)))
+    return outs

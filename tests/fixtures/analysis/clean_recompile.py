@@ -1,0 +1,38 @@
+"""Known-clean: the blessed jit lifetimes — module level, decorator,
+build-once factory, memoized wrapper, hashable static args."""
+
+from functools import partial
+
+import jax
+
+_double = jax.jit(lambda v: v * 2)
+
+_CACHE: dict = {}
+
+
+@partial(jax.jit, static_argnames=("sizes",))
+def bucketed(x, *, sizes):
+    return x
+
+
+def uses_module_jit(x):
+    return _double(x)
+
+
+def factory(scale):
+    # built once per factory call, returned for reuse — the
+    # make_train_step shape
+    step = jax.jit(lambda v: v * scale)
+    return step
+
+
+def memoized(key):
+    fn = _CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(lambda v: v + key)
+        _CACHE[key] = fn
+    return fn
+
+
+def hashable_static(x):
+    return bucketed(x, sizes=(16, 32))

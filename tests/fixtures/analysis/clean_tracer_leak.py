@@ -1,0 +1,23 @@
+"""Known-clean: jitted functions return values; the CALLER stores them
+(the engine pattern: ``self.pos, ... = _chunk_step(...)``)."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(1,))
+def _step(params, state):
+    return state * params
+
+
+class Engine:
+    def advance(self):
+        # assignment to self happens OUTSIDE the trace
+        self.state = _step(self.params, self.state)
+
+
+def not_jitted(engine, x):
+    # plain python: storing on self is fine outside a trace
+    engine.last = x
+    return x

@@ -1,0 +1,24 @@
+"""Suppression semantics: trailing and standalone forms silence a
+named rule; bare or unknown ``disable`` is itself a finding and the
+underlying hazard stays live."""
+
+import jax
+import numpy as np
+
+
+def accepted_one_shot(x):
+    return jax.jit(lambda v: v + 1)(x)  # jaxlint: disable=recompile-hazard — fixture: accepted one-shot
+
+
+def _dispatch_chunk(engine):
+    # jaxlint: disable=host-sync-in-dispatch — fixture: standalone
+    # form, justification continuing over a second comment line
+    return np.asarray(engine.pos)
+
+
+def bare_disable(x):
+    return jax.jit(lambda v: v)(x)  # jaxlint: disable   (EXPECT: bad-suppression, recompile-hazard)
+
+
+def unknown_rule(x):
+    return jax.jit(lambda v: v - 1)(x)  # jaxlint: disable=no-such-rule  (EXPECT: bad-suppression, recompile-hazard)

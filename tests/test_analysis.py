@@ -1,0 +1,349 @@
+"""jaxlint (hpc_patterns_tpu.analysis): golden fixture findings,
+suppression semantics, the CI gate over the live package, and the
+runtime donation-poison helper.
+
+The fixture corpus under ``tests/fixtures/analysis/`` is the rule
+catalog's executable form: one known-bad and one known-clean file per
+rule, with expected findings marked line-exact by ``EXPECT: <rule>``
+trailing comments — the golden comparison reads the markers, so a
+fixture edit can't silently desynchronize from its expectations.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hpc_patterns_tpu.analysis import __main__ as cli
+from hpc_patterns_tpu.analysis import core, runtime
+from hpc_patterns_tpu.analysis.core import AnalysisConfig, ModuleInfo
+from hpc_patterns_tpu.analysis.rules import _donor_table
+
+FIXTURES = Path(__file__).parent / "fixtures" / "analysis"
+PACKAGE = Path(__file__).resolve().parent.parent / "hpc_patterns_tpu"
+
+_EXPECT_RE = re.compile(r"EXPECT:\s*([a-z\-]+(?:\s*,\s*[a-z\-]+)*)")
+
+
+def _expected_findings() -> dict[tuple[str, int], set[str]]:
+    """{(fixture name, line): {rules}} parsed from EXPECT markers."""
+    expected: dict[tuple[str, int], set[str]] = {}
+    for f in sorted(FIXTURES.glob("*.py")):
+        for lineno, line in enumerate(f.read_text().splitlines(), 1):
+            m = _EXPECT_RE.search(line)
+            if m:
+                expected[(f.name, lineno)] = {
+                    r.strip() for r in m.group(1).split(",")}
+    return expected
+
+
+def _actual_findings() -> dict[tuple[str, int], set[str]]:
+    report = core.run_paths([FIXTURES])
+    actual: dict[tuple[str, int], set[str]] = {}
+    for f in report.findings:
+        actual.setdefault((Path(f.path).name, f.line), set()).add(f.rule)
+    return actual
+
+
+class TestGoldenFixtures:
+    def test_findings_match_expect_markers_exactly(self):
+        expected, actual = _expected_findings(), _actual_findings()
+        assert expected, "fixture corpus lost its EXPECT markers"
+        missing = {k: v for k, v in expected.items() if k not in actual}
+        extra = {k: v for k, v in actual.items() if k not in expected}
+        assert not missing and not extra, (
+            f"missing={missing} extra={extra}")
+        for key in expected:
+            assert actual[key] == expected[key], (
+                f"{key}: expected {expected[key]}, got {actual[key]}")
+
+    def test_every_rule_demonstrated_by_a_caught_fixture(self):
+        # the acceptance criterion: all five hazard rules fire on the
+        # corpus, including the minimized PR 2 donation-alias replica
+        caught = {r for rules in _actual_findings().values()
+                  for r in rules}
+        assert {"donation-alias", "host-sync-in-dispatch",
+                "recompile-hazard", "prng-key-reuse",
+                "tracer-leak"} <= caught
+
+    def test_pr2_reproducer_is_caught_at_the_view_line(self):
+        live, _ = core.analyze_file(
+            FIXTURES / "bad_donation_alias.py")
+        donation = [f for f in live if f.rule == "donation-alias"]
+        assert donation, "the PR 2 reproducer must be flagged"
+        src = (FIXTURES / "bad_donation_alias.py").read_text()
+        flagged_line = src.splitlines()[donation[0].line - 1]
+        assert "np.asarray(self.pos)" in flagged_line
+
+    def test_clean_fixtures_stay_clean(self):
+        for f in sorted(FIXTURES.glob("clean_*.py")):
+            live, suppressed = core.analyze_file(f)
+            assert not live, f"{f.name}: {[x.format() for x in live]}"
+            assert not suppressed
+
+    def test_findings_carry_location_and_hint(self):
+        live, _ = core.analyze_file(FIXTURES / "bad_recompile.py")
+        f = live[0]
+        assert f.line > 0 and f.path.endswith("bad_recompile.py")
+        assert f.hint  # every shipped rule must suggest the fix
+        assert f"{f.path}:{f.line}" in f.format()
+
+
+class TestSuppression:
+    def test_named_suppressions_silence_and_are_counted(self):
+        live, suppressed = core.analyze_file(FIXTURES / "suppressed.py")
+        assert {f.rule for f in suppressed} == {
+            "recompile-hazard", "host-sync-in-dispatch"}
+        assert len(suppressed) == 2
+
+    def test_bare_and_unknown_disable_are_findings(self):
+        live, _ = core.analyze_file(FIXTURES / "suppressed.py")
+        bad = [f for f in live if f.rule == "bad-suppression"]
+        assert len(bad) == 2  # one bare, one unknown-rule
+        # and the hazards under them stay LIVE
+        assert sum(1 for f in live if f.rule == "recompile-hazard") == 2
+
+    def test_standalone_suppression_skips_comment_lines(self):
+        # the suppressed.py standalone form has a two-line
+        # justification between the directive and the code
+        _, suppressed = core.analyze_file(FIXTURES / "suppressed.py")
+        assert any(f.rule == "host-sync-in-dispatch"
+                   for f in suppressed)
+
+    def test_bad_suppression_is_not_itself_suppressible(self, tmp_path):
+        f = tmp_path / "m.py"
+        f.write_text("x = 1  # jaxlint: disable  # jaxlint: disable\n")
+        live, suppressed = core.analyze_file(f)
+        assert any(x.rule == "bad-suppression" for x in live)
+
+
+class TestEngine:
+    def test_syntax_error_is_a_finding_not_a_crash(self, tmp_path):
+        f = tmp_path / "broken.py"
+        f.write_text("def f(:\n")
+        live, _ = core.analyze_file(f)
+        assert [x.rule for x in live] == ["parse-error"]
+
+    def test_alias_resolution_sees_through_import_spellings(self):
+        mod = ModuleInfo.parse(
+            "m.py", "import numpy as xyz\nv = xyz.asarray(q)\n")
+        call = mod.tree.body[1].value
+        assert mod.resolve(call.func) == "numpy.asarray"
+
+    def test_select_runs_only_named_rules(self):
+        cfg = AnalysisConfig(select=frozenset({"prng-key-reuse"}))
+        report = core.run_paths([FIXTURES], cfg)
+        assert set(report.by_rule()) == {"prng-key-reuse"}
+
+    def test_nested_function_hazard_reported_once(self, tmp_path):
+        # rules walking nested defs see inner statements from both the
+        # outer and inner function — the engine dedupes to one finding
+        f = tmp_path / "nested.py"
+        f.write_text(
+            "from functools import partial\n"
+            "import jax\n"
+            "import numpy as np\n"
+            "@partial(jax.jit, donate_argnums=(0,))\n"
+            "def step(x):\n"
+            "    return x\n"
+            "def outer():\n"
+            "    def inner(y):\n"
+            "        v = np.asarray(y)\n"
+            "        step(y)\n"
+            "        return v.sum()\n"
+            "    return inner\n")
+        live, _ = core.analyze_file(f)
+        assert [x.rule for x in live] == ["donation-alias"]
+
+    def test_baseline_roundtrip_tolerates_known_findings(self, tmp_path):
+        base = tmp_path / "baseline.json"
+        report = core.run_paths([FIXTURES])
+        core.write_baseline(base, report.findings)
+        again = core.run_paths([FIXTURES],
+                               baseline=core.load_baseline(base))
+        assert not again.findings
+        assert len(again.baselined) == len(report.findings)
+        assert json.loads(base.read_text())["findings"]
+
+
+class TestCLI:
+    def test_ci_exits_nonzero_on_fixture_corpus(self, capsys):
+        assert cli.main([str(FIXTURES), "--ci"]) == 1
+        out = capsys.readouterr().out
+        assert "donation-alias" in out and "jaxlint:" in out
+
+    def test_ci_exits_zero_on_live_package(self, capsys):
+        # THE tier-1 gate: the shipped tree is clean (fix-or-suppress
+        # policy — no baseline file exists in the repo)
+        assert cli.main([str(PACKAGE), "--ci"]) == 0
+        out = capsys.readouterr().out
+        assert "0 finding(s)" in out
+        assert not (Path(__file__).resolve().parent.parent
+                    / "jaxlint_baseline.json").exists()
+
+    def test_default_paths_cover_the_package(self, capsys):
+        assert cli.main(["--ci"]) == 0
+        # the default target is the package dir: same file count as
+        # pointing at it explicitly
+        n = re.search(r"across (\d+) file",
+                      capsys.readouterr().out).group(1)
+        assert int(n) > 50
+
+    def test_non_ci_mode_reports_but_exits_zero(self):
+        assert cli.main([str(FIXTURES)]) == 0
+
+    def test_select_rejects_unknown_rule_names(self, capsys):
+        # a typo'd --select must not run zero rules and read clean
+        assert cli.main([str(FIXTURES), "--ci",
+                         "--select", "donation_alias"]) == 2
+        assert "unknown rule(s)" in capsys.readouterr().err
+
+    def test_log_appends_kind_analysis_record(self, tmp_path, capsys):
+        log = tmp_path / "run.jsonl"
+        log.write_text('{"kind": "result", "success": true}\n')
+        cli.main([str(FIXTURES), "--log", str(log)])
+        records = [json.loads(l) for l in
+                   log.read_text().splitlines()]
+        assert records[0]["kind"] == "result"  # appended, not truncated
+        rec = records[-1]
+        assert rec["kind"] == "analysis" and rec["ok"] is False
+        assert rec["findings"] > 0 and rec["suppressed"] == 2
+        assert rec["by_rule"]["donation-alias"] >= 1
+
+    def test_list_rules_prints_catalog(self, capsys):
+        assert cli.main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in ("donation-alias", "host-sync-in-dispatch",
+                     "recompile-hazard", "prng-key-reuse",
+                     "tracer-leak"):
+            assert rule in out
+
+
+class TestBurnDownPins:
+    """Regression pins for the analyzer's first full-package run: the
+    true-positive fixes stay fixed."""
+
+    def test_interop_app_jits_are_module_level(self):
+        from hpc_patterns_tpu.apps import interop_app
+
+        # hoisted wrappers: same object on every access = one trace
+        # cache for the life of the process (the pre-fix form rebuilt
+        # them inside run())
+        assert interop_app._double is interop_app._double
+        x = jnp.ones((8,), jnp.float32)
+        np.testing.assert_allclose(np.asarray(interop_app._double(x)),
+                                   2.0)
+        np.testing.assert_allclose(np.asarray(interop_app._triple(x)),
+                                   3.0)
+
+    def test_rank_filled_reuses_its_jit(self, mesh8):
+        from hpc_patterns_tpu.comm.communicator import Communicator
+        from hpc_patterns_tpu.harness import trace as tracelib
+
+        c = Communicator(mesh8, "x")
+        a = c.rank_filled(16)
+        b = c.rank_filled(16)
+        assert len(c._rank_filled_cache) == 1
+        fill = next(iter(c._rank_filled_cache.values()))
+        # one compiled variant despite two calls
+        assert tracelib.jit_cache_size(fill, strict=True) == 1
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        c.rank_filled(32)
+        assert len(c._rank_filled_cache) == 2
+
+    def test_busy_wait_single_wrap_matches_oracle(self):
+        from hpc_patterns_tpu.concurrency import kernels
+
+        x = kernels.compute_buffer(8 * 128)
+        got = kernels.busy_wait(x, 3)
+        want = kernels.busy_wait_reference(x, 3)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6)
+        # tripcount is a runtime scalar: new values must NOT add
+        # compiled variants (the autotuner contract)
+        from hpc_patterns_tpu.harness import trace as tracelib
+
+        n0 = tracelib.jit_cache_size(kernels._busy_wait_call,
+                                     strict=True)
+        kernels.busy_wait(x, 7)
+        assert tracelib.jit_cache_size(kernels._busy_wait_call,
+                                       strict=True) == n0
+
+
+class TestPoisonDonated:
+    def test_poison_breaks_stale_zero_copy_views(self):
+        f = jax.jit(lambda v: v + 1, donate_argnums=(0,))
+        x = jax.block_until_ready(jnp.arange(64, dtype=jnp.int32))
+        view = np.asarray(x)  # zero-copy on CPU: the PR 2 shape
+        orig = view.copy()
+        pf = runtime.poison_donated(f, (0,))
+        y = pf(x)
+        # correctness preserved...
+        np.testing.assert_array_equal(np.asarray(y), orig + 1)
+        # ...and the stale view now reads EITHER the donated-in-place
+        # output (donation honored) or the sentinel (poisoned): never
+        # the comfortable pre-call values the bug class relies on
+        assert not np.array_equal(view, orig)
+        if pf.poison_count:
+            assert view.view(np.uint32)[0] == 0xABABABAB
+
+    def test_poison_skips_output_aliased_buffers(self):
+        # identity-ish pytree: some leaves may alias outputs; the
+        # helper must never corrupt what the caller receives
+        f = jax.jit(lambda d: {"a": d["a"] * 2, "b": d["b"]},
+                    donate_argnums=(0,))
+        d = {"a": jnp.ones((16,)), "b": jnp.zeros((16,))}
+        jax.block_until_ready(d)
+        pf = runtime.poison_donated(f, (0,))
+        out = pf(d)
+        np.testing.assert_array_equal(np.asarray(out["a"]), 2.0)
+        np.testing.assert_array_equal(np.asarray(out["b"]), 0.0)
+
+    def test_wrapper_forwards_the_jit_cache_probe(self):
+        from hpc_patterns_tpu.harness import trace as tracelib
+
+        f = jax.jit(lambda v: v * 3, donate_argnums=(0,))
+        pf = runtime.poison_donated(f, (0,))
+        pf(jnp.ones((4,)))
+        assert tracelib.jit_cache_size(pf, strict=True) == 1
+
+    def test_targets_mirror_serving_donate_argnums(self):
+        # SERVING_POISON_TARGETS must track models/serving.py — read
+        # the donate_argnums straight out of the source with the
+        # analyzer's own donor table (dogfood)
+        serving_py = PACKAGE / "models" / "serving.py"
+        donors = _donor_table(ModuleInfo.parse(serving_py))
+        for name, argnums in runtime.SERVING_POISON_TARGETS.items():
+            assert donors[name]["donate_argnums"] == argnums, name
+
+    def test_install_serving_poison_roundtrip(self):
+        from hpc_patterns_tpu.models import serving
+
+        before = {n: getattr(serving, n)
+                  for n in runtime.SERVING_POISON_TARGETS}
+        uninstall = runtime.install_serving_poison()
+        try:
+            for n in runtime.SERVING_POISON_TARGETS:
+                assert getattr(serving, n) is not before[n]
+                assert getattr(serving, n).__wrapped__ is before[n]
+        finally:
+            uninstall()
+        for n in runtime.SERVING_POISON_TARGETS:
+            assert getattr(serving, n) is before[n]
+
+
+class TestMarker:
+    def test_dispatch_critical_is_a_noop_marker(self):
+        from hpc_patterns_tpu.analysis import dispatch_critical
+
+        def g(x):
+            return x + 1
+
+        assert dispatch_critical(g) is g

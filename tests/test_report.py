@@ -61,6 +61,24 @@ class TestReportFixture:
         assert rc == 0
         assert "no kind=metrics snapshots" in out
 
+    def test_kind_analysis_record_is_surfaced(self, tmp_path, capsys):
+        # the jaxlint verdict (analysis --log) renders next to the
+        # runtime rollups — one line per record, rule counts included
+        path = tmp_path / "gate.jsonl"
+        path.write_text("\n".join([
+            json.dumps({"kind": "result", "name": "x", "success": True}),
+            json.dumps({"kind": "analysis", "ok": False, "findings": 2,
+                        "suppressed": 6, "baselined": 0, "files": 67,
+                        "by_rule": {"donation-alias": 2}}),
+        ]) + "\n")
+        agg = report.aggregate(report.load_records([path]))
+        assert agg["analyses"][0]["findings"] == 2
+        rc = report.main([str(path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "analysis: FINDINGS — 2 finding(s)" in out
+        assert "donation-alias=2" in out and "6 suppressed" in out
+
     def test_cli_empty_input_fails(self, tmp_path, capsys):
         path = tmp_path / "empty.jsonl"
         path.write_text("")
