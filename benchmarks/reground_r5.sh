@@ -86,12 +86,30 @@ import sys; sys.argv = ["b", "--seq=131072", "--batch=1", "--remat=1", "--rp=not
 sys.path.insert(0, "benchmarks"); import bench_train as bt; bt.main()
 EOF
 
+# 7b. multi-proc distributed trace: a 2-process allreduce under the
+#     flight recorder — the merged Perfetto timeline and the cross-rank
+#     skew/straggler rollup (kind=trace_merged) land next to the
+#     round's log, so a slow round's collective skew is inspectable
+#     rather than inferred (harness/collect.py; CPU mesh — the
+#     cross-PROCESS path is what this leg exercises, not the chip)
+run "multi-proc allreduce trace (2 ranks)" env JAX_PLATFORMS=cpu \
+  python -m hpc_patterns_tpu.apps.launch -np 2 --cpu-devices-per-proc 2 \
+  --trace-out "${LOG%.log}_multiproc.trace.json" \
+  --log "${LOG%.log}_multiproc.jsonl" -- \
+  python -m hpc_patterns_tpu.apps.allreduce_app -p 16 \
+  --repetitions 5 --warmup 2 --trace
+
 # 8. final health check + REGRESSION GATE: capture the closing round,
 #    write it as the next BENCH_rNN.json, and compare its headline
 #    numbers against the best prior round (harness.regress) — a
 #    sequence that degraded the fast path now fails loudly instead of
-#    appending a silently-worse round
+#    appending a silently-worse round. The gate's stdout now also
+#    carries the coverage-loss check: a gated key (serving_tok_s,
+#    allreduce_busbw_gbps, ...) that a prior round measured and this
+#    round silently lost is WARNED, not passed.
 run "bench.py post-check + regression gate" python bench.py --gate
+run "regress coverage-loss check (full trajectory)" \
+  python -m hpc_patterns_tpu.harness.regress BENCH_r*.json
 
 # 9. STATIC GATE: jaxlint over the package (hpc_patterns_tpu.analysis)
 #    — the review-time counterpart of the bench gate. The round's

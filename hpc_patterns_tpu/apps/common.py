@@ -7,6 +7,7 @@ allreduce-mpi-sycl.cpp:135-152, world-size guard at :95-97, reporting at
 
 from __future__ import annotations
 
+import os
 from typing import Callable
 
 import jax
@@ -25,7 +26,14 @@ def run_instrumented(run_fn: Callable[[object], int], args) -> int:
     ``kind=trace`` (exported to Chrome-trace JSON by `python -m
     hpc_patterns_tpu.harness.trace`). Appending (never truncating)
     keeps the app's own records: the snapshots are the log's closing
-    records, like run.sh's trailing grep summary."""
+    records, like run.sh's trailing grep summary.
+
+    Distributed handoff: a traced run under apps/launch.py additionally
+    writes its recorder snapshot to the launcher-provided
+    ``HPCPAT_TRACE_DIR`` as ``rank<id>.trace.json`` (independent of
+    ``--log`` — the launcher, not the child, owns the merged artifact),
+    where the launcher collects every rank's ring for the clock-aligned
+    merge (harness/collect.py)."""
     from hpc_patterns_tpu.harness import metrics, trace
     from hpc_patterns_tpu.harness.runlog import RunLog
 
@@ -41,12 +49,30 @@ def run_instrumented(run_fn: Callable[[object], int], args) -> int:
     try:
         return run_fn(args)
     finally:
+        # ONE snapshot serves both sinks: the --log record and the
+        # per-rank handoff file must carry identical events and clock
+        # anchors (the offline re-merge from --log files and the
+        # launcher's merge would otherwise disagree)
+        trace_dir = os.environ.get(topology.ENV_TRACE_DIR)
+        rec_snap = (rec.snapshot()
+                    if rec.enabled and (getattr(args, "log", None)
+                                        or trace_dir) else None)
         if getattr(args, "log", None) and (m.enabled or rec.enabled):
             log = RunLog(args.log, truncate=False)
             if m.enabled:
                 log.emit(kind="metrics", **m.snapshot())
             if rec.enabled:
-                log.emit(kind="trace", **rec.snapshot())
+                log.emit(kind="trace", **rec_snap)
+        if rec.enabled and trace_dir:
+            trace.write_rank_snapshot(rec, trace_dir, snapshot=rec_snap)
+
+
+def _trace_recorder():
+    """The active flight recorder, or None — lazy so apps that never
+    enable tracing don't pay the harness import here."""
+    from hpc_patterns_tpu.harness import trace as tracelib
+
+    return tracelib.active()
 
 
 def make_communicator(
@@ -63,9 +89,27 @@ def make_communicator(
 
     Joins a launcher rendezvous first when one is in the environment
     (apps/launch.py ≙ mpirun; init is the MPI_Init analog), so the
-    device list is the GLOBAL multi-process view.
+    device list is the GLOBAL multi-process view. A traced
+    multi-process run then records a sync anchor off a global barrier
+    (all ranks exit within the release-propagation window), which the
+    cross-rank merge uses to align per-rank clocks tighter than wall
+    time — every rank runs the same command line, so either all ranks
+    reach the barrier or none does (the SPMD invariant).
     """
     topology.init_distributed_from_env()
+    rec = _trace_recorder()
+    if rec is not None and jax.process_count() > 1:
+        # barrier = a cross-process allgather: no process receives the
+        # gathered value before every process contributed, so the
+        # returns cluster inside the release-propagation window. The
+        # same primitive reduce_across_processes uses — NOT
+        # sync_global_devices, whose jitted psum the CPU backend
+        # rejects for multiprocess computations on jax 0.4.x.
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        multihost_utils.process_allgather(np.float64(0.0))
+        rec.mark_sync("make_communicator")
     devices = topology.get_devices(backend)
     if world == -1:
         world = len(devices)

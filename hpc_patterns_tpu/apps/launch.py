@@ -23,17 +23,31 @@ Usage:
 
 Exit 0 iff every rank exits 0 (the ctest contract); per-rank output is
 echoed with a ``[r]`` prefix and a grep-able summary line closes the
-run (run.sh:17-18 style).
+run (run.sh:17-18 style). On timeout the hung ranks are named with
+each one's last output line (what a deadlocked-collective debug needs
+first: which rank never arrived).
+
+Distributed flight recorder (``--trace-out merged.json``): the
+launcher exports ``HPCPAT_TRACE_DIR``, every child run with
+``--trace`` hands off its per-rank recorder snapshot there, and at
+exit — clean, failed, or timed out — the launcher merges whatever rank
+files exist into one clock-aligned Perfetto timeline with cross-rank
+skew/straggler rollups (harness/collect.py, rung 4 of the
+observability ladder; docs/observability.md).
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import shutil
 import socket
 import subprocess
 import sys
+import tempfile
 import threading
+import time
+from pathlib import Path
 
 from hpc_patterns_tpu import topology
 
@@ -55,6 +69,23 @@ def build_parser():
                    help="coordinator port (0 = pick a free one)")
     p.add_argument("--timeout", type=float, default=600.0,
                    help="per-run timeout in seconds")
+    p.add_argument("--trace-out", default=None, metavar="MERGED.json",
+                   help="distributed flight recorder: export the "
+                        "launcher env (HPCPAT_TRACE_DIR) so every "
+                        "child run with --trace hands off its per-rank "
+                        "snapshot, then collect, clock-align, and "
+                        "merge them into this Perfetto JSON (one pid "
+                        "lane per rank, flow arrows per collective) "
+                        "and print the skew/straggler rollup "
+                        "(harness/collect.py)")
+    p.add_argument("--trace-dir", default=None,
+                   help="keep the per-rank trace files here instead of "
+                        "a temporary directory (implies they survive "
+                        "the run; default: tmpdir, removed on success)")
+    p.add_argument("--log", default=None,
+                   help="append launcher records (kind=trace_merged "
+                        "under --trace-out) to this runlog JSONL; "
+                        "default: <trace-out>.rollup.jsonl")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="command to launch, after --")
     return p
@@ -91,6 +122,54 @@ def _child_env(base: dict, coord: str, nprocs: int, pid: int,
 _pump = topology.pump_lines
 
 
+class _LastLineTee:
+    """Stdout sink that remembers the most recent non-empty line per
+    rank, so the timeout path can say WHAT each hung rank last printed
+    (a rank stuck compiling vs. stuck in a collective read very
+    differently) without re-parsing the interleaved launcher output."""
+
+    def __init__(self, sink, store: dict, pid: int):
+        self._sink, self._store, self._pid = sink, store, pid
+
+    def write(self, text: str) -> None:
+        self._sink.write(text)
+        stripped = text.strip()
+        if stripped:
+            self._store[self._pid] = stripped
+
+    def flush(self) -> None:
+        self._sink.flush()
+
+
+def _harvest_traces(trace_dir: str, out: str, log: str | None,
+                    nprocs: int) -> None:
+    """Collect whatever per-rank trace files exist under ``trace_dir``
+    (ALL of them after a clean run; any partial set after a timeout —
+    a hung run's already-written ranks are still debuggable), merge
+    them clock-aligned into ``out``, print the skew/straggler rollup,
+    and append the ``kind=trace_merged`` record to ``log``."""
+    from hpc_patterns_tpu.harness import collect as collectlib
+    from hpc_patterns_tpu.harness.runlog import RunLog
+
+    files = sorted(Path(trace_dir).glob("rank*.trace.json"))
+    if not files:
+        print(f"trace: no per-rank snapshots under {trace_dir} — did "
+              "the launched command include --trace?")
+        return
+    if len(files) < nprocs:
+        have = ", ".join(f.name for f in files)
+        print(f"trace: only {len(files)}/{nprocs} rank snapshot(s) "
+              f"harvested ({have}) — merging what exists")
+    rollup = collectlib.collect_to_file(files, out)
+    if rollup is None:
+        print(f"trace: rank files under {trace_dir} held no snapshots")
+        return
+    print(collectlib.format_rollup(rollup))
+    print(f"merged trace: {out} (open in Perfetto / chrome://tracing)")
+    log = log or f"{out}.rollup.jsonl"
+    RunLog(log, truncate=False).emit(kind="trace_merged", **rollup)
+
+
 def run(args) -> int:
     cmd = args.cmd
     if cmd and cmd[0] == "--":
@@ -105,19 +184,45 @@ def run(args) -> int:
     if args.slices and nprocs % args.slices:
         print(f"ERROR: -np {nprocs} must divide by --slices {args.slices}")
         return 2
+    # distributed-trace handoff: children see HPCPAT_TRACE_DIR and (if
+    # run with --trace) write rank<id>.trace.json there at exit; the
+    # path is absolute because children may chdir. Without --trace-out
+    # nothing is exported and the launch is byte-identical to before.
+    trace_dir = made_trace_dir = None
+    if args.trace_out:
+        if args.trace_dir:
+            trace_dir = os.path.abspath(args.trace_dir)
+            os.makedirs(trace_dir, exist_ok=True)
+            # a reused dir must not leak a previous run's ranks into
+            # this merge (stale rank files would stand in for ranks
+            # that crashed before writing, silently)
+            for stale in Path(trace_dir).glob("rank*.trace.json"):
+                stale.unlink()
+        else:
+            trace_dir = made_trace_dir = tempfile.mkdtemp(
+                prefix="hpcpat_trace_")
+    elif args.trace_dir or args.log:
+        print("note: --trace-dir/--log do nothing without --trace-out "
+              "(the distributed-trace pipeline is off)")
+    base_env = dict(os.environ)
+    if trace_dir:
+        base_env[topology.ENV_TRACE_DIR] = trace_dir
     coord = f"127.0.0.1:{args.port or _free_port()}"
     procs, pumps = [], []
+    last_lines: dict[int, str] = {}
     for pid in range(nprocs):
         proc = subprocess.Popen(
             cmd,
-            env=_child_env(os.environ, coord, nprocs, pid,
+            env=_child_env(base_env, coord, nprocs, pid,
                            args.cpu_devices_per_proc, args.slices),
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
         )
         t = threading.Thread(
-            target=_pump, args=(f"[{pid}] ", proc.stdout, sys.stdout),
+            target=_pump,
+            args=(f"[{pid}] ", proc.stdout,
+                  _LastLineTee(sys.stdout, last_lines, pid)),
             daemon=True,
         )
         t.start()
@@ -125,18 +230,43 @@ def run(args) -> int:
         pumps.append(t)
 
     codes = []
+    timed_out = False
+    deadline = time.monotonic() + args.timeout
     try:
         for proc in procs:
-            codes.append(proc.wait(timeout=args.timeout))
+            codes.append(proc.wait(
+                timeout=max(0.0, deadline - time.monotonic())))
     except subprocess.TimeoutExpired:
+        timed_out = True
+        # name the hung ranks BEFORE killing them: rank id + the last
+        # line each printed is the first thing a debugger wants from a
+        # deadlocked collective (which rank never arrived?)
+        stuck = [pid for pid, proc in enumerate(procs)
+                 if proc.poll() is None]
         for proc in procs:
             proc.kill()
-        print(f"FAILURE: timeout after {args.timeout}s")
-        return 1
+        print(f"FAILURE: timeout after {args.timeout}s — "
+              f"{len(stuck)}/{nprocs} rank(s) had not exited:")
+        for pid in stuck:
+            last = last_lines.get(pid, "<no output>")
+            print(f"  rank {pid}: last output: {last}")
     finally:
         for t in pumps:
             t.join(timeout=5)
+        if trace_dir:
+            # harvest even after a timeout: ranks that finished (or
+            # crashed cleanly) already wrote their snapshots
+            try:
+                _harvest_traces(trace_dir, args.trace_out, args.log,
+                                nprocs)
+            finally:
+                if made_trace_dir and not timed_out:
+                    shutil.rmtree(made_trace_dir, ignore_errors=True)
+                elif made_trace_dir:
+                    print(f"per-rank trace files kept: {made_trace_dir}")
 
+    if timed_out:
+        return 1
     ok = all(c == 0 for c in codes)
     print(f"launch -np {nprocs}: exit codes {codes}")
     print("SUCCESS" if ok else "FAILURE")

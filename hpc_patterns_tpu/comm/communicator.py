@@ -30,25 +30,29 @@ from hpc_patterns_tpu.topology import shard_map
 Algorithm = Literal["collective", "ring", "ring_chunked"]
 
 
-def _ready_in_span(result, op: str = "collective"):
+def _ready_in_span(result, op: str = "collective", seq: int | None = None):
     """Block before an open span exits so it measures collective
     completion, not async dispatch — the shard_map call returns an
     unready array. Only when a span actually records (metrics, trace
     mirroring, or the flight recorder); the disabled path stays fully
     async. With a recorder, the dispatch→completion window also lands
     as a ``comm.<op>`` slice on the device track, separating wire time
-    from the host time around it."""
+    from the host time around it; ``seq`` (the per-communicator
+    collective counter) rides in the slice args so the cross-rank merge
+    (harness/collect.py) can match the N ranks' windows of the SAME
+    collective and measure its skew."""
     m = metricslib.get_metrics()
     rec = tracelib.active()
     if not (m.enabled or m.mirror_traces or rec is not None):
         return result
     if rec is not None:
-        t_disp = rec.mark_dispatch(f"comm.{op}")
+        attrs = None if seq is None else {"seq": seq}
+        t_disp = rec.mark_dispatch(f"comm.{op}", args=attrs)
         # jaxlint: disable=host-sync-in-dispatch — measures completion,
         # not dispatch (PR 1 review decision); only reached with a
         # recorder/metrics active, the disabled path stays fully async
         jax.block_until_ready(result)
-        rec.mark_complete(f"comm.{op}", t_disp)
+        rec.mark_complete(f"comm.{op}", t_disp, args=attrs)
     else:
         # jaxlint: disable=host-sync-in-dispatch — same contract as
         # above: the recording span must not exit before the wire time
@@ -104,6 +108,19 @@ class Communicator:
         # once per point, and a fresh jax.jit per call re-traces every
         # time (jaxlint: recompile-hazard)
         self._rank_filled_cache: dict = {}
+        # per-communicator collective counter: every eager collective
+        # call takes the next value, and since all ranks of an SPMD
+        # program issue the identical collective sequence, (span name,
+        # seq) identifies THE SAME collective across ranks — what the
+        # cross-rank trace merge fans its skew arrows over. Incremented
+        # unconditionally (one integer add; the disabled trace path
+        # stays byte-identical in recorded output).
+        self._seq = 0
+
+    def _next_seq(self) -> int:
+        s = self._seq
+        self._seq += 1
+        return s
 
     @property
     def size(self) -> int:
@@ -149,7 +166,7 @@ class Communicator:
         with metricslib.span("comm.allreduce", algorithm=algorithm):
             return _ready_in_span(
                 self._shmap(lambda local: impl(local, self.axis), x)(x),
-                op=f"allreduce.{algorithm}")
+                op=f"allreduce.{algorithm}", seq=self._next_seq())
 
     def jit_allreduce(self, x, algorithm: Algorithm = "collective"):
         """The compiled allreduce closure for ``x``'s shape — what a
@@ -162,7 +179,7 @@ class Communicator:
         pt2pt ping-pong config of BASELINE.json."""
         with metricslib.span("comm.pingpong"):
             return _ready_in_span(self.jit_pingpong(x)(x),
-                                  op="pingpong")
+                                  op="pingpong", seq=self._next_seq())
 
     def jit_pingpong(self, x):
         """Compiled pairwise-exchange closure (for timing loops)."""
@@ -174,7 +191,7 @@ class Communicator:
         with metricslib.span("comm.sendrecv_ring", shift=shift):
             return _ready_in_span(self._shmap(
                 lambda l: ring.ring_shift(l, self.axis, shift), x)(x),
-                op="sendrecv_ring")
+                op="sendrecv_ring", seq=self._next_seq())
 
     def all_gather(self, x) -> jax.Array:
         """Every rank receives every row: (size, n) -> (size, size, n)."""
@@ -182,7 +199,7 @@ class Communicator:
         spec = P(self.axis, None, *([None] * (jnp.ndim(x) - 1)))
         with metricslib.span("comm.all_gather"):
             return _ready_in_span(self._shmap(fn, x, out_specs=spec)(x),
-                                  op="all_gather")
+                                  op="all_gather", seq=self._next_seq())
 
     def reduce_scatter(self, x) -> jax.Array:
         """(size, size*n) rows -> (size, n): rank r gets chunk r of the sum."""
@@ -191,7 +208,7 @@ class Communicator:
             return _ready_in_span(self._shmap(
                 fn, x,
                 out_specs=P(self.axis, *([None] * (jnp.ndim(x) - 1))))(x),
-                op="reduce_scatter")
+                op="reduce_scatter", seq=self._next_seq())
 
     def all_to_all(self, x) -> jax.Array:
         """Row r's chunk c goes to row c's chunk r (MPI_Alltoall)."""
@@ -200,7 +217,7 @@ class Communicator:
         )
         with metricslib.span("comm.all_to_all"):
             return _ready_in_span(self._shmap(fn, x)(x),
-                                  op="all_to_all")
+                                  op="all_to_all", seq=self._next_seq())
 
     # -- miniapp-style buffer init ---------------------------------------
 

@@ -30,6 +30,13 @@ fails on a dead chip session would train everyone to ignore it. They
 are listed as skipped; the newest round that actually measured is what
 gates.
 
+Coverage loss warns (stderr + table): when the newest round LACKS a
+gated key that a prior comparable same-headline round carried (e.g.
+``detail.serving_tok_s`` silently dropping out of a capture), that is
+a lost measurement, not a pass — value-only gating would never notice.
+The gate still exits 0 (the round may legitimately skip a subsystem),
+but the warning makes the day a key disappears visible.
+
 Usage::
 
     python -m hpc_patterns_tpu.harness.regress BENCH_r0*.json
@@ -145,7 +152,8 @@ def compare(rounds: list[dict[str, Any]],
     skipped = [r for r in rounds if not comparable(r)]
     if len(usable) < 2:
         return {"rows": [], "newest": usable[-1] if usable else None,
-                "skipped": skipped, "n_prior": max(0, len(usable) - 1)}
+                "skipped": skipped, "n_prior": max(0, len(usable) - 1),
+                "coverage_loss": []}
     newest, prior = usable[-1], usable[:-1]
     # same-backend rounds only: a CPU-fallback capture gated against
     # the TPU trajectory would always "regress" — that is a backend
@@ -161,8 +169,24 @@ def compare(rounds: list[dict[str, Any]],
             prior = [r for r in prior if r not in mismatched]
     if not prior:
         return {"rows": [], "newest": newest, "skipped": skipped,
-                "n_prior": 0}
+                "n_prior": 0, "coverage_loss": []}
     new_metrics = extract_metrics(newest)
+    # coverage-loss check: a gated key that prior comparable rounds
+    # carried but the newest lacks is NOT a pass — the capture lost a
+    # measurement (detail.serving_tok_s silently dropping out reads as
+    # green under value-only gating). Same-prefix priors only: a round
+    # that changed its headline metric is a different trajectory, not
+    # lost coverage. Warn, don't fail: the round may legitimately not
+    # exercise that subsystem, and the human owns that call.
+    lost: dict[str, int] = {}  # lost key -> last round that carried it
+    new_prefix = newest["parsed"].get("metric", "?")
+    for r in prior:
+        if r["parsed"].get("metric", "?") != new_prefix:
+            continue
+        for name, (spec, _v) in extract_metrics(r).items():
+            if spec.gated and name not in new_metrics:
+                lost[name] = max(lost.get(name, 0), r.get("n", 0))
+    coverage_loss = sorted(lost.items())
     rows: list[Row] = []
     for name, (spec, new_v) in sorted(new_metrics.items()):
         prior_vals = []
@@ -185,7 +209,7 @@ def compare(rounds: list[dict[str, Any]],
         rows.append(Row(name, best, best_n, new_v, delta, spec.gated,
                         failed))
     return {"rows": rows, "newest": newest, "skipped": skipped,
-            "n_prior": len(prior)}
+            "n_prior": len(prior), "coverage_loss": coverage_loss}
 
 
 def format_table(result: dict[str, Any], tolerance: float) -> str:
@@ -219,6 +243,13 @@ def format_table(result: dict[str, Any], tolerance: float) -> str:
             f"{row.name:<44} {row.best_prior:>12.4g} "
             f"(r{row.best_round}) {row.newest:>12.4g} "
             f"{row.delta_frac:>+7.1%}  {status}")
+    for name, last_n in result.get("coverage_loss", []):
+        lines.append("")
+        lines.append(
+            f"WARNING: coverage loss — gated key {name!r} (last "
+            f"carried by r{last_n}) is absent from "
+            f"r{newest.get('n', '?')}: the capture lost a "
+            "measurement, not passed it")
     n_fail = sum(r.failed for r in result["rows"])
     lines.append("")
     lines.append("GATE: " + (f"FAIL ({n_fail} regression(s))" if n_fail
@@ -252,6 +283,11 @@ def main(argv=None) -> int:
         return 2
     result = compare(rounds, tolerance=args.tolerance)
     print(format_table(result, args.tolerance))
+    for name, last_n in result.get("coverage_loss", []):
+        # stderr too: CI logs that only keep stderr still surface it
+        print(f"WARNING: coverage loss — gated key {name!r} absent "
+              f"from the newest round (last carried by r{last_n})",
+              file=sys.stderr)
     return 1 if any(r.failed for r in result["rows"]) else 0
 
 

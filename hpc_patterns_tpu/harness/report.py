@@ -58,6 +58,7 @@ def aggregate(records: list[dict[str, Any]]) -> dict[str, Any]:
     histograms: dict[str, Histogram] = {}
     kinds: dict[str, int] = {}
     traces: list[dict[str, Any]] = []
+    merged_traces: list[dict[str, Any]] = []
     analyses: list[dict[str, Any]] = []
     n_ok = n_bad = n_snapshots = n_layout_skipped = 0
     for rec in records:
@@ -79,6 +80,17 @@ def aggregate(records: list[dict[str, Any]]) -> dict[str, Any]:
                 "baselined": rec.get("baselined", 0),
                 "files": rec.get("files", 0),
                 "by_rule": rec.get("by_rule", {}),
+            })
+        if kind == "trace_merged":
+            # cross-rank merge verdict (harness/collect.py): the
+            # launcher's skew/straggler rollup over all rank timelines
+            merged_traces.append({
+                "n_ranks": rec.get("n_ranks", 0),
+                "n_matched": rec.get("n_matched", 0),
+                "align": rec.get("align", {}),
+                "skew": rec.get("skew", {}),
+                "stragglers": rec.get("stragglers", {}),
+                "out": rec.get("out"),
             })
         if kind == "trace":
             # flight-recorder snapshot (harness/trace.py): summarize
@@ -124,6 +136,7 @@ def aggregate(records: list[dict[str, Any]]) -> dict[str, Any]:
         "histograms": histograms,
         "kinds": kinds,
         "traces": traces,
+        "merged_traces": merged_traces,
         "analyses": analyses,
         "n_snapshots": n_snapshots,
         "n_layout_skipped": n_layout_skipped,
@@ -161,6 +174,25 @@ def format_report(agg: dict[str, Any], source: str = "") -> str:
             + f", {a['suppressed']} suppressed"
             + (f", {a['baselined']} baselined" if a["baselined"] else "")
             + f" across {a['files']} file(s) (jaxlint)")
+    for t in agg.get("merged_traces", []):
+        worst_name, worst = None, 0.0
+        for name, s in t["skew"].items():
+            if s.get("max_start_skew_s", 0.0) >= worst:
+                worst_name, worst = name, s["max_start_skew_s"]
+        strag = max(t["stragglers"].items(),
+                    key=lambda kv: kv[1].get("last", 0),
+                    default=(None, {}))
+        line = (f"trace_merged: {t['n_ranks']} rank(s), "
+                f"{t['n_matched']} collective(s) matched "
+                f"(clock align: {t['align'].get('method', '?')})")
+        if worst_name is not None:
+            line += f", max start skew {worst * 1e3:.3f} ms ({worst_name})"
+        if strag[0] is not None and strag[1].get("last"):
+            line += (f", straggler rank {strag[0]} "
+                     f"({strag[1]['last']}/{strag[1].get('of', 0)} last)")
+        if t.get("out"):
+            line += f" — timeline: {t['out']}"
+        lines.append(line)
     for t in agg.get("traces", []):
         cats = ", ".join(f"{k}={n}" for k, n in sorted(t["by_cat"].items()))
         comp = t.get("compile", {})

@@ -72,8 +72,14 @@ def measure(
     timed phases become ``<label>.warmup`` / ``<label>.timed`` spans and
     every repetition lands in the ``<label>.rep_s`` histogram — the
     per-phase attribution that separates compile-absorbing warmup from
-    the numbers a verdict consumes. Disabled (the default), this is the
-    identical code path as always: no spans, no records, no extra work.
+    the numbers a verdict consumes. With a flight recorder installed
+    (``--trace``), each timed repetition additionally lands as a
+    ``<label>`` dispatch→completion window on the device track carrying
+    its ``seq`` index: in a multi-process launch every rank times the
+    same repetitions, so the cross-rank merge (harness/collect.py) can
+    match rank A's rep k against rank B's rep k and draw the skew fan.
+    Disabled (the default), this is the identical code path as always:
+    no spans, no records, no extra work.
     """
     if repetitions < 1:
         raise ValueError("repetitions must be >= 1")
@@ -91,16 +97,23 @@ def measure(
             fn()
             times.append(time.perf_counter() - t0)
         return TimingResult(tuple(_native_identity(times)))
+    from hpc_patterns_tpu.harness import trace as tracelib
+
+    rec = tracelib.active()
     with m.span(f"{label}.warmup", repetitions=warmup):
         for _ in range(warmup):
             fn()
     hist = m.histogram(f"{label}.rep_s")
     times = []
     with m.span(f"{label}.timed", repetitions=repetitions):
-        for _ in range(repetitions):
+        for seq in range(repetitions):
+            if rec is not None:
+                t_disp = rec.mark_dispatch(label, args={"seq": seq})
             t0 = time.perf_counter()
-            fn()
+            fn()  # blocking by contract: completion, not dispatch
             dt = time.perf_counter() - t0
+            if rec is not None:
+                rec.mark_complete(label, t_disp, args={"seq": seq})
             hist.observe(dt)
             times.append(dt)
     return TimingResult(tuple(_native_identity(times)))

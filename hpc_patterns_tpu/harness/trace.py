@@ -47,7 +47,13 @@ pairs on per-thread tracks, device windows and compiles as complete
   record (apps/common.run_instrumented, under ``--trace --log``), and
   ``python -m hpc_patterns_tpu.harness.trace run.jsonl -o out.json``
   rebuilds the Chrome JSON from it; ``harness.report`` summarizes the
-  same records.
+  same records;
+- distributed: a traced child of apps/launch.py also writes the
+  snapshot to the launcher-provided ``HPCPAT_TRACE_DIR``
+  (:func:`write_rank_snapshot`; stamped with process identity, dual
+  clock anchors, and barrier sync anchors), and harness/collect.py
+  merges every rank's ring into ONE clock-aligned timeline with
+  cross-rank skew/straggler rollups — rung 4 of the ladder.
 
 Like metrics.py, this module is jax-free at import time: jax is only
 touched inside enabled-path helpers (memory sampling, the monitoring
@@ -120,6 +126,11 @@ class TraceRecorder:
         # first sample one interval after construction, not at t=0
         self._last_mem_sample = self.t0_mono
         self._lock = threading.Lock()
+        # cross-rank alignment anchors: monotonic stamps taken right
+        # after a moment all ranks agree is (near-)simultaneous — a
+        # barrier exit (apps/common.make_communicator records one).
+        # They survive ring eviction like the rollup counters.
+        self.sync_anchors: list[dict[str, Any]] = []
         # rollup counters that survive ring eviction (the snapshot's
         # summary must not shrink when old events fall off the ring)
         self.compile_count = 0
@@ -176,6 +187,18 @@ class TraceRecorder:
         ts = time.perf_counter()
         self._push("X", "device", name, t_dispatch, TID_DEVICE + track,
                    dur=ts - t_dispatch, args=args)
+
+    def mark_sync(self, name: str) -> float:
+        """Record a cross-rank sync anchor: call this immediately after
+        a global barrier returns. All ranks exit a barrier within a
+        small window (bounded by its release propagation), so their
+        anchors of the same name+index are treated as simultaneous by
+        the cross-rank merge (harness/collect.py), tightening clock
+        alignment beyond what wall-clock anchors give on hosts with
+        skewed clocks. Returns the monotonic stamp."""
+        ts = time.perf_counter()
+        self.sync_anchors.append({"name": name, "mono": ts})
+        return ts
 
     # -- compile events ----------------------------------------------------
 
@@ -279,14 +302,28 @@ class TraceRecorder:
 
     def snapshot(self) -> dict[str, Any]:
         """JSON-able recorder state — the payload of the ``kind=trace``
-        RunLog record. ``events`` is the balanced ring contents in
-        compact list form; the summary fields survive eviction."""
+        RunLog record AND of the per-rank handoff file
+        (:func:`write_rank_snapshot`). ``events`` is the balanced ring
+        contents in compact list form; the summary fields survive
+        eviction. ``clock`` carries TWO monotonic↔wall anchor pairs
+        (construction and snapshot time) so the cross-rank merge can
+        estimate each rank's clock offset and bound its drift;
+        ``process`` stamps whose timeline this is (launcher env
+        protocol first, live jax runtime second — see
+        ``topology.process_env_info``)."""
         events = self._balanced_events()
         by_cat: dict[str, int] = {}
         for ev in events:
             by_cat[ev[1]] = by_cat.get(ev[1], 0) + 1
+        process_id, num_processes, slice_id = _process_info()
         return {
-            "clock": {"wall0": self.t0_wall, "mono0": self.t0_mono},
+            "clock": {"wall0": self.t0_wall, "mono0": self.t0_mono,
+                      "wall1": time.time(),
+                      "mono1": time.perf_counter()},
+            "process": {"process_id": process_id,
+                        "num_processes": num_processes,
+                        "slice_id": slice_id},
+            "sync": [dict(a) for a in self.sync_anchors],
             "capacity": self.capacity,
             "n_events": self.n_events,
             "n_dropped": max(0, self.n_events - len(self.events)),
@@ -307,6 +344,52 @@ class TraceRecorder:
         with path.open("w") as f:
             json.dump(self.to_chrome(), f)
         return path
+
+
+def _process_info() -> tuple[int, int, int]:
+    """(process_id, num_processes, slice_id) via topology's env-first
+    resolution; (0, 1, 0) when topology/jax are unavailable — a
+    snapshot must never fail for lack of a distributed runtime."""
+    try:
+        from hpc_patterns_tpu import topology
+
+        return topology.process_env_info()
+    except Exception:  # noqa: BLE001 — telemetry stamp, best-effort
+        return 0, 1, 0
+
+
+def rank_snapshot_path(trace_dir: str | Path, process_id: int) -> Path:
+    """The per-rank handoff file for ``process_id`` under the
+    launcher-provided ``HPCPAT_TRACE_DIR`` — one JSON object per file,
+    the ``kind=trace`` snapshot verbatim. Width-padded so a shell glob
+    lists ranks in order."""
+    return Path(trace_dir) / f"rank{process_id:05d}.trace.json"
+
+
+def write_rank_snapshot(rec: TraceRecorder, trace_dir: str | Path,
+                        snapshot: dict[str, Any] | None = None
+                        ) -> Path | None:
+    """Write ``rec``'s snapshot to its per-rank file under
+    ``trace_dir`` (the ``HPCPAT_TRACE_DIR`` handoff: the launcher sets
+    the env var, every traced child writes here at exit, the launcher
+    collects and merges — harness/collect.py). Pass ``snapshot`` when
+    one was already taken for another sink (the ``--log`` record), so
+    the rank file and the log record carry the SAME events and clock
+    anchors. Returns the path, or None when the write failed (a full
+    disk must not turn a successful run into a failure; the launcher
+    reports missing rank files)."""
+    snap = dict(rec.snapshot() if snapshot is None else snapshot)
+    snap["kind"] = "trace"
+    path = rank_snapshot_path(trace_dir, snap["process"]["process_id"])
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with path.open("w") as f:
+            json.dump(snap, f)
+    except OSError as e:
+        print(f"WARNING: could not write per-rank trace {path}: {e}",
+              file=sys.stderr)
+        return None
+    return path
 
 
 def chrome_from_snapshots(snaps: list[dict[str, Any]],
@@ -593,7 +676,9 @@ def instrument_jit(fn, name: str, *, exec_memory: bool = False):
 
 def load_trace_snapshots(paths) -> list[dict[str, Any]]:
     """Every ``kind=trace`` record across the given runlog JSONL files
-    (unparseable lines skipped, same tolerance as harness.report)."""
+    (unparseable lines skipped, same tolerance as harness.report).
+    Each record is annotated with its ``_source`` path so the export
+    can keep records from different files on different pid lanes."""
     snaps = []
     for path in paths:
         with open(path) as f:
@@ -606,6 +691,7 @@ def load_trace_snapshots(paths) -> list[dict[str, Any]]:
                 except json.JSONDecodeError:
                     continue
                 if rec.get("kind") == "trace":
+                    rec.setdefault("_source", str(path))
                     snaps.append(rec)
     return snaps
 
@@ -634,16 +720,23 @@ def main(argv=None) -> int:
         return 2
     out = Path(args.out) if args.out else Path(
         args.logs[0]).with_suffix(".trace.json")
-    chrome = chrome_from_snapshots(snaps)
+    # the merge path (harness/collect.py) assigns one pid lane per
+    # source process/file with process_name metadata — records from
+    # different runlog files no longer collapse onto a single lane
+    from hpc_patterns_tpu.harness import collect as collectlib
+
+    chrome = collectlib.merge(snaps)["chrome"]
     out.parent.mkdir(parents=True, exist_ok=True)
     with out.open("w") as f:
         json.dump(chrome, f)
     n_ev = len(chrome["traceEvents"])
+    n_lanes = len({e["pid"] for e in chrome["traceEvents"]})
     n_comp = sum(s.get("compile", {}).get("count", 0) for s in snaps)
     dropped = sum(s.get("n_dropped", 0) for s in snaps)
     print(f"{out}: {n_ev} trace events from {len(snaps)} snapshot(s) "
-          f"({n_comp} compiles, {dropped} evicted by the ring) — open "
-          f"in Perfetto (ui.perfetto.dev) or chrome://tracing")
+          f"on {n_lanes} pid lane(s) ({n_comp} compiles, {dropped} "
+          f"evicted by the ring) — open in Perfetto (ui.perfetto.dev) "
+          f"or chrome://tracing")
     return 0
 
 

@@ -324,6 +324,13 @@ def cpu_worker_env(base: Mapping[str, str], n_devices: int) -> dict:
     # drop the TPU-plugin trigger so the child cannot grab the real chip
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
+    # cross-process CPU computations need a collectives backend: jaxlib
+    # builds default to none and then reject multi-process executables
+    # outright ("Multiprocess computations aren't implemented on the
+    # CPU backend"), so a worker that exists to be one rank of many
+    # must ask for gloo. setdefault: an operator's explicit choice
+    # (e.g. "mpi") wins.
+    env.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
     # override (not inherit) any existing device-count flag — e.g. the
     # test conftest's 8 — so n_devices is what it says
     flags = [
@@ -352,6 +359,52 @@ def pump_lines(prefix: str, stream, sink) -> None:
 ENV_COORDINATOR = "HPCPAT_COORDINATOR"
 ENV_NUM_PROCESSES = "HPCPAT_NUM_PROCESSES"
 ENV_PROCESS_ID = "HPCPAT_PROCESS_ID"
+# per-rank flight-recorder handoff: when the launcher sets this to a
+# directory, every traced child (--trace) writes its closing recorder
+# snapshot there as rank<id>.trace.json for the launcher to collect and
+# merge (harness/collect.py) — the distributed-trace file protocol
+ENV_TRACE_DIR = "HPCPAT_TRACE_DIR"
+
+
+def process_env_info(environ=None) -> tuple[int, int, int]:
+    """``(process_id, num_processes, slice_id)`` for THIS process, from
+    the launcher env protocol when present (the same variables
+    :func:`init_distributed_from_env` consumes, so the answer is right
+    even before jax.distributed is initialized), falling back to the
+    live jax runtime, then to the single-process identity. ``slice_id``
+    applies a process-keyed :data:`ENV_SLICE_GROUPING` override to the
+    process id (device-keyed specs don't determine a per-process slice).
+
+    This is what stamps flight-recorder snapshots with their rank
+    (harness/trace.py), so cross-rank merges know whose timeline each
+    ring is without trusting file names.
+    """
+    env = os.environ if environ is None else environ
+    pid_s = env.get(ENV_PROCESS_ID)
+    if pid_s is not None:
+        pid = int(pid_s)
+        nprocs = int(env.get(ENV_NUM_PROCESSES, 1))
+    else:
+        try:
+            pid, nprocs = jax.process_index(), jax.process_count()
+        except Exception:  # noqa: BLE001 — backends may not be up yet
+            pid, nprocs = 0, 1
+    slice_id = 0
+    spec = env.get(ENV_SLICE_GROUPING)
+    if spec:
+        kind, _, arg = spec.partition(":")
+        if kind == "process":
+            if not arg:
+                slice_id = pid
+            else:
+                try:
+                    mapping = [int(s) for s in arg.split(",")]
+                    if pid < len(mapping):
+                        slice_id = mapping[pid]
+                except ValueError:
+                    pass  # malformed spec: group_by_slice raises; a
+                    # telemetry stamp just falls back to slice 0
+    return pid, nprocs, slice_id
 
 
 def init_distributed_from_env(environ=None) -> bool:
@@ -372,6 +425,17 @@ def init_distributed_from_env(environ=None) -> bool:
     coord = env.get(ENV_COORDINATOR)
     if not coord:
         return False
+    # the launcher recipe (cpu_worker_env) requests a CPU collectives
+    # backend via env, but jax flags don't read env vars — apply it
+    # here, before the first device touch creates the CPU client (a
+    # client built with collectives=none rejects every multi-process
+    # computation outright)
+    impl = env.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION")
+    if impl:
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", impl)
+        except Exception:  # noqa: BLE001 — flag renamed/removed: let
+            pass           # the runtime surface its own error later
     try:
         return init_distributed(
             coord,

@@ -1,0 +1,241 @@
+"""Unit tests for the cross-rank trace merge (harness/collect.py).
+
+Pure-host tests over synthetic snapshots with KNOWN clock geometry:
+offset estimation must recover constructed per-rank offsets within
+tolerance (wall anchors alone, then the sync-anchor refinement undoing
+a deliberately lying wall clock), the merge must produce one pid lane
+per rank with flow events threading matched collectives, and the skew/
+straggler/busy rollups must equal the numbers the events were built
+from. No jax, no subprocesses — the multi-process end-to-end lives in
+tests/test_launch.py.
+"""
+
+import json
+
+import pytest
+
+from hpc_patterns_tpu.harness import collect
+
+
+def make_snap(rank, *, nprocs=2, boot=0.0, wall_skew=0.0, events=(),
+              sync_mono=None, source=None):
+    """A recorder snapshot for a rank whose monotonic clock started at
+    true time ``boot`` (so ``mono = true − boot``) and whose wall clock
+    is off by ``wall_skew`` seconds. Events carry LOCAL mono stamps."""
+    snap = {
+        "kind": "trace",
+        "clock": {"mono0": 0.0, "wall0": boot + wall_skew,
+                  "mono1": 100.0, "wall1": boot + 100.0 + wall_skew},
+        "process": {"process_id": rank, "num_processes": nprocs,
+                    "slice_id": 0},
+        "sync": ([] if sync_mono is None else
+                 [{"name": "make_communicator", "mono": sync_mono}]),
+        "capacity": 1024, "n_events": len(events), "n_dropped": 0,
+        "by_cat": {}, "compile": {"count": 0, "total_s": 0.0},
+        "mem": {"peak_live_bytes": 0},
+        "events": [list(e) for e in events],
+    }
+    if source is not None:
+        snap["_source"] = source
+    return snap
+
+
+def window(name, true_start, dur, *, boot, seq, tid=1 << 20):
+    """A device X slice in local mono time for a rank booted at ``boot``."""
+    return ("X", "device", name, true_start - boot, tid, dur,
+            {"seq": seq})
+
+
+class TestClockAlignment:
+    def test_wall_anchors_recover_known_offsets(self):
+        # rank 0 booted at true t=100, rank 1 at t=200: their offsets
+        # (mono -> true time) are exactly the boot instants
+        snaps = [make_snap(0, boot=100.0), make_snap(1, boot=200.0)]
+        align = collect.estimate_alignment(snaps)
+        assert align["method"] == "wall"
+        assert align["offsets"][0] == pytest.approx(100.0, abs=1e-9)
+        assert align["offsets"][1] == pytest.approx(200.0, abs=1e-9)
+
+    def test_drift_bound_from_anchor_disagreement(self):
+        snap = make_snap(0, boot=100.0)
+        snap["clock"]["wall1"] += 0.002  # clock drifted 2 ms over the run
+        _, drift = collect.wall_offset(snap)
+        assert drift == pytest.approx(0.001, abs=1e-9)
+
+    def test_sync_anchors_correct_lying_wall_clock(self):
+        # rank 1's wall clock is 0.5 s fast (NTP-scale skew). The sync
+        # anchors were taken at the SAME true instant t=250 on both
+        # ranks; refinement must pull rank 1's offset back to truth.
+        snaps = [
+            make_snap(0, boot=100.0, sync_mono=150.0),
+            make_snap(1, boot=200.0, wall_skew=0.5, sync_mono=50.0),
+        ]
+        align = collect.estimate_alignment(snaps)
+        assert align["method"] == "sync"
+        assert align["offsets"][0] == pytest.approx(100.0, abs=1e-9)
+        assert align["offsets"][1] == pytest.approx(200.0, abs=1e-9)
+        # the refinement also reports how wrong wall-only would have been
+        assert align["wall_disagreement_s"] == pytest.approx(0.5, abs=1e-9)
+
+    def test_sync_skipped_without_common_anchors(self):
+        snaps = [make_snap(0, boot=0.0, sync_mono=10.0),
+                 make_snap(1, boot=0.0)]  # rank 1 has none
+        align = collect.estimate_alignment(snaps)
+        assert align["method"] == "wall"
+
+
+class TestMerge:
+    def _two_rank_snaps(self):
+        # collective seq 0: rank 1 starts 2 ms late (start skew), and
+        # with equal durations rank 1 finishes last (the straggler);
+        # collective seq 1: aligned starts, rank 0 runs 3 ms longer
+        # (dur skew) and is the straggler.
+        name = "comm.allreduce.ring"
+        r0 = [window(name, 300.000, 0.010, boot=100.0, seq=0),
+              window(name, 301.000, 0.013, boot=100.0, seq=1)]
+        r1 = [window(name, 300.002, 0.010, boot=200.0, seq=0),
+              window(name, 301.000, 0.010, boot=200.0, seq=1)]
+        return [make_snap(0, boot=100.0, sync_mono=150.0, events=r0),
+                make_snap(1, boot=200.0, sync_mono=50.0, events=r1)]
+
+    def test_one_pid_lane_per_rank_with_names(self):
+        merged = collect.merge(self._two_rank_snaps())
+        evs = merged["chrome"]["traceEvents"]
+        pids = {e["pid"] for e in evs if e["ph"] not in ("M",)}
+        assert pids == {0, 1}
+        lanes = {e["args"]["name"] for e in evs
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert lanes == {"rank 0/2", "rank 1/2"}
+
+    def test_flow_events_thread_matched_collectives(self):
+        merged = collect.merge(self._two_rank_snaps())
+        evs = merged["chrome"]["traceEvents"]
+        flows = [e for e in evs if e["ph"] in ("s", "t", "f")]
+        # 2 matched collectives x 2 ranks = 2 chains of (s, f)
+        assert len(flows) == 4
+        by_id = {}
+        for e in flows:
+            by_id.setdefault(e["id"], []).append(e)
+        for chain in by_id.values():
+            assert [e["ph"] for e in chain] == ["s", "f"]
+            assert chain[0]["pid"] != chain[1]["pid"]  # crosses ranks
+            assert chain[0]["ts"] <= chain[1]["ts"]  # time-ordered
+            assert chain[-1]["bp"] == "e"
+
+    def test_skew_rollup_matches_construction(self):
+        rollup = collect.merge(self._two_rank_snaps())["rollup"]
+        s = rollup["skew"]["comm.allreduce.ring"]
+        assert s["n"] == 2
+        assert s["max_start_skew_s"] == pytest.approx(0.002, abs=1e-6)
+        assert s["mean_start_skew_s"] == pytest.approx(0.001, abs=1e-6)
+        assert s["max_dur_skew_s"] == pytest.approx(0.003, abs=1e-6)
+
+    def test_straggler_table(self):
+        rollup = collect.merge(self._two_rank_snaps())["rollup"]
+        # seq 0: rank 1 ends last (started late); seq 1: rank 0 (ran long)
+        assert rollup["stragglers"]["0"] == {"last": 1, "of": 2}
+        assert rollup["stragglers"]["1"] == {"last": 1, "of": 2}
+        assert rollup["n_matched"] == 2
+
+    def test_busy_bubble_fractions(self):
+        rollup = collect.merge(self._two_rank_snaps())["rollup"]
+        for r in ("0", "1"):
+            b = rollup["busy"][r]
+            assert 0.0 < b["busy_frac"] < 1.0
+            assert b["busy_frac"] + b["bubble_frac"] == pytest.approx(1.0)
+
+    def test_unmatched_single_rank_collective_counted_not_flowed(self):
+        snaps = self._two_rank_snaps()
+        snaps[0]["events"].append(list(window(
+            "comm.pingpong", 302.0, 0.001, boot=100.0, seq=0)))
+        merged = collect.merge(snaps)
+        assert merged["rollup"]["n_unmatched"] == 1
+        assert "comm.pingpong" not in merged["rollup"]["skew"]
+
+    def test_colliding_rank_ids_get_distinct_lanes(self):
+        # two unrelated single-process logs both claim rank 0: the
+        # multi-file export fix — they must not share a pid lane
+        snaps = [
+            make_snap(0, nprocs=1, boot=0.0, source="a.jsonl"),
+            make_snap(0, nprocs=1, boot=0.0, source="b.jsonl"),
+        ]
+        merged = collect.merge(snaps)
+        meta = [e for e in merged["chrome"]["traceEvents"]
+                if e["ph"] == "M" and e["name"] == "process_name"]
+        assert {e["pid"] for e in meta} == {0, 1}
+        assert {e["args"]["name"] for e in meta} == {"a.jsonl", "b.jsonl"}
+
+    def test_same_source_same_rank_share_a_lane(self):
+        snaps = [
+            make_snap(0, nprocs=1, boot=0.0, source="a.jsonl"),
+            make_snap(0, nprocs=1, boot=0.0, source="a.jsonl"),
+        ]
+        merged = collect.merge(snaps)
+        pids = {e["pid"] for e in merged["chrome"]["traceEvents"]}
+        assert pids == {0}
+
+    def test_format_rollup_names_the_straggler(self):
+        text = collect.format_rollup(
+            collect.merge(self._two_rank_snaps())["rollup"])
+        assert "allreduce.ring" in text
+        assert "straggler: rank" in text
+        assert "clock align: sync" in text
+
+
+class TestCLI:
+    def _rank_dir(self, tmp_path):
+        d = tmp_path / "ranks"
+        d.mkdir()
+        snaps = TestMerge()._two_rank_snaps()
+        for snap in snaps:
+            r = snap["process"]["process_id"]
+            (d / f"rank{r:05d}.trace.json").write_text(json.dumps(snap))
+        return d
+
+    def test_merges_rank_dir(self, tmp_path, capsys):
+        d = self._rank_dir(tmp_path)
+        out = tmp_path / "merged.json"
+        log = tmp_path / "run.jsonl"
+        assert collect.main([str(d), "-o", str(out),
+                             "--log", str(log)]) == 0
+        printed = capsys.readouterr().out
+        assert "max start skew" in printed
+        chrome = json.loads(out.read_text())  # strict JSON
+        assert {e["pid"] for e in chrome["traceEvents"]} == {0, 1}
+        recs = [json.loads(l) for l in log.read_text().splitlines()]
+        assert [r["kind"] for r in recs] == ["trace_merged"]
+        assert recs[0]["n_ranks"] == 2
+
+    def test_reads_runlog_jsonl_inputs(self, tmp_path, capsys):
+        snaps = TestMerge()._two_rank_snaps()
+        files = []
+        for snap in snaps:
+            r = snap["process"]["process_id"]
+            p = tmp_path / f"r{r}.jsonl"
+            p.write_text(json.dumps({"kind": "result"}) + "\n"
+                         + json.dumps(snap) + "\n")
+            files.append(str(p))
+        out = tmp_path / "m.json"
+        assert collect.main([*files, "-o", str(out)]) == 0
+        capsys.readouterr()
+        chrome = json.loads(out.read_text())
+        assert {e["pid"] for e in chrome["traceEvents"]} == {0, 1}
+
+    def test_no_snapshots_exits_2(self, tmp_path, capsys):
+        d = tmp_path / "empty"
+        d.mkdir()
+        assert collect.main([str(d)]) == 2
+        assert "no trace snapshots" in capsys.readouterr().err
+
+
+class TestUnionSeconds:
+    def test_overlapping_intervals_not_double_counted(self):
+        assert collect._union_seconds(
+            [(0.0, 1.0), (0.5, 1.5), (3.0, 4.0)]) == pytest.approx(2.5)
+
+    def test_contained_interval(self):
+        assert collect._union_seconds(
+            [(0.0, 2.0), (0.5, 1.0)]) == pytest.approx(2.0)
+
+    def test_empty(self):
+        assert collect._union_seconds([]) == 0.0

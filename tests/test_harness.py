@@ -34,6 +34,26 @@ def test_measure_min_of_reps():
     assert r.min_s >= 0.001
 
 
+def test_measure_records_seq_stamped_rep_windows_under_trace():
+    # with a flight recorder active, every TIMED repetition lands as a
+    # device window carrying its seq index — the per-rank spans the
+    # cross-rank merge matches (rank A's rep k vs rank B's rep k);
+    # warmup reps stay off the device track
+    from hpc_patterns_tpu.harness import metrics as metricslib
+    from hpc_patterns_tpu.harness import trace as tracelib
+
+    rec = tracelib.configure(enabled=True)
+    try:
+        measure(lambda: None, repetitions=3, warmup=2, label="unit.rep")
+        wins = [ev for ev in rec.events
+                if ev[0] == "X" and ev[1] == "device"
+                and ev[2] == "unit.rep"]
+        assert [w[6]["seq"] for w in wins] == [0, 1, 2]
+    finally:
+        tracelib.configure(enabled=False)
+        metricslib.configure(enabled=False)
+
+
 def test_timing_result_bandwidth():
     r = TimingResult((0.5, 1.0))
     assert r.bandwidth_gbps(1_000_000_000) == pytest.approx(2.0)

@@ -6,15 +6,21 @@ processes joined via jax.distributed over a local coordinator, CPU
 devices standing in for chips — cross-process collectives,
 cross-process MAX timing, and per-rank validation all run for real
 (SURVEY.md §4's hardware-free-testing gap, closed at the process
-level too)."""
+level too).
 
+Tiering: the broad app matrix stays in the slow tier (each case boots
+2 jax processes); the distributed-flight-recorder acceptance (ONE
+2-process launch) and the jax-free launcher-mechanics cases run tier-1
+— the rung-4 contract must hold without `--slow`."""
+
+import json
 import sys
 
 import pytest
 
 from hpc_patterns_tpu.apps import launch
 
-pytestmark = pytest.mark.slow  # each case boots 2 jax processes
+slow = pytest.mark.slow  # per-class: this module is no longer all-slow
 
 
 def _launch(app_args, np_=2, devices=2, slices=0):
@@ -25,6 +31,7 @@ def _launch(app_args, np_=2, devices=2, slices=0):
     ])
 
 
+@slow
 class TestLaunch:
     def test_allreduce_ring_4_ranks_2_processes(self, capsys):
         code = _launch(["hpc_patterns_tpu.apps.allreduce_app", "-p", "8",
@@ -119,6 +126,9 @@ class TestLaunch:
         out = capsys.readouterr().out
         assert code == 0, out
 
+class TestLauncherMechanics:
+    # jax-free children: tier-1 (no backend boot, sub-second cases)
+
     def test_failure_propagates(self, capsys):
         # a child that exits nonzero must fail the launch (ctest contract)
         code = launch.main([
@@ -131,3 +141,143 @@ class TestLaunch:
 
     def test_no_command_is_an_error(self, capsys):
         assert launch.main(["-np", "2"]) == 2
+        capsys.readouterr()
+
+    def test_timeout_names_hung_ranks_with_last_output(self, capsys):
+        # rank 1 exits immediately; rank 0's pid makes it hang — the
+        # timeout report must name ONLY the hung rank and quote its
+        # last printed line (what a deadlocked collective debug needs)
+        code = launch.main([
+            "-np", "2", "--timeout", "2", "--",
+            sys.executable, "-c",
+            "import os, sys, time\n"
+            "pid = int(os.environ['HPCPAT_PROCESS_ID'])\n"
+            "print(f'entering collective {pid}', flush=True)\n"
+            "time.sleep(0 if pid == 1 else 60)\n",
+        ])
+        out = capsys.readouterr().out
+        assert code == 1
+        assert "1/2 rank(s) had not exited" in out
+        assert "rank 0: last output: [0] entering collective 0" in out
+        assert "rank 1: last" not in out
+
+    def test_timeout_still_harvests_written_traces(self, tmp_path,
+                                                   capsys):
+        # a hung run is still debuggable: ranks that already handed off
+        # their snapshot merge; the hung rank is reported as missing
+        snap = {
+            "kind": "trace",
+            "clock": {"mono0": 0.0, "wall0": 0.0,
+                      "mono1": 1.0, "wall1": 1.0},
+            "process": {"process_id": 1, "num_processes": 2,
+                        "slice_id": 0},
+            "sync": [], "capacity": 8, "n_events": 0, "n_dropped": 0,
+            "by_cat": {}, "compile": {"count": 0, "total_s": 0.0},
+            "mem": {"peak_live_bytes": 0}, "events": [],
+        }
+        out = tmp_path / "merged.json"
+        code = launch.main([
+            "-np", "2", "--timeout", "3",
+            "--trace-out", str(out),
+            "--trace-dir", str(tmp_path / "ranks"),
+            "--log", str(tmp_path / "run.jsonl"), "--",
+            sys.executable, "-c",
+            "import json, os, sys, time\n"
+            "pid = int(os.environ['HPCPAT_PROCESS_ID'])\n"
+            "d = os.environ['HPCPAT_TRACE_DIR']\n"
+            f"snap = {snap!r}\n"
+            "if pid == 1:\n"
+            "    with open(os.path.join(d, 'rank00001.trace.json'), 'w') as f:\n"
+            "        json.dump(snap, f)\n"
+            "    sys.exit(0)\n"
+            "time.sleep(60)\n",
+        ])
+        printed = capsys.readouterr().out
+        assert code == 1
+        assert "timeout" in printed
+        assert "only 1/2 rank snapshot(s) harvested" in printed
+        assert out.exists()  # the partial merge still landed
+        recs = [json.loads(l)
+                for l in (tmp_path / "run.jsonl").read_text().splitlines()]
+        assert recs[-1]["kind"] == "trace_merged"
+        assert recs[-1]["n_ranks"] == 1
+
+
+class TestDistributedTraceMerge:
+    """The rung-4 acceptance, tier-1: ONE 2-process launch of the
+    allreduce miniapp under --trace must produce a Perfetto-valid
+    merged timeline with one pid lane per rank, flow events linking the
+    two ranks' windows of each timed collective, a skew/straggler
+    rollup on stdout, and a kind=trace_merged record harness.report
+    renders."""
+
+    @pytest.fixture(scope="class")
+    def merged_run(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("dtrace")
+        out, log = tmp / "merged.json", tmp / "run.jsonl"
+        import io
+        from contextlib import redirect_stdout
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            code = launch.main([
+                "-np", "2", "--cpu-devices-per-proc", "1",
+                "--trace-out", str(out), "--log", str(log), "--",
+                sys.executable, "-m",
+                "hpc_patterns_tpu.apps.allreduce_app", "-p", "8",
+                "--repetitions", "3", "--warmup", "1", "--trace",
+            ])
+        return code, out, log, buf.getvalue()
+
+    def test_exit_0_and_rollup_printed(self, merged_run):
+        code, _out, _log, printed = merged_run
+        assert code == 0, printed
+        assert "max start skew" in printed
+        assert "clock align: sync" in printed  # barrier anchor taken
+
+    def test_merged_json_is_perfetto_valid_with_2_lanes(self, merged_run):
+        code, out, _log, printed = merged_run
+        assert code == 0, printed
+        chrome = json.loads(out.read_text())  # strict JSON
+        evs = chrome["traceEvents"]
+        assert {e["pid"] for e in evs if e["ph"] != "M"} == {0, 1}
+        names = {e["args"]["name"] for e in evs
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert names == {"rank 0/2", "rank 1/2"}
+        # B/E pairs stay balanced per (pid, tid) lane after the merge
+        stacks = {}
+        for e in evs:
+            if e["ph"] == "B":
+                stacks.setdefault((e["pid"], e["tid"]), []).append(e["name"])
+            elif e["ph"] == "E":
+                assert stacks[(e["pid"], e["tid"])].pop() == e["name"]
+        assert all(not s for s in stacks.values())
+
+    def test_flow_events_link_collective_pairs(self, merged_run):
+        code, out, _log, printed = merged_run
+        assert code == 0, printed
+        evs = json.loads(out.read_text())["traceEvents"]
+        flows = [e for e in evs if e["ph"] in ("s", "t", "f")]
+        assert flows, "no flow events in merged trace"
+        by_id = {}
+        for e in flows:
+            by_id.setdefault(e["id"], []).append(e)
+        crossing = [c for c in by_id.values()
+                    if len({e["pid"] for e in c}) >= 2]
+        assert crossing, "no flow chain crosses rank lanes"
+
+    def test_trace_merged_record_and_report(self, merged_run, capsys):
+        code, _out, log, printed = merged_run
+        assert code == 0, printed
+        recs = [json.loads(l) for l in log.read_text().splitlines()]
+        merged = [r for r in recs if r["kind"] == "trace_merged"]
+        assert len(merged) == 1
+        rec = merged[0]
+        assert rec["n_ranks"] == 2 and rec["n_matched"] >= 1
+        assert rec["align"]["method"] == "sync"
+        assert "allreduce" in " ".join(rec["skew"])
+        from hpc_patterns_tpu.harness import report
+
+        assert report.main([str(log)]) == 0
+        out = capsys.readouterr().out
+        assert "trace_merged: 2 rank(s)" in out

@@ -170,6 +170,68 @@ class TestGateMechanics:
         assert regress.main(files) == 0
         capsys.readouterr()
 
+    def test_coverage_loss_warns_but_passes(self, tmp_path, capsys):
+        # r1 carried serving_tok_s; r2 silently lost the measurement:
+        # gate still exits 0 (the value didn't regress — it vanished)
+        # but the loss is named on stdout AND stderr
+        files = [self._round(tmp_path, 1, 2.0,
+                             detail={"serving_tok_s": 100.0}),
+                 self._round(tmp_path, 2, 2.0)]
+        assert regress.main(files) == 0
+        captured = capsys.readouterr()
+        assert "coverage loss" in captured.out
+        assert "serving_tok_s" in captured.out
+        assert "r1" in captured.out
+        assert "coverage loss" in captured.err
+
+    def test_no_coverage_warning_when_keys_consistent(self, tmp_path,
+                                                      capsys):
+        files = [self._round(tmp_path, 1, 2.0,
+                             detail={"serving_tok_s": 100.0}),
+                 self._round(tmp_path, 2, 2.0,
+                             detail={"serving_tok_s": 110.0})]
+        assert regress.main(files) == 0
+        captured = capsys.readouterr()
+        assert "coverage loss" not in captured.out
+        assert captured.err == ""
+
+    def test_ungated_keys_never_flag_coverage_loss(self, tmp_path,
+                                                   capsys):
+        # dma_gbps is informational (session health): its absence is
+        # not lost gate coverage
+        files = [self._round(tmp_path, 1, 2.0,
+                             detail={"dma_gbps": 500.0}),
+                 self._round(tmp_path, 2, 2.0)]
+        assert regress.main(files) == 0
+        assert "coverage loss" not in capsys.readouterr().out
+
+    def test_changed_headline_metric_is_not_coverage_loss(self, tmp_path,
+                                                          capsys):
+        # a round that switched headline metric is a different
+        # trajectory (extract_metrics already refuses to compare it),
+        # not a capture that lost keys
+        r1 = {"n": 1, "cmd": "t", "rc": 0, "tail": "",
+              "parsed": {"metric": "old_metric", "value": 2.0,
+                         "vs_baseline": 1.0,
+                         "detail": {"serving_tok_s": 100.0}}}
+        r2 = {"n": 2, "cmd": "t", "rc": 0, "tail": "",
+              "parsed": {"metric": "new_metric", "value": 2.0,
+                         "vs_baseline": 1.0, "detail": {}}}
+        files = []
+        for rec in (r1, r2):
+            p = tmp_path / f"BENCH_r{rec['n']:02d}.json"
+            p.write_text(json.dumps(rec))
+            files.append(str(p))
+        assert regress.main(files) == 0
+        assert "coverage loss" not in capsys.readouterr().out
+
+    def test_checked_in_trajectory_has_no_coverage_loss(self, capsys):
+        # the real BENCH_r0*.json history must not start warning —
+        # the serving keys are wired but no checked-in round carries
+        # them yet (ROADMAP), so nothing has been "lost"
+        assert regress.main(ROUNDS) == 0
+        assert "coverage loss" not in capsys.readouterr().out
+
     def test_unreadable_input_exits_2(self, tmp_path, capsys):
         bad = tmp_path / "nope.json"
         assert regress.main([str(bad)]) == 2

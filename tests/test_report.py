@@ -79,6 +79,38 @@ class TestReportFixture:
         assert "analysis: FINDINGS — 2 finding(s)" in out
         assert "donation-alias=2" in out and "6 suppressed" in out
 
+    def test_kind_trace_merged_record_is_surfaced(self, tmp_path,
+                                                  capsys):
+        # the launcher's cross-rank rollup (launch.py --trace-out /
+        # harness.collect --log) renders as one digest line: rank
+        # count, matched collectives, worst skew, straggler
+        path = tmp_path / "merged.jsonl"
+        path.write_text(json.dumps({
+            "kind": "trace_merged", "num_processes": 2, "ranks": [0, 1],
+            "n_ranks": 2, "n_events": 36, "n_matched": 3,
+            "n_unmatched": 0,
+            "align": {"method": "sync", "offsets_s": {},
+                      "drift_bound_s": 0.0, "wall_disagreement_s": 0.0,
+                      "residual_s": 0.0},
+            "skew": {"allreduce.ring": {"n": 3,
+                                        "max_start_skew_s": 0.000966,
+                                        "mean_start_skew_s": 0.0005,
+                                        "max_dur_skew_s": 0.0014}},
+            "stragglers": {"0": {"last": 2, "of": 3},
+                           "1": {"last": 1, "of": 3}},
+            "busy": {"0": {"busy_frac": 0.5, "bubble_frac": 0.5,
+                           "window_s": 1.0}},
+            "out": "merged.json",
+        }) + "\n")
+        rc = report.main([str(path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "trace_merged: 2 rank(s), 3 collective(s) matched" in out
+        assert "clock align: sync" in out
+        assert "max start skew 0.966 ms (allreduce.ring)" in out
+        assert "straggler rank 0 (2/3 last)" in out
+        assert "merged.json" in out
+
     def test_cli_empty_input_fails(self, tmp_path, capsys):
         path = tmp_path / "empty.jsonl"
         path.write_text("")

@@ -206,3 +206,45 @@ class TestHybridMesh:
     def test_single_slice_degenerates(self):
         mesh = topology.make_hybrid_mesh({"dp": -1}, {"tp": 8})
         assert mesh.shape == {"dp": 1, "tp": 8}
+
+
+class TestProcessEnvInfo:
+    # the flight-recorder snapshot stamp: env protocol first (right
+    # even before jax.distributed initializes), jax runtime fallback
+
+    def test_launcher_env_wins(self):
+        env = {topology.ENV_PROCESS_ID: "2",
+               topology.ENV_NUM_PROCESSES: "4"}
+        assert topology.process_env_info(env) == (2, 4, 0)
+
+    def test_slice_id_from_process_mapping(self):
+        env = {topology.ENV_PROCESS_ID: "3",
+               topology.ENV_NUM_PROCESSES: "4",
+               topology.ENV_SLICE_GROUPING: "process:0,0,1,1"}
+        assert topology.process_env_info(env) == (3, 4, 1)
+
+    def test_slice_id_process_identity(self):
+        env = {topology.ENV_PROCESS_ID: "1",
+               topology.ENV_NUM_PROCESSES: "2",
+               topology.ENV_SLICE_GROUPING: "process"}
+        assert topology.process_env_info(env) == (1, 2, 1)
+
+    def test_device_keyed_grouping_does_not_apply(self):
+        env = {topology.ENV_PROCESS_ID: "1",
+               topology.ENV_NUM_PROCESSES: "2",
+               topology.ENV_SLICE_GROUPING: "devices:4"}
+        assert topology.process_env_info(env) == (1, 2, 0)
+
+    def test_jax_fallback_single_process(self):
+        assert topology.process_env_info({}) == (0, 1, 0)
+
+
+def test_cpu_worker_env_requests_gloo_collectives():
+    # a CPU worker exists to be one rank of many: without a collectives
+    # backend the CPU client rejects every multi-process computation
+    env = topology.cpu_worker_env({}, 2)
+    assert env["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] == "gloo"
+    # an operator's explicit choice survives
+    env = topology.cpu_worker_env(
+        {"JAX_CPU_COLLECTIVES_IMPLEMENTATION": "mpi"}, 2)
+    assert env["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] == "mpi"

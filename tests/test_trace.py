@@ -153,6 +153,34 @@ class TestChromeExport:
         assert tracelib.main([str(tmp_path / "empty.jsonl")]) == 2
         capsys.readouterr()
 
+    def test_cli_multi_file_gets_distinct_pid_lanes(self, tmp_path,
+                                                    capsys):
+        # two runlogs from two (single-process) runs must NOT collapse
+        # onto one pid lane — each source file gets its own, labeled
+        from hpc_patterns_tpu.harness.runlog import RunLog
+
+        m = metricslib.configure(enabled=True)
+        files = []
+        for name in ("a.jsonl", "b.jsonl"):
+            rec = tracelib.configure(enabled=True)
+            with m.span("phase"):
+                pass
+            log = RunLog(tmp_path / name)
+            log.emit(kind="trace", **rec.snapshot())
+            files.append(str(tmp_path / name))
+        out = tmp_path / "multi.trace.json"
+        assert tracelib.main([*files, "-o", str(out)]) == 0
+        capsys.readouterr()
+        chrome = json.loads(out.read_text())
+        meta = [e for e in chrome["traceEvents"]
+                if e["ph"] == "M" and e["name"] == "process_name"]
+        assert len({e["pid"] for e in meta}) == 2
+        assert {e["args"]["name"] for e in meta} == \
+            {"a.jsonl", "b.jsonl"}
+        spans = [e for e in chrome["traceEvents"]
+                 if e.get("cat") == "span"]
+        assert len({e["pid"] for e in spans}) == 2
+
 
 class TestCompileWatcher:
     def test_forced_recompile_counted_exactly_once(self):
@@ -328,6 +356,80 @@ class TestRunInstrumented:
         kinds = [json.loads(l)["kind"]
                  for l in path.read_text().splitlines()]
         assert kinds == ["result"]
+
+
+class TestDistributedHandoff:
+    """The per-rank capture protocol (rung 4's capture half): snapshots
+    carry process identity + dual clock anchors + sync anchors, and a
+    traced child under HPCPAT_TRACE_DIR hands its ring to the launcher
+    as rank<id>.trace.json (the merge half lives in test_collect.py)."""
+
+    def test_snapshot_carries_process_and_dual_clock_anchors(self):
+        rec = TraceRecorder(capacity=8)
+        snap = rec.snapshot()
+        proc = snap["process"]
+        assert proc["process_id"] == 0 and proc["num_processes"] == 1
+        c = snap["clock"]
+        assert c["mono1"] >= c["mono0"] and c["wall1"] >= c["wall0"]
+        # the two anchor pairs agree on the offset (same clocks here)
+        assert (c["wall1"] - c["mono1"]) == pytest.approx(
+            c["wall0"] - c["mono0"], abs=0.05)
+
+    def test_snapshot_reads_launcher_env_protocol(self, monkeypatch):
+        monkeypatch.setenv("HPCPAT_PROCESS_ID", "3")
+        monkeypatch.setenv("HPCPAT_NUM_PROCESSES", "4")
+        monkeypatch.setenv("HPCPAT_SLICE_GROUPING", "process:0,0,1,1")
+        snap = TraceRecorder(capacity=8).snapshot()
+        assert snap["process"] == {"process_id": 3, "num_processes": 4,
+                                   "slice_id": 1}
+
+    def test_mark_sync_anchors_survive_eviction(self):
+        rec = TraceRecorder(capacity=2)
+        rec.mark_sync("make_communicator")
+        for i in range(10):  # overflow the ring
+            rec.span_begin(f"s{i}", {})
+            rec.span_end(f"s{i}")
+        snap = rec.snapshot()
+        assert len(snap["sync"]) == 1
+        assert snap["sync"][0]["name"] == "make_communicator"
+        assert snap["sync"][0]["mono"] <= snap["clock"]["mono1"]
+
+    def test_write_rank_snapshot(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HPCPAT_PROCESS_ID", "1")
+        monkeypatch.setenv("HPCPAT_NUM_PROCESSES", "2")
+        rec = TraceRecorder(capacity=8)
+        rec.span_begin("x", {})
+        rec.span_end("x")
+        path = tracelib.write_rank_snapshot(rec, tmp_path)
+        assert path == tmp_path / "rank00001.trace.json"
+        snap = json.loads(path.read_text())
+        assert snap["kind"] == "trace"
+        assert snap["process"]["process_id"] == 1
+        assert len(snap["events"]) == 2
+
+    def test_run_instrumented_hands_off_under_env(self, tmp_path,
+                                                  monkeypatch):
+        import argparse
+
+        from hpc_patterns_tpu.apps import common
+
+        monkeypatch.setenv("HPCPAT_TRACE_DIR", str(tmp_path))
+        args = argparse.Namespace(metrics=False, trace=True,
+                                  trace_capacity=None, log=None)
+        assert common.run_instrumented(lambda a: 0, args) == 0
+        files = list(tmp_path.glob("rank*.trace.json"))
+        assert len(files) == 1
+
+    def test_no_handoff_without_trace_flag(self, tmp_path, monkeypatch):
+        import argparse
+
+        from hpc_patterns_tpu.apps import common
+
+        monkeypatch.setenv("HPCPAT_TRACE_DIR", str(tmp_path))
+        args = argparse.Namespace(metrics=False, trace=False,
+                                  trace_capacity=None, log=None)
+        assert common.run_instrumented(lambda a: 0, args) == 0
+        assert list(tmp_path.glob("rank*.trace.json")) == []
 
 
 class TestMemorySampling:
