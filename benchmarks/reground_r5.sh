@@ -117,7 +117,11 @@ run "regress coverage-loss check (full trajectory)" \
 #    harness.report surfaces it next to the metrics/trace rollups. A
 #    dirty tree fails the sequence: donation-alias was the bug class
 #    that cost round 6 its cache, and it is cheaper to catch here than
-#    on a chip session.
+#    on a chip session. Rules self-register, so the shardlint family
+#    (collective-divergence/-order, unchecked-permutation,
+#    spec-mismatch) gates here with no script change; its runtime half
+#    is the "collective schedules consistent" verdict step 7b's merged
+#    trace now carries.
 run "jaxlint static gate" python -m hpc_patterns_tpu.analysis --ci \
   --log "${LOG%.log}_analysis.jsonl"
 echo "DONE $(date +%H:%M:%S)" | tee -a "$LOG"

@@ -1,18 +1,22 @@
 """jaxlint: static hazard analysis for the JAX patterns this repo has
 been burned by — donation aliasing, dispatch-path host syncs, per-call
-re-jits, PRNG key reuse, and tracer leaks.
+re-jits, PRNG key reuse, tracer leaks, and (the shardlint family) the
+SPMD collective-divergence class: rank-branched collective schedules,
+reordered collective paths, unchecked ppermute pair lists, and
+PartitionSpec/mesh inconsistencies.
 
 Run it over the package (CI mode exits nonzero on any unsuppressed
 finding)::
 
     python -m hpc_patterns_tpu.analysis --ci
 
-The motivating incident is PR 2's "poisoned cache": a zero-copy
+The motivating incidents: PR 2's "poisoned cache" — a zero-copy
 ``np.asarray`` host view of a buffer that a donated jit arg later
-mutated in place (``serving._dispatch_chunk``). The flight recorder
-(harness/trace.py) can show that bug only *after* it burns a chip
-session; the ``donation-alias`` rule catches it at review time. The
-recorder shows you the bubble; jaxlint stops the next one.
+mutated in place (``serving._dispatch_chunk``), caught at review time
+by ``donation-alias`` — and the reference suite's silent MPI-ring
+deadlock, where SPMD ranks disagree on which collective comes next,
+caught by ``collective-divergence``. The recorder shows you the
+bubble; jaxlint stops the next one.
 
 Public surface:
 
@@ -21,10 +25,14 @@ Public surface:
 - :func:`dispatch_critical` — no-op marker decorator: the
   ``host-sync-in-dispatch`` rule treats any function carrying it as
   dispatch-critical, in addition to the configured name list;
-- :func:`poison_donated` (hpc_patterns_tpu.analysis.runtime) — the
-  RUNTIME complement: wraps a jitted fn and clobbers donated inputs
-  after each call, so an aliasing bug the analyzer missed fails loudly
-  in tests instead of silently on a chip.
+- hpc_patterns_tpu.analysis.runtime — the RUNTIME complements:
+  :func:`~hpc_patterns_tpu.analysis.runtime.poison_donated` clobbers
+  donated inputs after each call so an aliasing bug the analyzer
+  missed fails loudly in tests, and
+  :class:`~hpc_patterns_tpu.analysis.runtime.CollectiveSchedule`
+  fingerprints every eager collective into a per-rank hash chain that
+  the cross-rank trace merge (harness/collect.py) verifies — and that
+  names which collective a hung rank is stuck in on a launch timeout.
 """
 
 from __future__ import annotations

@@ -1,4 +1,4 @@
-"""The jaxlint rule set: five hazard classes this repo has hit or is
+"""The jaxlint rule set: the hazard classes this repo has hit or is
 one typo away from.
 
 Each rule is a pure-``ast`` visitor over one module (cross-module
@@ -20,6 +20,24 @@ Catalog (docs/analysis.md has the worked examples):
                              no ``split``/``fold_in`` between
 - ``tracer-leak``          — traced intermediates assigned to
                              ``self.*``/globals inside a jitted body
+
+The **shardlint family** (PR 6) guards the SPMD divergence hazard
+class — the reference suite's silent MPI deadlock, where ranks
+disagree on which collective comes next (its runtime complement is
+the collective schedule verifier in ``analysis/runtime.py``):
+
+- ``collective-divergence``— a collective issued under rank-dependent
+                             control flow whose paths disagree on the
+                             collective sequence (branch arms, early
+                             returns, rank-sized loops)
+- ``collective-order``     — two sibling code paths issue the SAME
+                             collectives in DIFFERENT orders
+- ``unchecked-permutation``— a ppermute pair list that never flowed
+                             through ``comm.ring.check_permutation``
+- ``spec-mismatch``        — PartitionSpec literals inconsistent with
+                             the module's declared mesh axes (unknown
+                             or duplicated axis names), or a donated
+                             arg's in-sharding matching no out-sharding
 """
 
 from __future__ import annotations
@@ -577,3 +595,582 @@ class TracerLeakRule(Rule):
                                 f"inside jit-traced {fn.name!r} "
                                 f"leaks a traced intermediate",
                             )
+
+
+# ---------------------------------------------------------------------------
+# shardlint: SPMD collective-divergence rule family
+# ---------------------------------------------------------------------------
+
+# jax.lax SPMD collectives (``lax.psum`` spellings resolve through the
+# alias table to ``jax.lax.psum``)
+_LAX_COLLECTIVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+    "all_gather", "all_to_all", "psum_scatter", "pbroadcast",
+})
+# comm-layer / multihost collective entry points, matched by final
+# name whether called through an alias (``collectives.allreduce``) or
+# as a Communicator method (``comm.allreduce``): every one of these
+# must be issued by ALL ranks of its axis, in the same order — which
+# is exactly what makes them hazardous under rank-dependent control
+# flow. Final-name matching is a lint-level heuristic; the live tree
+# has no same-named non-collective methods (asserted by the CI gate
+# staying at zero findings).
+_COLLECTIVE_NAMES = frozenset({
+    "allreduce", "all_gather", "reduce_scatter", "all_to_all",
+    "pingpong", "sendrecv_ring", "broadcast", "barrier_value",
+    "ring_shift", "pairwise_exchange", "ring_allreduce",
+    "ring_allreduce_chunked", "ring_reduce_scatter", "ring_all_gather",
+    "ring_schedule", "halo_exchange", "jacobi_step",
+    "process_allgather", "sync_global_devices", "broadcast_one_to_all",
+}) | _LAX_COLLECTIVES
+
+#: final names whose call result identifies the calling rank — the
+#: taint sources for rank-dependent control flow
+_RANK_SOURCES = frozenset({"axis_index", "process_index"})
+
+
+def _collective_id(mod: ModuleInfo, call: ast.Call
+                   ) -> tuple[str, str, str] | None:
+    """(receiver, op, axis) identity of a collective call, or None.
+    ``receiver`` is the dotted prefix (``comm``, ``jax.lax``, …) so ops
+    on two DIFFERENT communicators never compare equal; ``axis`` is the
+    first string literal among the args when one is visible (the mesh
+    axis for ``lax.p*`` forms)."""
+    name = _func_name(mod, call) or ""
+    recv, _, op = name.rpartition(".")
+    if op not in _COLLECTIVE_NAMES:
+        return None
+    axis = ""
+    candidates = list(call.args) + [
+        kw.value for kw in call.keywords
+        if kw.arg in ("axis", "axis_name")]
+    for a in candidates:
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            axis = a.value
+            break
+    return (recv, op, axis)
+
+
+class _Unjudgeable(Exception):
+    """A nested branch whose arms issue DIFFERENT collective sequences:
+    the enclosing block's true sequence depends on a predicate the
+    analyzer cannot resolve, so comparisons through it must abstain —
+    flattening both arms (the naive walk) turns legitimate nested
+    algorithm switches into false positives."""
+
+
+def _collective_seq(mod: ModuleInfo, stmts
+                    ) -> list[tuple[str, str, str]] | None:
+    """Collective identities issued by a statement list, in evaluation
+    order — the canonical form the divergence/order rules compare.
+    Nested defs/lambdas are DEFERRED work, not issued here, and are
+    skipped (they are scanned in their own scope). A nested branch
+    whose arms agree contributes its sequence ONCE (whichever arm
+    runs, the same collectives issue); arms that disagree make the
+    whole block unjudgeable — returns None, and callers abstain (an
+    inner rank-dependent branch is still flagged by its own scan)."""
+    try:
+        return _seq_block(mod, stmts)
+    except _Unjudgeable:
+        return None
+
+
+def _seq_block(mod: ModuleInfo, stmts) -> list[tuple[str, str, str]]:
+    out: list[tuple[str, str, str]] = []
+    for stmt in stmts:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(stmt, ast.If):
+            _seq_expr(mod, stmt.test, out)
+            a = _seq_block(mod, stmt.body)
+            b = _seq_block(mod, stmt.orelse)
+            if a != b:
+                raise _Unjudgeable
+            out.extend(a)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            _seq_expr(mod, stmt.iter, out)
+            out.extend(_seq_block(mod, stmt.body))
+            out.extend(_seq_block(mod, stmt.orelse))
+        elif isinstance(stmt, ast.While):
+            _seq_expr(mod, stmt.test, out)
+            out.extend(_seq_block(mod, stmt.body))
+            out.extend(_seq_block(mod, stmt.orelse))
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                _seq_expr(mod, item.context_expr, out)
+            out.extend(_seq_block(mod, stmt.body))
+        elif isinstance(stmt, ast.Try):
+            out.extend(_seq_block(mod, stmt.body))
+            for h in stmt.handlers:
+                out.extend(_seq_block(mod, h.body))
+            out.extend(_seq_block(mod, stmt.orelse))
+            out.extend(_seq_block(mod, stmt.finalbody))
+        else:
+            _seq_expr(mod, stmt, out)
+    return out
+
+
+def _seq_expr(mod: ModuleInfo, node: ast.AST,
+              out: list[tuple[str, str, str]]) -> None:
+    """Collectives issued by one expression/simple statement, appended
+    in evaluation order. A conditional expression is the statement
+    branch in miniature: agreeing arms count once, disagreeing arms
+    are unjudgeable."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef, ast.Lambda)):
+        return
+    if isinstance(node, ast.IfExp):
+        _seq_expr(mod, node.test, out)
+        a: list[tuple[str, str, str]] = []
+        b: list[tuple[str, str, str]] = []
+        _seq_expr(mod, node.body, a)
+        _seq_expr(mod, node.orelse, b)
+        if a != b:
+            raise _Unjudgeable
+        out.extend(a)
+        return
+    for child in ast.iter_child_nodes(node):
+        _seq_expr(mod, child, out)
+    if isinstance(node, ast.Call):
+        cid = _collective_id(mod, node)
+        if cid is not None:
+            out.append(cid)
+
+
+def _is_rank_source(mod: ModuleInfo, node: ast.AST) -> bool:
+    if isinstance(node, ast.Call):
+        name = _func_name(mod, node) or ""
+        if name.rsplit(".", 1)[-1] in _RANK_SOURCES:
+            return True
+        # os.environ.get("HPCPAT_PROCESS_ID") — the launcher protocol
+        if (name == "os.environ.get" and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and "PROCESS_ID" in str(node.args[0].value)):
+            return True
+    if isinstance(node, ast.Subscript):
+        if (mod.resolve(node.value) == "os.environ"
+                and isinstance(node.slice, ast.Constant)
+                and "PROCESS_ID" in str(node.slice.value)):
+            return True
+    return False
+
+
+def _expr_rank_dependent(mod: ModuleInfo, expr: ast.AST,
+                         tainted: set[str]) -> bool:
+    for node in ast.walk(expr):
+        if _is_rank_source(mod, node):
+            return True
+        if (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)
+                and node.id in tainted):
+            return True
+    return False
+
+
+def _rank_tainted(mod: ModuleInfo, fn: ast.FunctionDef) -> set[str]:
+    """Names assigned (transitively) from a rank source anywhere in
+    ``fn`` — a flow-insensitive fixpoint, enough for the straight-line
+    ``me = lax.axis_index(axis); if me == 0: …`` hazard shape."""
+    tainted: set[str] = set()
+    assigns = [n for n in ast.walk(fn)
+               if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign))]
+    changed = True
+    while changed:
+        changed = False
+        for a in assigns:
+            if a.value is None:
+                continue
+            if not _expr_rank_dependent(mod, a.value, tainted):
+                continue
+            targets = a.targets if isinstance(a, ast.Assign) else [a.target]
+            for tgt in targets:
+                for sub in ast.walk(tgt):
+                    if (isinstance(sub, ast.Name)
+                            and sub.id not in tainted):
+                        tainted.add(sub.id)
+                        changed = True
+    return tainted
+
+
+def _ops(seq: list[tuple[str, str, str]]) -> str:
+    return ", ".join(op for _, op, _ in seq) if seq else "(none)"
+
+
+@register
+class CollectiveDivergenceRule(Rule):
+    """The deadlock class the reference's miniapps hand-dodge with
+    even/odd Send/Recv ordering: SPMD ranks must issue the identical
+    collective sequence, so a collective under rank-dependent control
+    flow whose paths disagree — branch arms with different sequences,
+    a rank-guarded early return skipping later collectives, a loop
+    with a rank-sized trip count — hangs the job silently (every other
+    rank waits inside a collective this rank never enters)."""
+
+    name = "collective-divergence"
+    summary = ("collective under rank-dependent control flow whose "
+               "paths disagree on the schedule")
+    hint = ("issue the same collective sequence on every rank: branch "
+            "on rank for DATA (jnp.where) or host I/O, never for "
+            "which collective comes next")
+
+    def check(self, mod: ModuleInfo, config: AnalysisConfig
+              ) -> Iterable[Finding]:
+        for fn in _functions(mod.tree):
+            tainted = _rank_tainted(mod, fn)
+            yield from self._scan(mod, fn.body, tainted)
+
+    def _scan(self, mod, stmts, tainted) -> Iterable[Finding]:
+        for idx, stmt in enumerate(stmts):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # separate scope, scanned on its own
+            if isinstance(stmt, ast.If):
+                if _expr_rank_dependent(mod, stmt.test, tainted):
+                    a = _collective_seq(mod, stmt.body)
+                    b = _collective_seq(mod, stmt.orelse)
+                    if a is None or b is None:
+                        # an arm with an unjudgeable nested branch:
+                        # abstain here — a rank-dependent inner branch
+                        # is still flagged by its own scan below
+                        pass
+                    elif a != b:
+                        yield self.finding(
+                            mod, stmt,
+                            f"rank-dependent branch issues different "
+                            f"collective sequences: if-arm [{_ops(a)}] "
+                            f"vs else-arm [{_ops(b)}] — ranks disagree "
+                            f"on which collective comes next (deadlock "
+                            f"shape)",
+                        )
+                    elif (self._returns(stmt.body)
+                            != self._returns(stmt.orelse)):
+                        trailing = _collective_seq(mod, stmts[idx + 1:])
+                        if trailing:
+                            yield self.finding(
+                                mod, stmt,
+                                f"rank-dependent early return skips "
+                                f"{len(trailing)} later collective(s) "
+                                f"([{_ops(trailing)}]) on the "
+                                f"returning ranks",
+                            )
+                yield from self._scan(mod, stmt.body, tainted)
+                yield from self._scan(mod, stmt.orelse, tainted)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                bound = (stmt.test if isinstance(stmt, ast.While)
+                         else stmt.iter)
+                if _expr_rank_dependent(mod, bound, tainted):
+                    body = _collective_seq(mod, stmt.body)
+                    if body:
+                        yield self.finding(
+                            mod, stmt,
+                            f"collective(s) [{_ops(body)}] inside a "
+                            f"loop with a rank-dependent trip count — "
+                            f"ranks issue different collective counts",
+                        )
+                yield from self._scan(mod, stmt.body, tainted)
+                yield from self._scan(mod, stmt.orelse, tainted)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                yield from self._scan(mod, stmt.body, tainted)
+            elif isinstance(stmt, ast.Try):
+                for blk in (stmt.body, stmt.orelse, stmt.finalbody):
+                    yield from self._scan(mod, blk, tainted)
+                for h in stmt.handlers:
+                    yield from self._scan(mod, h.body, tainted)
+
+    @staticmethod
+    def _returns(stmts) -> bool:
+        """Whether the block unconditionally RETURNS. ``raise`` is
+        exempt on purpose: an error path kills the job loudly rather
+        than deadlocking it quietly (the precondition-check pattern)."""
+        return bool(stmts) and isinstance(stmts[-1], ast.Return)
+
+
+@register
+class CollectiveOrderRule(Rule):
+    """Two code paths reaching the same communicator with the same ops
+    in different orders: if the branch predicate EVER disagrees across
+    ranks, rank A's first collective pairs with rank B's second — the
+    mis-ordered ``MPI_Send/Recv`` cross, one config drift away from a
+    deadlock. Unlike ``collective-divergence`` this fires on ANY
+    predicate: a reordered-but-equal op multiset has no legitimate
+    reason to exist."""
+
+    name = "collective-order"
+    summary = ("sibling code paths issue the same collectives in "
+               "different orders")
+    hint = ("normalize the order so every path reaching the "
+            "communicator issues the identical sequence")
+
+    def check(self, mod: ModuleInfo, config: AnalysisConfig
+              ) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.If):
+                continue
+            a = _collective_seq(mod, node.body)
+            b = _collective_seq(mod, node.orelse)
+            if a and b and a != b and sorted(a) == sorted(b):
+                yield self.finding(
+                    mod, node,
+                    f"if/else arms issue the same collectives in "
+                    f"different orders: [{_ops(a)}] vs [{_ops(b)}] — "
+                    f"should the predicate ever disagree across "
+                    f"ranks, the orderings cross",
+                )
+
+
+@register
+class UncheckedPermutationRule(Rule):
+    """A malformed ppermute pair list does not deadlock — XLA silently
+    zero-fills destinations with no incoming pair and drops duplicated
+    sources — which is WORSE: the job completes with wrong data.
+    ``comm.ring.check_permutation`` closes that gap; this rule makes
+    routing every pair list through it a checked invariant."""
+
+    name = "unchecked-permutation"
+    summary = ("ppermute pair list built without "
+               "ring.check_permutation")
+    hint = ("bind the pair list to a name and run "
+            "comm.ring.check_permutation(pairs, size) before the "
+            "ppermute — a malformed permutation silently drops or "
+            "duplicates data")
+
+    def check(self, mod: ModuleInfo, config: AnalysisConfig
+              ) -> Iterable[Finding]:
+        checked: dict[ast.AST | None, set[str]] = {}
+        permutes: list[ast.Call] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            base = (_func_name(mod, node) or "").rsplit(".", 1)[-1]
+            if base == "check_permutation":
+                if node.args and isinstance(node.args[0], ast.Name):
+                    checked.setdefault(self._scope(mod, node), set()).add(
+                        node.args[0].id)
+            elif base == "ppermute":
+                permutes.append(node)
+        for call in permutes:
+            perm = call.args[2] if len(call.args) >= 3 else None
+            if perm is None:
+                for kw in call.keywords:
+                    if kw.arg == "perm":
+                        perm = kw.value
+            if perm is None:
+                continue
+            if isinstance(perm, ast.Name):
+                if perm.id in checked.get(self._scope(mod, call), ()):
+                    continue
+                msg = (f"pair list {perm.id!r} reaches ppermute "
+                       f"without a check_permutation in this scope")
+            else:
+                msg = ("pair list built inline in the ppermute call — "
+                       "it can never have been check_permutation'd")
+            yield self.finding(mod, call, msg)
+
+    @staticmethod
+    def _scope(mod: ModuleInfo, node: ast.AST) -> ast.AST | None:
+        cur = mod.parents.get(node)
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cur = mod.parents.get(cur)
+        return cur
+
+
+@register
+class SpecMismatchRule(Rule):
+    """PartitionSpec literals inconsistent with the module they live
+    in. Three checks: an axis name appearing twice in one spec (jax
+    rejects it at run time — this catches it at review time); an axis
+    name absent from the mesh axes the SAME module declares (only when
+    every mesh declaration in the module is a resolvable literal — a
+    module building specs for a caller-provided mesh is never judged);
+    and a donated jit arg whose literal in-sharding matches no literal
+    out-sharding (XLA cannot alias a resharded buffer: the donation is
+    silently wasted and the input still dies)."""
+
+    name = "spec-mismatch"
+    summary = ("PartitionSpec inconsistent with the module's mesh "
+               "axes or a donated buffer's output specs")
+    hint = ("axis names in a PartitionSpec must exist on the mesh and "
+            "appear at most once; a donated input must share a spec "
+            "with some output for the buffer to alias")
+
+    def check(self, mod: ModuleInfo, config: AnalysisConfig
+              ) -> Iterable[Finding]:
+        declared = self._declared_axes(mod)
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and self._is_spec(mod, node)):
+                continue
+            axes = self._spec_axes(node)
+            seen: set[str] = set()
+            for ax in axes:
+                if ax in seen:
+                    yield self.finding(
+                        mod, node,
+                        f"axis {ax!r} appears twice in one "
+                        f"PartitionSpec — jax rejects duplicate mesh "
+                        f"axes in a spec",
+                    )
+                    break
+                seen.add(ax)
+            if declared:
+                unknown = sorted(set(axes) - declared)
+                if unknown:
+                    yield self.finding(
+                        mod, node,
+                        f"PartitionSpec axis(es) "
+                        f"{', '.join(map(repr, unknown))} not among "
+                        f"the mesh axes declared in this module "
+                        f"({', '.join(map(repr, sorted(declared)))})",
+                    )
+        yield from self._donation_specs(mod)
+
+    @staticmethod
+    def _is_spec(mod: ModuleInfo, call: ast.Call) -> bool:
+        return ((_func_name(mod, call) or "").rsplit(".", 1)[-1]
+                == "PartitionSpec")
+
+    @staticmethod
+    def _spec_axes(call: ast.Call) -> list[str]:
+        """Flattened axis-name string literals of one spec call
+        (``P(("dp", "fsdp"), None)`` shards one dim over two axes)."""
+        out: list[str] = []
+        for arg in call.args:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Constant) and isinstance(
+                        sub.value, str):
+                    out.append(sub.value)
+        return out
+
+    @staticmethod
+    def _literal_names(arg: ast.AST) -> tuple[str, ...] | None:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return (arg.value,)
+        if isinstance(arg, (ast.Tuple, ast.List)):
+            vals = []
+            for e in arg.elts:
+                if not (isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)):
+                    return None
+                vals.append(e.value)
+            return tuple(vals)
+        return None
+
+    def _declared_axes(self, mod: ModuleInfo) -> frozenset[str] | None:
+        """Mesh axis names declared by this module's ``Mesh(...)`` /
+        ``make_mesh({...})`` calls, or None when there are none or ANY
+        declaration is non-literal (open world: a generic mesh builder
+        like topology.py must not have its spec literals judged)."""
+        axes: set[str] = set()
+        found = False
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            base = (_func_name(mod, node) or "").rsplit(".", 1)[-1]
+            if base == "Mesh":
+                arg = node.args[1] if len(node.args) > 1 else None
+                for kw in node.keywords:
+                    if kw.arg == "axis_names":
+                        arg = kw.value
+                names = self._literal_names(arg) if arg is not None else None
+                if names is None:
+                    return None
+                axes.update(names)
+                found = True
+            elif base == "make_mesh":
+                if not node.args:
+                    return None
+                shape = node.args[0]
+                if not (isinstance(shape, ast.Dict) and all(
+                        isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                        for k in shape.keys)):
+                    return None
+                axes.update(k.value for k in shape.keys)
+                found = True
+        return frozenset(axes) if found else None
+
+    # -- donated-arg sharding consistency ------------------------------
+
+    def _donation_specs(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for fn in _functions(mod.tree):
+            for dec in fn.decorator_list:
+                if not (isinstance(dec, ast.Call)
+                        and _is_jit_constructor(mod, dec)):
+                    continue
+                kws = {kw.arg: kw.value for kw in dec.keywords}
+                nums = (_int_tuple(kws["donate_argnums"])
+                        if "donate_argnums" in kws else None)
+                ins, outs = kws.get("in_shardings"), kws.get("out_shardings")
+                if not nums or ins is None or outs is None:
+                    continue
+                in_specs = self._spec_list(mod, ins)
+                out_specs = self._spec_list(mod, outs)
+                if in_specs is None or out_specs is None:
+                    continue  # non-literal shardings: not judged
+                out_sigs = {sig for _, sig in out_specs}
+                for i in nums:
+                    if i >= len(in_specs):
+                        continue
+                    node, sig = in_specs[i]
+                    if sig not in out_sigs:
+                        yield self.finding(
+                            mod, node,
+                            f"donated arg {i}'s sharding matches no "
+                            f"out_sharding of {fn.name!r} — the "
+                            f"donation cannot alias (the buffer is "
+                            f"resharded; the input still dies, the "
+                            f"memory saving silently doesn't happen)",
+                        )
+
+    def _spec_list(self, mod: ModuleInfo, node: ast.AST
+                   ) -> list[tuple[ast.AST, tuple]] | None:
+        """[(anchor node, positional spec signature)] from a literal
+        tuple/list of ``P(...)``/``NamedSharding(mesh, P(...))``
+        entries (a bare call counts as a 1-tuple); None if any entry
+        is not a recognizable literal."""
+        elts = (node.elts if isinstance(node, (ast.Tuple, ast.List))
+                else [node])
+        out = []
+        for e in elts:
+            entry = self._spec_entry(mod, e)
+            if entry is None:
+                return None
+            out.append(entry)
+        return out
+
+    def _spec_entry(self, mod: ModuleInfo, node: ast.AST
+                    ) -> tuple[ast.AST, tuple] | None:
+        if not isinstance(node, ast.Call):
+            return None
+        base = (_func_name(mod, node) or "").rsplit(".", 1)[-1]
+        if base == "NamedSharding" and len(node.args) >= 2:
+            inner = node.args[1]
+            if isinstance(inner, ast.Call) and self._is_spec(mod, inner):
+                sig = self._spec_signature(inner)
+                return None if sig is None else (node, sig)
+            return None
+        if self._is_spec(mod, node):
+            sig = self._spec_signature(node)
+            return None if sig is None else (node, sig)
+        return None
+
+    @staticmethod
+    def _spec_signature(call: ast.Call) -> tuple | None:
+        """Positional (axis-or-None, ...) signature of a spec literal;
+        None when any element is not a literal."""
+        sig: list = []
+        for arg in call.args:
+            if isinstance(arg, ast.Constant) and (
+                    arg.value is None or isinstance(arg.value, str)):
+                sig.append(arg.value)
+            elif isinstance(arg, (ast.Tuple, ast.List)):
+                elems = []
+                for e in arg.elts:
+                    if not (isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)):
+                        return None
+                    elems.append(e.value)
+                sig.append(tuple(elems))
+            else:
+                return None
+        return tuple(sig)

@@ -1,39 +1,202 @@
-"""Runtime donation poisoning: make aliasing bugs fail loudly in tests.
+"""Runtime complements to the static rules: donation poisoning and the
+collective schedule verifier.
 
-The static ``donation-alias`` rule catches the shapes it can see; this
-is the belt-and-braces RUNTIME check for the ones it can't. The hazard
-(round 6's "poisoned cache"): on CPU a freshly-built executable often
-does NOT honor a donation, so a zero-copy host view of a donated input
-keeps reading stable values and the bug passes every test — until a
-cache-loaded (or TPU) executable honors the donation and mutates the
-view in place, corrupting whatever bookkeeping was built on it.
+Two helpers live here, each the belt-and-braces RUNTIME check behind a
+static rule family:
 
-:func:`poison_donated` removes the luck: it wraps a jitted function
-and, after each call completes, overwrites every donated input buffer
-that the executable did NOT alias into an output with a sentinel byte
-pattern. Any host view (or late host read) of a donated input now sees
-garbage on EVERY backend — the same observable behavior a
-donation-honoring executable produces, minus the chip session.
+**Donation poisoning** (:func:`poison_donated`, behind
+``donation-alias``). The hazard (round 6's "poisoned cache"): on CPU a
+freshly-built executable often does NOT honor a donation, so a
+zero-copy host view of a donated input keeps reading stable values and
+the bug passes every test — until a cache-loaded (or TPU) executable
+honors the donation and mutates the view in place, corrupting whatever
+bookkeeping was built on it. ``poison_donated`` removes the luck: it
+wraps a jitted function and, after each call completes, overwrites
+every donated input buffer that the executable did NOT alias into an
+output with a sentinel byte pattern. Wiring: ``tests/conftest.py``
+installs the wrappers around the serving engine's jitted entry points
+for ``tests/test_serving.py`` (always) and for the whole suite under
+``HPC_PATTERNS_POISON_DONATED=1``.
 
-Wiring: ``tests/conftest.py`` installs the wrappers around the serving
-engine's jitted entry points for ``tests/test_serving.py`` (always)
-and for the whole suite under ``HPC_PATTERNS_POISON_DONATED=1``.
+**Collective schedule verification** (:class:`CollectiveSchedule`,
+behind ``collective-divergence``/``collective-order``). The hazard is
+the reference suite's silent MPI deadlock: SPMD ranks disagreeing on
+which collective comes next hang with no error. Statically the
+shardlint rules forbid the divergence-shaped code; at runtime every
+eager ``Communicator`` collective (and every recorder-traced
+``harness.timing.measure`` repetition) is fingerprinted into a
+per-rank hash chain over ``(op, seq, shape, dtype, axis)``. The
+running digest is stamped into flight-recorder snapshots
+(``harness/trace.py``) and cross-checked at merge time
+(``harness/collect.py``): equal digests PROVE the rank schedules
+matched; on mismatch the merge names the first divergent
+``(rank, op, seq)``. Under ``apps/launch.py`` the chain additionally
+persists a tiny per-rank progress file on every record, so a TIMED-OUT
+rank's position is readable post-mortem — a hang reads as "rank 2 is
+at allreduce#17, rank 0 at sendrecv_ring#17" instead of a dead tunnel.
 
-The buffer writes go through ``unsafe_buffer_pointer`` + ctypes —
-test-harness territory, kept out of library code on purpose.
+This module is import-light on purpose (stdlib only; jax is imported
+inside the poison helpers): the schedule verifier must be usable from
+jax-free launcher children and from ``harness/trace.py``, whose
+disabled path stays jax-free at import time.
 """
 
 from __future__ import annotations
 
 import ctypes
 import functools
-
-import jax
+import hashlib
+import json
+import os
+import threading
+from collections import deque
 
 #: sentinel byte: 0xAB patterns decode to huge-magnitude garbage in
 #: every dtype we serve (int32 -1414812757, implausible floats), so a
 #: poisoned read corrupts comparisons instead of looking plausible
 SENTINEL_BYTE = 0xAB
+
+#: env names mirroring ``topology.ENV_TRACE_DIR`` / ``ENV_PROCESS_ID``
+#: — duplicated as literals so this module stays importable without
+#: jax (topology imports jax at module scope); tests assert the pair
+#: stays in sync with topology's constants.
+ENV_TRACE_DIR = "HPCPAT_TRACE_DIR"
+ENV_PROCESS_ID = "HPCPAT_PROCESS_ID"
+
+#: chain entries retained per process (the digest always covers the
+#: FULL history; the window only bounds what a snapshot can name)
+SCHEDULE_WINDOW = 4096
+
+
+# ---------------------------------------------------------------------------
+# collective schedule verifier
+# ---------------------------------------------------------------------------
+
+
+class CollectiveSchedule:
+    """Per-rank hash chain over collective fingerprints.
+
+    ``record(op, seq, ...)`` folds one fingerprint into the running
+    digest: ``digest_k = H(digest_{k-1} | op | seq | shape | dtype |
+    axis)``. Two ranks of an SPMD program that issued the identical
+    collective sequence therefore hold the identical digest — one
+    string comparison at merge time proves N whole schedules matched —
+    while the retained entry window lets a mismatch be localized to
+    the first divergent ``(op, seq)``.
+    """
+
+    def __init__(self, *, window: int = SCHEDULE_WINDOW):
+        self._lock = threading.Lock()
+        self.window = window
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.n = 0
+            self.digest = ""
+            self.entries: deque = deque(maxlen=self.window)
+
+    def record(self, op: str, seq: int, *, shape=None, dtype=None,
+               axis=None) -> dict:
+        fp = (f"{op}|{int(seq)}|{tuple(shape) if shape is not None else ()}"
+              f"|{dtype or ''}|{axis or ''}")
+        with self._lock:
+            digest = hashlib.sha256(
+                f"{self.digest}\x1f{fp}".encode()).hexdigest()[:16]
+            entry = {
+                "i": self.n, "op": str(op), "seq": int(seq),
+                "shape": list(shape) if shape is not None else None,
+                "dtype": str(dtype) if dtype is not None else None,
+                "axis": str(axis) if axis is not None else None,
+                "digest": digest,
+            }
+            self.digest = digest
+            self.entries.append(entry)
+            self.n += 1
+        return entry
+
+    @property
+    def last(self) -> dict | None:
+        return self.entries[-1] if self.entries else None
+
+    def snapshot(self) -> dict:
+        """JSON-able chain state — the ``collectives`` field of a
+        flight-recorder snapshot (``harness/trace.py``), cross-checked
+        rank-against-rank by ``harness/collect.py``."""
+        with self._lock:
+            return {
+                "n": self.n,
+                "digest": self.digest,
+                "window": self.window,
+                "entries": [dict(e) for e in self.entries],
+            }
+
+
+_schedule = CollectiveSchedule()
+
+
+def collective_schedule() -> CollectiveSchedule:
+    """The process-wide chain (one per rank in a launch)."""
+    return _schedule
+
+
+def reset_collective_schedule() -> None:
+    """Fresh chain — ``harness.trace.configure`` calls this so every
+    instrumented run's chain starts at the same genesis on every rank."""
+    _schedule.reset()
+
+
+def _progress_path(trace_dir: str, process_id: int) -> str:
+    return os.path.join(trace_dir, f"rank{process_id:05d}.sched.json")
+
+
+def record_collective(op: str, seq: int, *, shape=None, dtype=None,
+                      axis=None) -> dict:
+    """Fingerprint one collective into the process chain.
+
+    Called at ISSUE time (before the wait): ``comm/communicator.py``
+    records every eager collective, ``harness/timing.py`` every traced
+    timed repetition. Under a launcher (``HPCPAT_TRACE_DIR`` exported
+    by ``apps/launch.py --trace-out``) each record also persists the
+    chain head to ``rank<id>.sched.json`` — that write is what makes a
+    HUNG rank diagnosable: the rank never reaches its trace-snapshot
+    handoff, but the collective it is stuck in is already on disk for
+    the launcher's timeout report."""
+    entry = _schedule.record(op, seq, shape=shape, dtype=dtype, axis=axis)
+    trace_dir = os.environ.get(ENV_TRACE_DIR)
+    if trace_dir:
+        try:
+            pid = int(os.environ.get(ENV_PROCESS_ID) or 0)
+        except ValueError:
+            pid = 0
+        # payload built from THIS call's entry (not a re-read of the
+        # shared chain head): concurrent recorders each write a
+        # self-consistent (last, n, digest) triple
+        payload = {
+            "process_id": pid,
+            "n": entry["i"] + 1,
+            "digest": entry["digest"],
+            "last": {"i": entry["i"], "op": entry["op"],
+                     "seq": entry["seq"]},
+        }
+        path = _progress_path(trace_dir, pid)
+        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+        try:
+            # write-then-rename: a rank killed mid-write (the timeout
+            # path's proc.kill()) must not leave a truncated file —
+            # the straggler whose position the hang report exists to
+            # print is exactly the rank most likely to die mid-write
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # forensics are best-effort; never fail the collective
+    return entry
+
+
+# ---------------------------------------------------------------------------
+# donation poisoning
+# ---------------------------------------------------------------------------
 
 
 def _buffer_ptrs(leaf) -> list[tuple[int, int]]:
@@ -69,6 +232,8 @@ def poison_donated(fn, donate_argnums, *, sentinel: int = SENTINEL_BYTE):
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
+        import jax
+
         out = fn(*args, **kwargs)
         leaves_out = jax.tree_util.tree_leaves(out)
         for leaf in leaves_out:

@@ -39,6 +39,7 @@ observability ladder; docs/observability.md).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import shutil
 import socket
@@ -141,6 +142,24 @@ class _LastLineTee:
         self._sink.flush()
 
 
+def _read_sched_progress(trace_dir: str) -> dict[int, dict]:
+    """Per-rank collective-fingerprint progress files
+    (``rank<id>.sched.json``, written by
+    ``analysis.runtime.record_collective`` on EVERY collective): the
+    hang forensics. A rank stuck inside a collective never reaches its
+    trace-snapshot handoff, but the fingerprint of the collective it
+    entered is already on disk — so a timeout report can say which
+    collective each rank is at instead of just that it hung."""
+    out: dict[int, dict] = {}
+    for f in sorted(Path(trace_dir).glob("rank*.sched.json")):
+        try:
+            rec = json.loads(f.read_text())
+            out[int(rec["process_id"])] = rec
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+    return out
+
+
 def _harvest_traces(trace_dir: str, out: str, log: str | None,
                     nprocs: int) -> None:
     """Collect whatever per-rank trace files exist under ``trace_dir``
@@ -195,9 +214,11 @@ def run(args) -> int:
             os.makedirs(trace_dir, exist_ok=True)
             # a reused dir must not leak a previous run's ranks into
             # this merge (stale rank files would stand in for ranks
-            # that crashed before writing, silently)
-            for stale in Path(trace_dir).glob("rank*.trace.json"):
-                stale.unlink()
+            # that crashed before writing, silently) — nor a previous
+            # run's collective fingerprints into this run's hang report
+            for pattern in ("rank*.trace.json", "rank*.sched.json"):
+                for stale in Path(trace_dir).glob(pattern):
+                    stale.unlink()
         else:
             trace_dir = made_trace_dir = tempfile.mkdtemp(
                 prefix="hpcpat_trace_")
@@ -247,9 +268,23 @@ def run(args) -> int:
             proc.kill()
         print(f"FAILURE: timeout after {args.timeout}s — "
               f"{len(stuck)}/{nprocs} rank(s) had not exited:")
+        fps = _read_sched_progress(trace_dir) if trace_dir else {}
         for pid in stuck:
             last = last_lines.get(pid, "<no output>")
             print(f"  rank {pid}: last output: {last}")
+            e = fps.get(pid)
+            if e:
+                # the collective-schedule fingerprint: a hang now reads
+                # as "rank 2 is at allreduce#17, rank 0 at
+                # sendrecv_ring#17" instead of a dead tunnel
+                print(f"  rank {pid}: is at {e['last']['op']}"
+                      f"#{e['last']['seq']} ({e['n']} collective(s) "
+                      f"issued, digest {e['digest']})")
+        for pid, e in sorted(fps.items()):
+            if pid not in stuck:
+                print(f"  rank {pid} (exited): was at "
+                      f"{e['last']['op']}#{e['last']['seq']} "
+                      f"({e['n']} issued)")
     finally:
         for t in pumps:
             t.join(timeout=5)

@@ -15,6 +15,7 @@ no host staging.
 
 from __future__ import annotations
 
+import os
 from typing import Literal
 
 import jax
@@ -22,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from hpc_patterns_tpu.analysis import runtime as analysis_runtime
 from hpc_patterns_tpu.comm import collectives, ring
 from hpc_patterns_tpu.harness import metrics as metricslib
 from hpc_patterns_tpu.harness import trace as tracelib
@@ -30,7 +32,8 @@ from hpc_patterns_tpu.topology import shard_map
 Algorithm = Literal["collective", "ring", "ring_chunked"]
 
 
-def _ready_in_span(result, op: str = "collective", seq: int | None = None):
+def _ready_in_span(result, op: str = "collective", seq: int | None = None,
+                   axis: str | None = None):
     """Block before an open span exits so it measures collective
     completion, not async dispatch — the shard_map call returns an
     unready array. Only when a span actually records (metrics, trace
@@ -40,9 +43,26 @@ def _ready_in_span(result, op: str = "collective", seq: int | None = None):
     from the host time around it; ``seq`` (the per-communicator
     collective counter) rides in the slice args so the cross-rank merge
     (harness/collect.py) can match the N ranks' windows of the SAME
-    collective and measure its skew."""
+    collective and measure its skew.
+
+    Every eager collective is ALSO fingerprinted into the per-rank
+    schedule hash chain (analysis/runtime.py) before the wait —
+    whenever anything can consume the chain: a live flight recorder
+    (the chain rides trace snapshots to the cross-rank merge) or a
+    launcher-exported ``HPCPAT_TRACE_DIR`` (the per-record progress
+    file is what names which collective a hung rank is stuck in, so
+    it must engage even when the child wasn't run with ``--trace``).
+    Reading ``.shape``/``.dtype`` off the unready array does not
+    block, and with neither consumer present nothing is recorded —
+    the disabled path stays fully async and byte-identical."""
     m = metricslib.get_metrics()
     rec = tracelib.active()
+    if seq is not None and (
+            rec is not None
+            or analysis_runtime.ENV_TRACE_DIR in os.environ):
+        analysis_runtime.record_collective(
+            op, seq, shape=getattr(result, "shape", None),
+            dtype=str(getattr(result, "dtype", "")) or None, axis=axis)
     if not (m.enabled or m.mirror_traces or rec is not None):
         return result
     if rec is not None:
@@ -166,7 +186,8 @@ class Communicator:
         with metricslib.span("comm.allreduce", algorithm=algorithm):
             return _ready_in_span(
                 self._shmap(lambda local: impl(local, self.axis), x)(x),
-                op=f"allreduce.{algorithm}", seq=self._next_seq())
+                op=f"allreduce.{algorithm}", seq=self._next_seq(),
+                axis=self.axis)
 
     def jit_allreduce(self, x, algorithm: Algorithm = "collective"):
         """The compiled allreduce closure for ``x``'s shape — what a
@@ -179,7 +200,8 @@ class Communicator:
         pt2pt ping-pong config of BASELINE.json."""
         with metricslib.span("comm.pingpong"):
             return _ready_in_span(self.jit_pingpong(x)(x),
-                                  op="pingpong", seq=self._next_seq())
+                                  op="pingpong", seq=self._next_seq(),
+                                  axis=self.axis)
 
     def jit_pingpong(self, x):
         """Compiled pairwise-exchange closure (for timing loops)."""
@@ -191,7 +213,7 @@ class Communicator:
         with metricslib.span("comm.sendrecv_ring", shift=shift):
             return _ready_in_span(self._shmap(
                 lambda l: ring.ring_shift(l, self.axis, shift), x)(x),
-                op="sendrecv_ring", seq=self._next_seq())
+                op="sendrecv_ring", seq=self._next_seq(), axis=self.axis)
 
     def all_gather(self, x) -> jax.Array:
         """Every rank receives every row: (size, n) -> (size, size, n)."""
@@ -199,7 +221,8 @@ class Communicator:
         spec = P(self.axis, None, *([None] * (jnp.ndim(x) - 1)))
         with metricslib.span("comm.all_gather"):
             return _ready_in_span(self._shmap(fn, x, out_specs=spec)(x),
-                                  op="all_gather", seq=self._next_seq())
+                                  op="all_gather", seq=self._next_seq(),
+                                  axis=self.axis)
 
     def reduce_scatter(self, x) -> jax.Array:
         """(size, size*n) rows -> (size, n): rank r gets chunk r of the sum."""
@@ -208,7 +231,7 @@ class Communicator:
             return _ready_in_span(self._shmap(
                 fn, x,
                 out_specs=P(self.axis, *([None] * (jnp.ndim(x) - 1))))(x),
-                op="reduce_scatter", seq=self._next_seq())
+                op="reduce_scatter", seq=self._next_seq(), axis=self.axis)
 
     def all_to_all(self, x) -> jax.Array:
         """Row r's chunk c goes to row c's chunk r (MPI_Alltoall)."""
@@ -217,7 +240,8 @@ class Communicator:
         )
         with metricslib.span("comm.all_to_all"):
             return _ready_in_span(self._shmap(fn, x)(x),
-                                  op="all_to_all", seq=self._next_seq())
+                                  op="all_to_all", seq=self._next_seq(),
+                                  axis=self.axis)
 
     # -- miniapp-style buffer init ---------------------------------------
 

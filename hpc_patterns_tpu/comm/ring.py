@@ -115,7 +115,9 @@ def pairwise_exchange(x, axis: str):
     size = axis_size(axis)
     if size % 2:
         raise ValueError(f"pairwise_exchange needs an even axis size, got {size}")
-    return lax.ppermute(x, axis, [(i, i ^ 1) for i in range(size)])
+    perm = [(i, i ^ 1) for i in range(size)]
+    check_permutation(perm, size)
+    return lax.ppermute(x, axis, perm)
 
 
 def ring_schedule(

@@ -272,6 +272,90 @@ def _device_windows(annotated: list[dict[str, Any]]):
     return groups
 
 
+def _schedule_check(annotated: list[dict[str, Any]]) -> dict[str, Any]:
+    """Cross-rank collective schedule verification — the merge-time
+    half of the shardlint story (analysis/runtime.py records, this
+    cross-checks). Each snapshot carries its rank's hash chain over
+    ``(op, seq, shape, dtype, axis)`` fingerprints; equal final
+    digests prove the SPMD schedules matched, and on mismatch the
+    retained entry windows localize the FIRST divergent collective
+    per rank — the "rank 2 is at allreduce#17, rank 0 at
+    sendrecv_ring#17" a deadlock debug needs first.
+
+    Returns the ``schedule`` field of the trace_merged rollup:
+    ``verdict`` is ``consistent`` / ``divergent`` / ``single_rank``
+    (one chain: nothing to cross-check) / ``not_recorded``; a
+    divergent verdict carries ``first_divergence`` with the index and
+    each rank's ``(op, seq)`` there (or ``ended_at`` for a rank whose
+    chain stopped short)."""
+    chains: dict[int, dict[str, Any]] = {}
+    for snap in annotated:
+        c = snap.get("collectives")
+        if not isinstance(c, dict) or not int(c.get("n", 0) or 0):
+            continue
+        pid = snap["_pid"]
+        cur = chains.get(pid)
+        # several snapshots of one process: the longest chain is the
+        # final state (the chain only grows within a run)
+        if cur is None or int(c["n"]) > int(cur["n"]):
+            chains[pid] = c
+    if not chains:
+        return {"verdict": "not_recorded", "n_ranks_recorded": 0}
+    base = {
+        "n_ranks_recorded": len(chains),
+        "n_collectives": max(int(c["n"]) for c in chains.values()),
+    }
+    if len(chains) == 1:
+        return {"verdict": "single_rank", **base}
+    ns = {int(c["n"]) for c in chains.values()}
+    digests = {c.get("digest", "") for c in chains.values()}
+    if len(ns) == 1 and len(digests) == 1:
+        return {"verdict": "consistent", **base,
+                "digest": next(iter(digests))}
+    # localize: walk absolute indices; at the first index where the
+    # per-rank entry digests disagree (or a chain has ended), name each
+    # rank's position. Indices evicted from some chain's window are
+    # skipped (unjudgeable); chains here are far below the window in
+    # practice. Keys are merge LANES (same ids as the rollup's
+    # ``ranks``/``stragglers`` tables): ranks are guaranteed-distinct
+    # lane ids, while two unrelated single-process logs may both claim
+    # process_id 0 and must not collapse onto one report key.
+    maps: dict[int, tuple[dict[int, dict[str, Any]], int]] = {}
+    for pid, c in sorted(chains.items()):
+        maps[pid] = ({int(e["i"]): e for e in c.get("entries", [])},
+                     int(c["n"]))
+    hi = max(n for _, n in maps.values())
+    first = None
+    for i in range(hi):
+        seen: dict[int, str | None] = {}
+        evicted = False
+        for pid, (entries, n) in maps.items():
+            if i >= n:
+                seen[pid] = None  # this rank never issued collective #i
+            elif i in entries:
+                seen[pid] = entries[i]["digest"]
+            else:
+                evicted = True
+                break
+        if evicted:
+            continue
+        if len(set(seen.values())) > 1:
+            first = i
+            break
+    divergence = None
+    if first is not None:
+        ranks_at: dict[str, dict[str, Any]] = {}
+        for pid, (entries, n) in sorted(maps.items()):
+            e = entries.get(first)
+            if e is None or first >= n:
+                ranks_at[str(pid)] = {"ended_at": n}
+            else:
+                ranks_at[str(pid)] = {"op": e["op"], "seq": e["seq"]}
+        divergence = {"index": first, "ranks": ranks_at}
+    return {"verdict": "divergent", **base,
+            "first_divergence": divergence}
+
+
 def _union_seconds(intervals: list[tuple[float, float]]) -> float:
     """Total length of the union of (start, end) intervals — busy time
     must not double-count overlapped windows on different subtracks."""
@@ -442,6 +526,7 @@ def _rollup(annotated, matched, align, n_unmatched):
             "residual_s": align["residual_s"],
         },
         "skew": skew,
+        "schedule": _schedule_check(annotated),
         "stragglers": {str(r): {"last": last_counts.get(r, 0),
                                 "of": n_matched}
                        for r in ranks},
@@ -477,6 +562,30 @@ def format_rollup(rollup: dict[str, Any]) -> str:
         + f"); {rollup['n_matched']} collective(s) matched across ranks"
         + (f", {rollup['n_unmatched']} single-rank"
            if rollup["n_unmatched"] else ""))
+    sched = rollup.get("schedule") or {}
+    verdict = sched.get("verdict")
+    if verdict == "consistent":
+        lines.append(
+            f"collective schedules consistent across "
+            f"{sched['n_ranks_recorded']} rank(s): "
+            f"{sched['n_collectives']} collective(s), "
+            f"digest {sched['digest']}")
+    elif verdict == "divergent":
+        fd = sched.get("first_divergence")
+        if fd:
+            at = ", ".join(
+                (f"rank {r} is at {info['op']}#{info['seq']}"
+                 if "op" in info
+                 else f"rank {r} ended after {info['ended_at']}")
+                for r, info in sorted(fd["ranks"].items(),
+                                      key=lambda kv: int(kv[0])))
+            lines.append(
+                f"COLLECTIVE SCHEDULE DIVERGENCE at #{fd['index']}: "
+                f"{at}")
+        else:
+            lines.append(
+                "COLLECTIVE SCHEDULE DIVERGENCE (first divergent "
+                "collective evicted from every chain window)")
     if rollup["skew"]:
         lines.append("")
         lines.append(f"{'collective':<36} {'n':>4} {'max start skew':>15} "

@@ -89,6 +89,7 @@ def aggregate(records: list[dict[str, Any]]) -> dict[str, Any]:
                 "n_matched": rec.get("n_matched", 0),
                 "align": rec.get("align", {}),
                 "skew": rec.get("skew", {}),
+                "schedule": rec.get("schedule", {}),
                 "stragglers": rec.get("stragglers", {}),
                 "out": rec.get("out"),
             })
@@ -190,6 +191,16 @@ def format_report(agg: dict[str, Any], source: str = "") -> str:
         if strag[0] is not None and strag[1].get("last"):
             line += (f", straggler rank {strag[0]} "
                      f"({strag[1]['last']}/{strag[1].get('of', 0)} last)")
+        # the desync check (analysis/runtime.py chains, cross-checked
+        # by collect._schedule_check): one glance says whether the
+        # ranks PROVABLY ran the same collective program
+        sched = t.get("schedule") or {}
+        if sched.get("verdict") == "consistent":
+            line += (f", schedules consistent "
+                     f"({sched.get('n_collectives', 0)} collectives)")
+        elif sched.get("verdict") == "divergent":
+            fd = sched.get("first_divergence") or {}
+            line += f", SCHEDULE DIVERGENCE at #{fd.get('index', '?')}"
         if t.get("out"):
             line += f" — timeline: {t['out']}"
         lines.append(line)

@@ -25,6 +25,7 @@ from typing import Callable, Sequence
 
 import jax
 
+from hpc_patterns_tpu.analysis import runtime as _runtimelib
 from hpc_patterns_tpu.harness import metrics as metricslib
 
 
@@ -108,6 +109,14 @@ def measure(
     with m.span(f"{label}.timed", repetitions=repetitions):
         for seq in range(repetitions):
             if rec is not None:
+                # fingerprint the rep into the per-rank schedule hash
+                # chain (analysis/runtime.py) BEFORE dispatching: every
+                # rank times the same repetitions, so the chains match
+                # iff the rank schedules did — and a rank that hangs
+                # inside rep k has already persisted k to the launcher
+                # (the recorder-gated path keeps untraced timing loops
+                # byte-identical)
+                _runtimelib.record_collective(label, seq)
                 t_disp = rec.mark_dispatch(label, args={"seq": seq})
             t0 = time.perf_counter()
             fn()  # blocking by contract: completion, not dispatch

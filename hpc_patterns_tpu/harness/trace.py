@@ -310,12 +310,28 @@ class TraceRecorder:
         estimate each rank's clock offset and bound its drift;
         ``process`` stamps whose timeline this is (launcher env
         protocol first, live jax runtime second — see
-        ``topology.process_env_info``)."""
+        ``topology.process_env_info``); ``collectives`` carries the
+        rank's collective-schedule hash chain for the merge-time
+        desync check."""
         events = self._balanced_events()
         by_cat: dict[str, int] = {}
         for ev in events:
             by_cat[ev[1]] = by_cat.get(ev[1], 0) + 1
         process_id, num_processes, slice_id = _process_info()
+        # the collective schedule hash chain (analysis/runtime.py):
+        # every eager Communicator collective and traced timing rep
+        # fingerprinted as (op, seq, shape, dtype, axis). The merge
+        # (harness/collect.py) cross-checks the chains rank-against-
+        # rank — equal digests PROVE the SPMD schedules matched; on
+        # mismatch the first divergent (rank, op, seq) is named.
+        # analysis.runtime is import-light (stdlib only), so this
+        # costs no jax import.
+        try:
+            from hpc_patterns_tpu.analysis import runtime as _runtimelib
+
+            collectives = _runtimelib.collective_schedule().snapshot()
+        except Exception:  # noqa: BLE001 — the stamp is best-effort
+            collectives = None
         return {
             "clock": {"wall0": self.t0_wall, "mono0": self.t0_mono,
                       "wall1": time.time(),
@@ -331,6 +347,7 @@ class TraceRecorder:
             "compile": {"count": self.compile_count,
                         "total_s": self.compile_total_s},
             "mem": {"peak_live_bytes": self.peak_live_bytes},
+            "collectives": collectives,
             "events": [list(ev) for ev in events],
         }
 
@@ -473,6 +490,15 @@ def configure(*, enabled: bool = False,
     _recorder = TraceRecorder(enabled=enabled, capacity=capacity,
                               mem_interval_s=mem_interval_s)
     metricslib._trace_sink = _recorder if enabled else None
+    # fresh recorder = fresh collective schedule chain: every rank of a
+    # launch configures at app start, so the chains all start from the
+    # same genesis and index the run's collectives identically
+    try:
+        from hpc_patterns_tpu.analysis import runtime as _runtimelib
+
+        _runtimelib.reset_collective_schedule()
+    except Exception:  # noqa: BLE001
+        pass
     if enabled:
         install_monitoring_listener()
     return _recorder

@@ -1,0 +1,36 @@
+"""Known-bad: the rank-branched-collective deadlock, minimized.
+
+The reference suite's silent failure mode: SPMD ranks disagreeing on
+which collective comes next. Rank 0 enters the allreduce while every
+other rank enters the ring shift — each side waits forever for peers
+that went elsewhere, and the job hangs with no error (the mis-ordered
+``MPI_Send/Recv`` deadlock, statically visible).
+
+Lines carrying ``EXPECT: <rule>`` markers are the golden findings
+tests/test_analysis.py asserts, line-exact.
+"""
+
+import jax
+from jax import lax
+
+
+def rank_branched_deadlock(comm, x):
+    if jax.process_index() == 0:  # EXPECT: collective-divergence
+        y = comm.allreduce(x)
+    else:
+        y = comm.sendrecv_ring(x)
+    return y
+
+
+def early_return_skips(comm, x):
+    me = lax.axis_index("x")
+    if me == 0:  # EXPECT: collective-divergence
+        return x
+    return comm.allreduce(x)
+
+
+def loop_count_diverges(comm, x):
+    r = jax.process_index()
+    for _ in range(r):  # EXPECT: collective-divergence
+        x = comm.sendrecv_ring(x)
+    return x

@@ -1,0 +1,29 @@
+"""Known-bad: PartitionSpec literals inconsistent with the module.
+
+Three shapes: an axis name the module's own mesh never declared (a
+typo jax only rejects when the spec finally meets the mesh — often on
+the chip); one axis named twice in a single spec (jax rejects it at
+run time); and a donated jit arg whose in-sharding matches no
+out-sharding (XLA cannot alias a resharded buffer: the input still
+dies, the memory saving silently doesn't happen)."""
+
+from functools import partial
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def build(devs):
+    mesh = Mesh(np.array(devs).reshape(2, 4), ("dp", "tp"))
+    batch = NamedSharding(mesh, P("dp", None))
+    typo = NamedSharding(mesh, P("pp", None))  # EXPECT: spec-mismatch
+    doubled = NamedSharding(mesh, P("dp", "dp"))  # EXPECT: spec-mismatch
+    return batch, typo, doubled
+
+
+@partial(jax.jit, donate_argnums=(0,),
+         in_shardings=(P("dp", None),),  # EXPECT: spec-mismatch
+         out_shardings=(P("tp", None),))
+def resharding_donation(x):
+    return x * 2

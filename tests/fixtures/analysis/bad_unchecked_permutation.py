@@ -1,0 +1,15 @@
+"""Known-bad: ppermute pair lists that never flowed through
+``comm.ring.check_permutation``. A malformed permutation does not
+deadlock — XLA silently zero-fills destinations with no incoming pair
+and drops duplicated sources — so the job completes with wrong data."""
+
+from jax import lax
+
+
+def rotate_unchecked(x, size):
+    pairs = [(i, (i + 2) % size) for i in range(size)]
+    return lax.ppermute(x, "x", pairs)  # EXPECT: unchecked-permutation
+
+
+def inline_pairs(x, size):
+    return lax.ppermute(x, "x", [(i, i ^ 1) for i in range(size)])  # EXPECT: unchecked-permutation

@@ -1,0 +1,25 @@
+"""Known-clean: specs consistent with the module's declared mesh axes,
+multi-axis dims as tuples, and a donation whose in/out shardings
+match (the buffer can alias). Variable axis names are never judged —
+a module building specs for a caller-provided mesh stays silent."""
+
+from functools import partial
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def build(devs, cfg):
+    mesh = Mesh(np.array(devs).reshape(2, 4), ("dp", "tp"))
+    batch = NamedSharding(mesh, P("dp", None))
+    fused = NamedSharding(mesh, P(("dp", "tp"), None))
+    by_cfg = NamedSharding(mesh, P(cfg.axis, None))  # variable: unjudged
+    return batch, fused, by_cfg
+
+
+@partial(jax.jit, donate_argnums=(0,),
+         in_shardings=(P("dp", None),),
+         out_shardings=(P("dp", None), P("tp", None)))
+def aliasable_donation(x):
+    return x * 2, x.sum(axis=0, keepdims=True)

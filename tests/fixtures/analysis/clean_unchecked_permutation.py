@@ -1,0 +1,19 @@
+"""Known-clean: every pair list is bound to a name and sanitized by
+``check_permutation`` before reaching ``ppermute`` (the
+``comm.ring.ring_shift`` discipline), positional and keyword forms."""
+
+from jax import lax
+
+from hpc_patterns_tpu.comm.ring import check_permutation
+
+
+def rotate_checked(x, size):
+    pairs = [(i, (i + 2) % size) for i in range(size)]
+    check_permutation(pairs, size)
+    return lax.ppermute(x, "x", pairs)
+
+
+def keyword_form(x, size):
+    pairs = [(i, i ^ 1) for i in range(size)]
+    check_permutation(pairs, size)
+    return lax.ppermute(x, "x", perm=pairs)

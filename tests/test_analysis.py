@@ -65,13 +65,25 @@ class TestGoldenFixtures:
                 f"{key}: expected {expected[key]}, got {actual[key]}")
 
     def test_every_rule_demonstrated_by_a_caught_fixture(self):
-        # the acceptance criterion: all five hazard rules fire on the
-        # corpus, including the minimized PR 2 donation-alias replica
+        # the acceptance criterion: every hazard rule fires on the
+        # corpus — the minimized PR 2 donation-alias replica AND the
+        # minimized rank-branched-collective deadlock replica included
         caught = {r for rules in _actual_findings().values()
                   for r in rules}
         assert {"donation-alias", "host-sync-in-dispatch",
                 "recompile-hazard", "prng-key-reuse",
-                "tracer-leak"} <= caught
+                "tracer-leak", "collective-divergence",
+                "collective-order", "unchecked-permutation",
+                "spec-mismatch"} <= caught
+
+    def test_rank_branched_deadlock_replica_is_caught_at_the_branch(self):
+        live, _ = core.analyze_file(
+            FIXTURES / "bad_collective_divergence.py")
+        div = [f for f in live if f.rule == "collective-divergence"]
+        assert len(div) == 3  # branch, early return, rank-sized loop
+        src = (FIXTURES / "bad_collective_divergence.py").read_text()
+        flagged = src.splitlines()[div[0].line - 1]
+        assert "process_index" in flagged  # anchored at the branch
 
     def test_pr2_reproducer_is_caught_at_the_view_line(self):
         live, _ = core.analyze_file(
@@ -222,7 +234,9 @@ class TestCLI:
         out = capsys.readouterr().out
         for rule in ("donation-alias", "host-sync-in-dispatch",
                      "recompile-hazard", "prng-key-reuse",
-                     "tracer-leak"):
+                     "tracer-leak", "collective-divergence",
+                     "collective-order", "unchecked-permutation",
+                     "spec-mismatch"):
             assert rule in out
 
 
@@ -337,6 +351,248 @@ class TestPoisonDonated:
             uninstall()
         for n in runtime.SERVING_POISON_TARGETS:
             assert getattr(serving, n) is before[n]
+
+
+class TestShardlintRules:
+    """Engine-level behaviors of the collective-divergence rule family
+    that the fixture corpus doesn't pin line-exact."""
+
+    def _live(self, src, tmp_path):
+        f = tmp_path / "m.py"
+        f.write_text(src)
+        live, _ = core.analyze_file(f)
+        return live
+
+    def test_taint_flows_through_assignment_chains(self, tmp_path):
+        live = self._live(
+            "from jax import lax\n"
+            "def f(comm, x):\n"
+            "    me = lax.axis_index('x')\n"
+            "    is_root = me == 0\n"
+            "    if is_root:\n"
+            "        return comm.allreduce(x)\n"
+            "    return comm.sendrecv_ring(x)\n",
+            tmp_path)
+        assert [x.rule for x in live] == ["collective-divergence"]
+
+    def test_launcher_env_rank_read_is_a_rank_source(self, tmp_path):
+        live = self._live(
+            "import os\n"
+            "def f(comm, x):\n"
+            "    if int(os.environ['HPCPAT_PROCESS_ID']) == 0:\n"
+            "        comm.allreduce(x)\n",
+            tmp_path)
+        assert [x.rule for x in live] == ["collective-divergence"]
+
+    def test_rank_guarded_raise_is_exempt(self, tmp_path):
+        # precondition checks kill the job loudly; they are not the
+        # quiet-deadlock shape the rule hunts
+        live = self._live(
+            "import jax\n"
+            "def f(comm, x, size):\n"
+            "    if jax.process_index() >= size:\n"
+            "        raise ValueError('rank out of range')\n"
+            "    return comm.allreduce(x)\n",
+            tmp_path)
+        assert not live
+
+    def test_nested_uniform_branch_counts_once_not_twice(self, tmp_path):
+        # a data-dependent inner branch whose arms issue the SAME
+        # collective must not flatten to [allreduce, allreduce] and
+        # fake a divergence against the else-arm's single allreduce
+        live = self._live(
+            "import jax\n"
+            "def f(comm, x, c):\n"
+            "    if jax.process_index() == 0:\n"
+            "        if c:\n"
+            "            y = comm.allreduce(x)\n"
+            "        else:\n"
+            "            y = comm.allreduce(-x)\n"
+            "    else:\n"
+            "        y = comm.allreduce(x * 2)\n"
+            "    return y\n",
+            tmp_path)
+        assert not live
+
+    def test_unjudgeable_nested_branch_abstains(self, tmp_path):
+        # an inner UNIFORM branch whose arms genuinely differ (an
+        # algorithm switch) makes the outer comparison unjudgeable:
+        # abstain rather than guess — and rather than false-positive
+        live = self._live(
+            "import jax\n"
+            "def f(comm, x, use_ring):\n"
+            "    if jax.process_index() == 0:\n"
+            "        if use_ring:\n"
+            "            y = comm.sendrecv_ring(x)\n"
+            "        else:\n"
+            "            y = comm.all_gather(x)\n"
+            "    else:\n"
+            "        y = comm.allreduce(x)\n"
+            "    return y\n",
+            tmp_path)
+        assert not live
+
+    def test_order_rule_needs_same_multiset(self, tmp_path):
+        # different op SETS across arms is an algorithm switch, not a
+        # reordering — neither order nor divergence (uniform predicate)
+        live = self._live(
+            "def f(comm, x, fast):\n"
+            "    if fast:\n"
+            "        return comm.allreduce(x)\n"
+            "    return comm.reduce_scatter(x)\n",
+            tmp_path)
+        assert not live
+
+    def test_spec_checks_skip_open_world_modules(self, tmp_path):
+        # a module building meshes from caller-provided axis names can
+        # never have its spec literals judged (topology.py's shape)
+        live = self._live(
+            "from jax.sharding import Mesh, PartitionSpec as P\n"
+            "def f(devs, names):\n"
+            "    mesh = Mesh(devs, names)\n"
+            "    return P('anything', None)\n",
+            tmp_path)
+        assert not live
+
+    def test_ppermute_check_in_another_scope_does_not_count(self, tmp_path):
+        live = self._live(
+            "from jax import lax\n"
+            "from hpc_patterns_tpu.comm.ring import check_permutation\n"
+            "def checker(pairs, size):\n"
+            "    check_permutation(pairs, size)\n"
+            "def f(x, pairs):\n"
+            "    return lax.ppermute(x, 'x', pairs)\n",
+            tmp_path)
+        assert [x.rule for x in live] == ["unchecked-permutation"]
+
+
+class TestCollectiveSchedule:
+    """The runtime verifier's hash chain: equality means equal
+    schedules, any fingerprint field divergence changes the digest,
+    and the launcher progress-file protocol works without jax."""
+
+    def test_identical_records_identical_digests(self):
+        a, b = runtime.CollectiveSchedule(), runtime.CollectiveSchedule()
+        for s in (a, b):
+            s.record("allreduce.ring", 0, shape=(2, 8),
+                     dtype="float32", axis="x")
+            s.record("sendrecv_ring", 1, shape=(2, 8),
+                     dtype="float32", axis="x")
+        assert a.digest == b.digest
+        assert a.n == b.n == 2
+        assert a.last["op"] == "sendrecv_ring"
+
+    def test_every_fingerprint_field_feeds_the_digest(self):
+        base = dict(shape=(2, 8), dtype="float32", axis="x")
+        digests = set()
+        for op, seq, kw in [
+            ("allreduce.ring", 0, base),
+            ("sendrecv_ring", 0, base),                  # op differs
+            ("allreduce.ring", 1, base),                 # seq differs
+            ("allreduce.ring", 0, {**base, "shape": (2, 16)}),
+            ("allreduce.ring", 0, {**base, "dtype": "int32"}),
+            ("allreduce.ring", 0, {**base, "axis": "y"}),
+        ]:
+            s = runtime.CollectiveSchedule()
+            s.record(op, seq, **kw)
+            digests.add(s.digest)
+        assert len(digests) == 6
+
+    def test_window_bounds_entries_not_the_digest(self):
+        s = runtime.CollectiveSchedule(window=4)
+        for i in range(10):
+            s.record("op", i)
+        assert s.n == 10
+        assert len(s.entries) == 4
+        assert s.entries[0]["i"] == 6  # absolute indices survive
+        full = runtime.CollectiveSchedule()
+        for i in range(10):
+            full.record("op", i)
+        assert s.digest == full.digest  # digest covers full history
+
+    def test_progress_file_written_under_launcher_env(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv(runtime.ENV_TRACE_DIR, str(tmp_path))
+        monkeypatch.setenv(runtime.ENV_PROCESS_ID, "3")
+        runtime.reset_collective_schedule()
+        try:
+            runtime.record_collective("allreduce.ring", 7,
+                                      shape=(2, 8), dtype="float32",
+                                      axis="x")
+            rec = json.loads(
+                (tmp_path / "rank00003.sched.json").read_text())
+            assert rec["process_id"] == 3 and rec["n"] == 1
+            assert rec["last"] == {"i": 0, "op": "allreduce.ring",
+                                   "seq": 7}
+            assert rec["digest"]
+        finally:
+            runtime.reset_collective_schedule()
+
+    def test_env_names_mirror_topology_constants(self):
+        # runtime duplicates the literals to stay importable without
+        # jax; the pair must never drift from the launcher protocol
+        from hpc_patterns_tpu import topology
+
+        assert runtime.ENV_TRACE_DIR == topology.ENV_TRACE_DIR
+        assert runtime.ENV_PROCESS_ID == topology.ENV_PROCESS_ID
+
+    def test_eager_communicator_collectives_are_fingerprinted(
+            self, mesh8):
+        from hpc_patterns_tpu.comm.communicator import Communicator
+        from hpc_patterns_tpu.harness import trace as tracelib
+
+        # recording engages only when something can consume the chain
+        # (a live recorder, or a launcher trace dir) — configure()
+        # also resets the chain to genesis
+        tracelib.configure(enabled=True)
+        try:
+            comm = Communicator(mesh8, "x")
+            x = comm.rank_filled(8)
+            comm.allreduce(x)
+            comm.sendrecv_ring(x)
+            sched = runtime.collective_schedule()
+            assert [e["op"] for e in sched.entries] == [
+                "allreduce.collective", "sendrecv_ring"]
+            e = sched.entries[0]
+            assert e["seq"] == 0 and e["axis"] == "x"
+            assert e["shape"] == [8, 8]
+            assert e["dtype"] == "float32"
+        finally:
+            tracelib.configure(enabled=False)
+
+    def test_untraced_eager_collectives_stay_unrecorded(self, mesh8,
+                                                        monkeypatch):
+        # the disabled-path contract: no recorder, no launcher trace
+        # dir -> no lock, no hash, no entry (byte-identical hot path)
+        from hpc_patterns_tpu.comm.communicator import Communicator
+        from hpc_patterns_tpu.harness import trace as tracelib
+
+        monkeypatch.delenv(runtime.ENV_TRACE_DIR, raising=False)
+        tracelib.configure(enabled=False)
+        comm = Communicator(mesh8, "x")
+        comm.allreduce(comm.rank_filled(4))
+        assert runtime.collective_schedule().n == 0
+
+    def test_trace_snapshot_stamps_the_chain(self):
+        from hpc_patterns_tpu.harness import trace as tracelib
+
+        runtime.reset_collective_schedule()
+        try:
+            runtime.record_collective("allreduce.ring", 0)
+            snap = tracelib.TraceRecorder(enabled=True).snapshot()
+            assert snap["collectives"]["n"] == 1
+            assert snap["collectives"]["digest"]
+            assert snap["collectives"]["entries"][0]["op"] == \
+                "allreduce.ring"
+        finally:
+            runtime.reset_collective_schedule()
+
+    def test_trace_configure_resets_the_chain(self):
+        from hpc_patterns_tpu.harness import trace as tracelib
+
+        runtime.record_collective("anything", 0)
+        tracelib.configure(enabled=False)
+        assert runtime.collective_schedule().n == 0
 
 
 class TestMarker:

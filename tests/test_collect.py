@@ -239,3 +239,98 @@ class TestUnionSeconds:
 
     def test_empty(self):
         assert collect._union_seconds([]) == 0.0
+
+
+def make_chain(ops):
+    """``collectives`` snapshot field built by the REAL runtime chain
+    (analysis/runtime.py), so these tests pin the same hashing the
+    launched ranks use."""
+    from hpc_patterns_tpu.analysis.runtime import CollectiveSchedule
+
+    s = CollectiveSchedule()
+    for op, seq in ops:
+        s.record(op, seq, shape=(2, 8), dtype="float32", axis="x")
+    return s.snapshot()
+
+
+class TestScheduleCheck:
+    """Merge-time collective schedule verification: equal chains prove
+    the SPMD schedules matched; a mismatch names the first divergent
+    (rank, op, seq) — the deadlock-debug headline."""
+
+    def _snaps(self, ops0, ops1):
+        s0 = make_snap(0, boot=100.0)
+        s0["collectives"] = make_chain(ops0)
+        s1 = make_snap(1, boot=200.0)
+        s1["collectives"] = make_chain(ops1)
+        return [s0, s1]
+
+    def test_equal_chains_verdict_consistent(self):
+        ops = [("allreduce.ring", i) for i in range(5)]
+        rollup = collect.merge(self._snaps(ops, ops))["rollup"]
+        sched = rollup["schedule"]
+        assert sched["verdict"] == "consistent"
+        assert sched["n_collectives"] == 5
+        assert sched["n_ranks_recorded"] == 2
+        assert sched["digest"]
+        text = collect.format_rollup(rollup)
+        assert "collective schedules consistent across 2 rank(s)" in text
+        assert sched["digest"] in text
+
+    def test_divergence_names_first_divergent_op_seq(self):
+        shared = ("allreduce.ring", 0)
+        ops0 = [shared, ("allreduce.ring", 1), ("allreduce.ring", 2)]
+        ops1 = [shared, ("sendrecv_ring", 1), ("allreduce.ring", 2)]
+        rollup = collect.merge(self._snaps(ops0, ops1))["rollup"]
+        sched = rollup["schedule"]
+        assert sched["verdict"] == "divergent"
+        fd = sched["first_divergence"]
+        assert fd["index"] == 1
+        assert fd["ranks"]["0"] == {"op": "allreduce.ring", "seq": 1}
+        assert fd["ranks"]["1"] == {"op": "sendrecv_ring", "seq": 1}
+        text = collect.format_rollup(rollup)
+        assert "COLLECTIVE SCHEDULE DIVERGENCE at #1" in text
+        assert "rank 0 is at allreduce.ring#1" in text
+        assert "rank 1 is at sendrecv_ring#1" in text
+
+    def test_short_chain_reported_as_ended(self):
+        # rank 1 stopped issuing collectives one step early (the hang /
+        # early-exit shape): the divergence point is the first
+        # collective it never issued
+        ops0 = [("allreduce.ring", 0), ("allreduce.ring", 1)]
+        ops1 = [("allreduce.ring", 0)]
+        sched = collect.merge(
+            self._snaps(ops0, ops1))["rollup"]["schedule"]
+        assert sched["verdict"] == "divergent"
+        fd = sched["first_divergence"]
+        assert fd["index"] == 1
+        assert fd["ranks"]["0"] == {"op": "allreduce.ring", "seq": 1}
+        assert fd["ranks"]["1"] == {"ended_at": 1}
+
+    def test_shape_divergence_caught_by_fingerprint(self):
+        # same op/seq stream, different SHAPE on rank 1 — invisible to
+        # op-name matching, caught because shape feeds the hash
+        from hpc_patterns_tpu.analysis.runtime import CollectiveSchedule
+
+        s0, s1 = make_snap(0, boot=0.0), make_snap(1, boot=0.0)
+        a = CollectiveSchedule()
+        a.record("allreduce.ring", 0, shape=(2, 8), dtype="f32", axis="x")
+        b = CollectiveSchedule()
+        b.record("allreduce.ring", 0, shape=(2, 16), dtype="f32", axis="x")
+        s0["collectives"], s1["collectives"] = a.snapshot(), b.snapshot()
+        sched = collect.merge([s0, s1])["rollup"]["schedule"]
+        assert sched["verdict"] == "divergent"
+        assert sched["first_divergence"]["index"] == 0
+
+    def test_no_chains_reads_not_recorded(self):
+        rollup = collect.merge(
+            [make_snap(0, boot=0.0), make_snap(1, boot=0.0)])["rollup"]
+        assert rollup["schedule"]["verdict"] == "not_recorded"
+        assert "SCHEDULE" not in collect.format_rollup(rollup)
+
+    def test_one_chain_reads_single_rank(self):
+        s0, s1 = make_snap(0, boot=0.0), make_snap(1, boot=0.0)
+        s0["collectives"] = make_chain([("allreduce.ring", 0)])
+        sched = collect.merge([s0, s1])["rollup"]["schedule"]
+        assert sched["verdict"] == "single_rank"
+        assert sched["n_ranks_recorded"] == 1

@@ -203,6 +203,82 @@ class TestLauncherMechanics:
         assert recs[-1]["n_ranks"] == 1
 
 
+class TestCollectiveScheduleLaunch:
+    """The desync check, divergent side (tier-1): a deliberately
+    divergent worker pair must be named with the exact first-divergent
+    (rank, op, seq) at merge time, and a hung worker's last fingerprint
+    must surface in the timeout report. The workers drive the REAL
+    per-rank recording path (analysis/runtime.py + the trace handoff)
+    without booting a jax mesh, so both cases stay tier-1 fast."""
+
+    def test_divergent_worker_named_with_first_divergent_op_seq(
+            self, tmp_path, capsys):
+        out, log = tmp_path / "merged.json", tmp_path / "run.jsonl"
+        worker = (
+            "import os\n"
+            "from hpc_patterns_tpu.analysis import runtime as rt\n"
+            "from hpc_patterns_tpu.harness import trace\n"
+            "pid = int(os.environ['HPCPAT_PROCESS_ID'])\n"
+            "rec = trace.TraceRecorder(enabled=True)\n"
+            "rt.reset_collective_schedule()\n"
+            "kw = dict(shape=(2, 8), dtype='float32', axis='x')\n"
+            "rt.record_collective('allreduce.collective', 0, **kw)\n"
+            "if pid == 0:\n"
+            "    rt.record_collective('allreduce.collective', 1, **kw)\n"
+            "else:\n"
+            "    rt.record_collective('sendrecv_ring', 1, **kw)\n"
+            "trace.write_rank_snapshot(rec, os.environ['HPCPAT_TRACE_DIR'])\n"
+        )
+        code = launch.main([
+            "-np", "2", "--trace-out", str(out), "--log", str(log),
+            "--", sys.executable, "-c", worker,
+        ])
+        printed = capsys.readouterr().out
+        assert code == 0, printed
+        assert "COLLECTIVE SCHEDULE DIVERGENCE at #1" in printed
+        assert "rank 0 is at allreduce.collective#1" in printed
+        assert "rank 1 is at sendrecv_ring#1" in printed
+        recs = [json.loads(l) for l in log.read_text().splitlines()]
+        sched = [r for r in recs
+                 if r["kind"] == "trace_merged"][0]["schedule"]
+        assert sched["verdict"] == "divergent"
+        fd = sched["first_divergence"]
+        assert fd["index"] == 1
+        assert fd["ranks"]["0"] == {"op": "allreduce.collective",
+                                    "seq": 1}
+        assert fd["ranks"]["1"] == {"op": "sendrecv_ring", "seq": 1}
+
+    def test_timeout_prints_each_ranks_last_fingerprint(
+            self, tmp_path, capsys):
+        # rank 0 hangs INSIDE its second collective (never reaches the
+        # trace handoff); the per-record progress file is what lets the
+        # timeout report say WHICH collective it is stuck at — the
+        # "rank 0 is at allreduce#17" read of a deadlocked run
+        out = tmp_path / "merged.json"
+        worker = (
+            "import os, sys, time\n"
+            "from hpc_patterns_tpu.analysis import runtime as rt\n"
+            "pid = int(os.environ['HPCPAT_PROCESS_ID'])\n"
+            "rt.record_collective('allreduce.collective', 16)\n"
+            "if pid == 1:\n"
+            "    rt.record_collective('sendrecv_ring', 17)\n"
+            "    sys.exit(0)\n"
+            "rt.record_collective('allreduce.collective', 17)\n"
+            "time.sleep(60)\n"
+        )
+        code = launch.main([
+            "-np", "2", "--timeout", "8",
+            "--trace-out", str(out),
+            "--trace-dir", str(tmp_path / "ranks"),
+            "--", sys.executable, "-c", worker,
+        ])
+        printed = capsys.readouterr().out
+        assert code == 1
+        assert "rank 0: is at allreduce.collective#17" in printed
+        assert "2 collective(s) issued" in printed
+        assert "rank 1 (exited): was at sendrecv_ring#17" in printed
+
+
 class TestDistributedTraceMerge:
     """The rung-4 acceptance, tier-1: ONE 2-process launch of the
     allreduce miniapp under --trace must produce a Perfetto-valid
@@ -235,6 +311,21 @@ class TestDistributedTraceMerge:
         assert "max start skew" in printed
         assert "clock align: sync" in printed  # barrier anchor taken
 
+    def test_collective_schedules_verified_consistent(self, merged_run):
+        # the desync check, clean side: both ranks' fingerprint chains
+        # (analysis/runtime.py) carry the same digest, so the merge
+        # PROVES the rank schedules matched rather than assuming SPMD
+        code, _out, log, printed = merged_run
+        assert code == 0, printed
+        assert "collective schedules consistent across 2 rank(s)" in printed
+        recs = [json.loads(l) for l in log.read_text().splitlines()]
+        sched = [r for r in recs
+                 if r["kind"] == "trace_merged"][0]["schedule"]
+        assert sched["verdict"] == "consistent"
+        assert sched["n_ranks_recorded"] == 2
+        assert sched["n_collectives"] >= 3  # the timed reps at least
+        assert sched["digest"]
+
     def test_merged_json_is_perfetto_valid_with_2_lanes(self, merged_run):
         code, out, _log, printed = merged_run
         assert code == 0, printed
@@ -265,6 +356,15 @@ class TestDistributedTraceMerge:
         crossing = [c for c in by_id.values()
                     if len({e["pid"] for e in c}) >= 2]
         assert crossing, "no flow chain crosses rank lanes"
+
+    def test_report_renders_the_desync_verdict(self, merged_run, capsys):
+        code, _out, log, printed = merged_run
+        assert code == 0, printed
+        from hpc_patterns_tpu.harness import report
+
+        assert report.main([str(log)]) == 0
+        out = capsys.readouterr().out
+        assert "schedules consistent" in out
 
     def test_trace_merged_record_and_report(self, merged_run, capsys):
         code, _out, log, printed = merged_run

@@ -111,6 +111,38 @@ class TestReportFixture:
         assert "straggler rank 0 (2/3 last)" in out
         assert "merged.json" in out
 
+    def test_trace_merged_schedule_verdict_is_rendered(self, tmp_path,
+                                                       capsys):
+        # the desync check travels in the trace_merged record; the
+        # digest line must say at a glance whether the ranks PROVABLY
+        # ran the same collective program — and name the break if not
+        def rec(schedule):
+            return {
+                "kind": "trace_merged", "n_ranks": 2, "n_matched": 0,
+                "n_unmatched": 0, "num_processes": 2, "ranks": [0, 1],
+                "n_events": 0,
+                "align": {"method": "sync"}, "skew": {},
+                "stragglers": {}, "busy": {}, "schedule": schedule,
+            }
+
+        path = tmp_path / "merged.jsonl"
+        path.write_text("\n".join([
+            json.dumps(rec({"verdict": "consistent", "n_collectives": 5,
+                            "n_ranks_recorded": 2, "digest": "ab12"})),
+            json.dumps(rec({"verdict": "divergent", "n_collectives": 3,
+                            "n_ranks_recorded": 2,
+                            "first_divergence": {
+                                "index": 17,
+                                "ranks": {"0": {"op": "allreduce",
+                                                "seq": 17},
+                                          "1": {"op": "sendrecv_ring",
+                                                "seq": 17}}}})),
+        ]) + "\n")
+        assert report.main([str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "schedules consistent (5 collectives)" in out
+        assert "SCHEDULE DIVERGENCE at #17" in out
+
     def test_cli_empty_input_fails(self, tmp_path, capsys):
         path = tmp_path / "empty.jsonl"
         path.write_text("")
