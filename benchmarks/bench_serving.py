@@ -2,7 +2,22 @@
 
 Usage: python benchmarks/bench_serving.py [--n=N] [--slots=S] [--chunk=K]
          [--mix=0|1] [--buckets=auto|none|16,32,...] [--overlap=0|1]
-         [--temp=T] [--topk=K] [--smoke]
+         [--temp=T] [--topk=K] [--smoke] [--scenario]
+
+``--scenario``: the ROBUSTNESS row (round 8) — an OPEN-loop two-class
+stream (harness/loadgen.py) served under page pressure that forces
+preemption-and-resume, with a seeded stalled-host chaos injection
+(harness/chaos.py) perturbing the engine loop, reporting **goodput**
+(SLO-attained tok/s, harness/slo.py) NEXT TO raw tok/s plus the
+preemption/shed counts — and the engine must STILL beat clean static
+batching. The oracle extends to the degraded path: every served
+sequence (including preempted-and-resumed ones) must be token-exact vs
+standalone paged_generate before any number is reported.
+``--smoke --scenario`` is the CI shape (tier-1,
+tests/test_bench_serving.py); the full shape runs in
+benchmarks/reground_r5.sh and its ``serving_goodput_tok_s`` /
+``serving_degraded_bubble_frac`` keys are gated by
+``harness/regress.py`` like every other headline.
 
 The capacity story measured on the REALISTIC stream: N requests with
 VARIED prompt lengths (``--mix``, default on) and varied generation
@@ -45,10 +60,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from hpc_patterns_tpu.harness import chaos as chaoslib
+from hpc_patterns_tpu.harness import loadgen, slo
 from hpc_patterns_tpu.models import TransformerConfig
 from hpc_patterns_tpu.models.decode import paged_generate
 from hpc_patterns_tpu.models.serving import (
     ContinuousBatcher,
+    bucket_ladder,
     pad_to_bucket,
     prefill_cache_size,
 )
@@ -232,7 +250,265 @@ def smoke_config():
                 params=init_params(jax.random.PRNGKey(0), cfg))
 
 
+SCENARIO_CLASSES = (
+    # interactive: the SLO-bearing class — tight-ish first-token and
+    # per-token targets, sheds if it queues absurdly long
+    loadgen.PriorityClass("interactive", 0, weight=0.4,
+                          ttft_slo_s=3.0, tpot_slo_s=1.0,
+                          deadline_s=30.0),
+    # batch: throughput filler — no latency target, preemptible
+    loadgen.PriorityClass("batch", 1, weight=0.6),
+)
+
+
+def scenario_smoke_config():
+    """The CI chaos scenario (tier-1 via tests/test_bench_serving.py):
+    a DETERMINISTIC staged schedule — two long batch requests take the
+    pool at t=0, two interactive requests arrive mid-run and cannot
+    get pages without EVICTING a batch row — plus two seeded
+    engine-stall injections. Staged (not sampled) so the preemption
+    trigger is structural, not a lucky draw; the seeded-random shapes
+    are the full scenario's job (scenario_full_config)."""
+    base = smoke_config()
+    inter, batch = SCENARIO_CLASSES
+    # two long batch rows take the pool at t=0 (free pages drop below
+    # an interactive's need BY CONSTRUCTION, so the first interactive
+    # arrival must preempt); a third batch row and the interactive
+    # wave interleave in ARRIVAL order so that in static batching both
+    # of the first two batches mix a 160-budget row with short rows —
+    # every short row in them pays the 160-step run_len (padding) and
+    # the length split doubles the scans (fragmentation). The engine
+    # preempts one batch row, serves the wave at its own budgets, and
+    # resumes the victim
+    schedule = loadgen.staged_schedule([
+        (0.00, batch, 32, 160),
+        (0.00, batch, 32, 160),
+        (0.05, inter, 16, 16),
+        (0.10, batch, 32, 160),
+        (0.15, inter, 16, 24),
+        (0.20, inter, 16, 16),
+        (0.25, inter, 16, 24),
+        (0.30, inter, 16, 16),
+    ], spec={"name": "smoke-chaos"})
+    return dict(
+        cfg=base["cfg"], params=base["params"], page_size=16,
+        slots=3, chunk=8, schedule=schedule,
+        classes=SCENARIO_CLASSES,
+        # pool: room for the two batch rows (12 pages each) plus ONE
+        # spare page — an arriving interactive row (2 pages) is starved
+        # by construction and must preempt
+        pool_pages=25, pages_per_seq=12,
+        buckets=bucket_ladder(192),
+        chaos_spec="stall:at=3,delay_ms=50;stall:at=9,delay_ms=50",
+        # the high-water backoff stays off in the smoke: its pool is
+        # sized to the page for the preemption trigger, and a reserve
+        # would re-order the staged admissions (the full config runs
+        # with the reserve on)
+        admit_highwater=1.0,
+    )
+
+
+def scenario_full_config(on_tpu: bool):
+    """The re-grounding shape: a seeded BURSTY open-loop stream (the
+    admission-control stressor) over the same two classes, sized so
+    bursts oversubscribe the pool and preemption/backoff do real work."""
+    cfg = TransformerConfig(
+        vocab=32768 if on_tpu else 256,
+        d_model=1024 if on_tpu else 256,
+        n_heads=8 if on_tpu else 4,
+        n_layers=8 if on_tpu else 2,
+        d_ff=4096 if on_tpu else 1024,
+        max_seq=1024 if on_tpu else 256,
+        dtype="bfloat16" if on_tpu else "float32",
+        decode_attn="flash" if on_tpu else "gather",
+    )
+    prompt_top = 128 if on_tpu else 32
+    budget_top = 256 if on_tpu else 128
+    schedule = loadgen.make_schedule(
+        32, rate_rps=16.0, classes=SCENARIO_CLASSES,
+        prompt_lens=(prompt_top // 2, prompt_top),
+        budgets=(budget_top // 8, budget_top // 2, budget_top),
+        budget_probs=(0.5, 0.3, 0.2),
+        process="bursty", seed=7, burst_factor=8.0,
+        mean_quiet_s=0.5, mean_burst_s=0.2)
+    page = 256 if on_tpu else 16
+    pps = ContinuousBatcher.pages_needed(
+        prompt_top, budget_top, page, padded_len=prompt_top)
+    return dict(
+        cfg=cfg, params=init_params(jax.random.PRNGKey(0), cfg),
+        page_size=page, slots=8 if on_tpu else 4, chunk=16,
+        schedule=schedule, classes=SCENARIO_CLASSES,
+        # ~2.5 concurrent max-size rows' worth of pages for 4-8 slots:
+        # bursts starve the arena and exercise eviction + backoff
+        pool_pages=int(2.5 * pps), pages_per_seq=pps,
+        buckets=bucket_ladder(prompt_top + budget_top),
+        chaos_spec="stall:at=5,delay_ms=80,every=12",
+        admit_highwater=0.95,
+    )
+
+
+def run_scenario(*, cfg, params, schedule, classes, page_size, slots,
+                 chunk, pool_pages, pages_per_seq, buckets,
+                 chaos_spec=None, admit_highwater=0.95, quiet=False):
+    """One robustness row: the open-loop schedule through (a) clean
+    static batching (closed-loop, arrival order — the baseline that
+    ignores arrival gaps, generous to static) and (b) the engine with
+    priority admission, preemption-and-resume, SLO accounting, and the
+    seeded chaos faults ACTIVE. The engine must beat static anyway,
+    and every served sequence — preempted-and-resumed included — must
+    be token-exact vs standalone paged_generate before any number is
+    believed. Returns the metrics dict (goodput next to tok/s)."""
+    out = print if not quiet else (lambda *a, **k: None)
+    rng = np.random.RandomState(13)
+    prompts = {r.index: rng.randint(0, cfg.vocab, size=r.prompt_len)
+               .astype(np.int32) for r in schedule.requests}
+    total_tokens = sum(r.max_new for r in schedule.requests)
+    targets = slo.targets_from_classes(classes)
+
+    def run_static():
+        outs = {}
+        reqs = [(prompts[r.index], r.max_new) for r in schedule.requests]
+        for i in range(0, len(reqs), slots):
+            batch = reqs[i:i + slots]
+            run_len = max(b for _, b in batch)
+            bylen = {}
+            for j, (p, b) in enumerate(batch):
+                bylen.setdefault(len(p), []).append((i + j, p, b))
+            for group in bylen.values():
+                arr = jnp.asarray(np.stack([p for _, p, _ in group]))
+                toks = np.asarray(paged_generate(
+                    params, arr, cfg, run_len, page_size=page_size))
+                for j, (idx, _, b) in enumerate(group):
+                    outs[idx] = toks[j, :b]
+        return outs
+
+    def run_engine():
+        eng = ContinuousBatcher(
+            params, cfg, slots=slots, pool_pages=pool_pages,
+            pages_per_seq=pages_per_seq, page_size=page_size,
+            chunk=chunk, prompt_buckets=buckets, preempt=True,
+            admit_highwater=admit_highwater, slo=targets,
+        )
+        arrivals = [
+            (r.t_arrival_s, dict(prompt=prompts[r.index],
+                                 max_new=r.max_new, seq_id=r.index,
+                                 priority=r.priority,
+                                 deadline_s=r.deadline_s))
+            for r in schedule.requests
+        ]
+        got = eng.run(arrivals=arrivals)
+        return got, eng
+
+    def prewarm_rungs():
+        # resumed prompts land on ladder rungs the ORIGINAL stream
+        # never visits (prompt + generated-so-far pads upward), and
+        # WHICH rung depends on when the preemption fired — so the
+        # warmup run cannot be trusted to have compiled them. Prefill
+        # every rung once (budget-1 rows through a 1-slot engine
+        # sharing this config's _prefill_one cache) so the timed leg
+        # measures scheduling, not a mid-run XLA compile.
+        # the SAME pool geometry as the scenario engine: _prefill_one
+        # compiles key on the cache shapes too, so a differently-sized
+        # pool would warm a parallel cache line and change nothing
+        eng = ContinuousBatcher(
+            params, cfg, slots=1, pool_pages=pool_pages,
+            pages_per_seq=pages_per_seq, page_size=page_size, chunk=1,
+            prompt_buckets=buckets)
+        for rung in buckets:
+            for plen in (rung, rung - 1):
+                if plen < 1 or pad_to_bucket(buckets, plen) != rung:
+                    continue
+                if ContinuousBatcher.pages_needed(
+                        plen, 1, page_size,
+                        padded_len=rung) <= pages_per_seq:
+                    eng.submit(np.zeros(plen, np.int32), 1)
+                    eng.run()
+                    break
+
+    compiles_before = prefill_cache_size()
+    # warmup (compiles; the chaos faults stay off so the warm cache is
+    # the same one a clean run builds), then the timed legs — the
+    # engine leg runs UNDER the seeded faults, static runs clean
+    run_static()
+    prewarm_rungs()
+    run_engine()
+    t0 = time.perf_counter()
+    static_out = run_static()
+    t_static = time.perf_counter() - t0
+    chaoslib.configure(chaos_spec)  # also clears the injection log
+    try:
+        t0 = time.perf_counter()
+        engine_out, eng = run_engine()
+        t_engine = time.perf_counter() - t0
+        stalls = [e for e in chaoslib.injections()
+                  if e["site"] == "engine_round"]
+    finally:
+        chaoslib.reset()
+    compiles = prefill_cache_size() - compiles_before
+
+    # oracle before any number is believed — the DEGRADED path included:
+    # a preempted-and-resumed row must be byte-identical to standalone
+    rep = eng.last_slo
+    for r in schedule.requests:
+        if eng.stats[r.index]["outcome"] != "ok":
+            continue  # shed: empty output by contract
+        want = np.asarray(paged_generate(
+            params, jnp.asarray(prompts[r.index])[None], cfg, r.max_new,
+            page_size=page_size))[0]
+        np.testing.assert_array_equal(
+            engine_out[r.index], want, err_msg=f"engine seq {r.index}")
+        np.testing.assert_array_equal(
+            static_out[r.index], want[:len(static_out[r.index])],
+            err_msg=f"static seq {r.index}")
+    assert compiles <= len(buckets), (
+        f"{compiles} prefill compiles > ladder {len(buckets)} — "
+        "resumed prompts left the bucket ladder")
+
+    tot = rep["total"]
+    served_tokens = tot["tokens"]
+    result = {
+        "t_static": t_static, "t_engine": t_engine,
+        "tokens": total_tokens, "served_tokens": served_tokens,
+        "tokens_per_s_static": total_tokens / t_static,
+        "tokens_per_s_engine": served_tokens / t_engine,
+        "speedup": (served_tokens / t_engine) / (total_tokens / t_static),
+        "goodput_tok_s": tot["goodput_tok_s"] * eng._serve_s / t_engine
+        if eng._serve_s else 0.0,
+        "attained_frac": tot["attained_frac"],
+        "preemptions": tot["preemptions"], "shed": tot["shed"],
+        "bubble_frac": eng.last_bubble_frac,
+        "stall_injections": len(stalls),
+        "stall_injected_s": sum(e["delay_s"] for e in stalls),
+        "prefill_compiles": compiles, "ladder": len(buckets),
+        "schedule": schedule.spec,
+    }
+    out(f"scenario[{schedule.spec.get('process', '?')}]: "
+        f"n={schedule.n} slots={slots} chunk={chunk} "
+        f"pool={pool_pages}p tokens={total_tokens} "
+        f"chaos={chaos_spec or 'off'}")
+    out(f"  static  : {t_static:.3f}s  "
+        f"{result['tokens_per_s_static']:,.1f} tok/s (clean)")
+    out(f"  engine  : {t_engine:.3f}s  "
+        f"{result['tokens_per_s_engine']:,.1f} tok/s  "
+        f"goodput {result['goodput_tok_s']:,.1f} tok/s  "
+        f"bubble {result['bubble_frac']:.1%}  "
+        f"preempted {result['preemptions']}  shed {result['shed']}  "
+        f"stalls {result['stall_injections']} "
+        f"(+{result['stall_injected_s'] * 1e3:.0f}ms)")
+    out(f"  engine/static speedup under chaos: "
+        f"{result['speedup']:.3f}x (oracle-exact incl. resumed rows)")
+    out("  " + slo.format_slo(rep).replace("\n", "\n  "))
+    return result
+
+
 def main():
+    if arg("scenario", False, bool):
+        if arg("smoke", False, bool):
+            run_scenario(**scenario_smoke_config())
+        else:
+            run_scenario(**scenario_full_config(
+                jax.default_backend() == "tpu"))
+        return
     if arg("smoke", False, bool):
         run_bench(**smoke_config(),
                   overlap=bool(arg("overlap", 1)),

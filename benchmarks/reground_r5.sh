@@ -56,6 +56,16 @@ run "decode paged page=2048" python benchmarks/bench_decode.py --prompt=2048 --s
 # 4. continuous batching vs static (item 3's chip row)
 run "serving engine vs static" python benchmarks/bench_serving.py
 
+# 4b. ROBUSTNESS row: the open-loop chaos/SLO scenario — bursty
+#     two-class traffic under page pressure (preemption-and-resume) and
+#     a seeded stalled-host injection; reports GOODPUT (SLO-attained
+#     tok/s) next to raw tok/s and must still beat clean static. Its
+#     headline keys (serving_goodput_tok_s, serving_degraded_bubble_
+#     frac) are gated by harness/regress.py alongside serving_tok_s
+#     when captured into a round; the oracle (preempted-and-resumed
+#     rows byte-identical to standalone) runs before any number prints.
+run "serving chaos/SLO scenario" python benchmarks/bench_serving.py --scenario
+
 # 5. aligned speculative pair + gamma sweep + batched impls (item 4, 7)
 run "make draft pair" python benchmarks/make_draft_pair.py --out=benchmarks/pair_r5
 run "speculative aligned sweep" python benchmarks/bench_speculative.py --pair=benchmarks/pair_r5 --batched=8

@@ -56,6 +56,18 @@ DEFAULT_DISPATCH_CRITICAL = frozenset({
     "_admit_row",
     "_try_admit",
     "_ready_in_span",
+    # the round-8 robustness entry points: preemption decision/eviction,
+    # shedding, and the admission high-water check all run inside the
+    # serving loop at chunk boundaries — a stray host sync there stalls
+    # the very pipeline preemption exists to keep fed (the one
+    # DELIBERATE sync, the eviction snapshot, carries a justified
+    # suppression in models/serving.py)
+    "_maybe_preempt",
+    "_preempt",
+    "_shed_expired",
+    "_queue_order",
+    "_admissible",
+    "_can_resume",
 })
 
 # rule names are kebab-case identifiers; anything after the last name

@@ -34,6 +34,16 @@ exit — clean, failed, or timed out — the launcher merges whatever rank
 files exist into one clock-aligned Perfetto timeline with cross-rank
 skew/straggler rollups (harness/collect.py, rung 4 of the
 observability ladder; docs/observability.md).
+
+Chaos runs (round 8): ``--chaos SPEC`` exports ``HPCPAT_CHAOS`` so
+every child runs under the seeded fault injectors (harness/chaos.py —
+straggler rank, stalled host, mid-stream worker death). A rank that
+exits nonzero — killed included — lands in the rank report with its
+FAULT KIND, last output line, and last collective fingerprint, and the
+surviving ranks' trace files still merge (the ``trace_merged`` record
+carries ``faults``). ``--retry N --retry-backoff S`` relaunches a
+failed run with doubling backoff — bounded retry for transient and
+injected faults.
 """
 
 from __future__ import annotations
@@ -70,6 +80,21 @@ def build_parser():
                    help="coordinator port (0 = pick a free one)")
     p.add_argument("--timeout", type=float, default=600.0,
                    help="per-run timeout in seconds")
+    p.add_argument("--retry", type=int, default=0,
+                   help="relaunch a failed run (nonzero/killed rank or "
+                        "timeout) up to N more times with backoff — "
+                        "bounded retry for chaos runs where a worker "
+                        "death is an injected or transient fault")
+    p.add_argument("--retry-backoff", type=float, default=1.0,
+                   help="seconds to wait before the first retry "
+                        "(doubles per attempt)")
+    p.add_argument("--chaos", default=None, metavar="SPEC",
+                   help="export HPCPAT_CHAOS=SPEC to every child — the "
+                        "seeded fault injectors of harness/chaos.py "
+                        "(e.g. 'straggler:rank=1,delay_ms=40' or "
+                        "'die:rank=1,at=5'); the rank report records "
+                        "the fault kind and partial trace sets still "
+                        "merge")
     p.add_argument("--trace-out", default=None, metavar="MERGED.json",
                    help="distributed flight recorder: export the "
                         "launcher env (HPCPAT_TRACE_DIR) so every "
@@ -160,13 +185,34 @@ def _read_sched_progress(trace_dir: str) -> dict[int, dict]:
     return out
 
 
+def _fault_kind(code: int | None) -> str:
+    """One rank's exit classified for the rank report: ``clean``,
+    ``exit N`` (error), ``killed (SIGNAME)`` (a negative returncode —
+    the mid-stream worker-death shape: SIGKILLed, OOMed, preempted),
+    or ``timeout`` (never exited)."""
+    if code is None:
+        return "timeout"
+    if code == 0:
+        return "clean"
+    if code < 0:
+        import signal
+
+        try:
+            return f"killed ({signal.Signals(-code).name})"
+        except ValueError:
+            return f"killed (signal {-code})"
+    return f"exit {code}"
+
+
 def _harvest_traces(trace_dir: str, out: str, log: str | None,
-                    nprocs: int) -> None:
+                    nprocs: int, faults: dict | None = None) -> None:
     """Collect whatever per-rank trace files exist under ``trace_dir``
-    (ALL of them after a clean run; any partial set after a timeout —
-    a hung run's already-written ranks are still debuggable), merge
+    (ALL of them after a clean run; any partial set after a timeout or
+    a killed worker — the surviving ranks are still debuggable), merge
     them clock-aligned into ``out``, print the skew/straggler rollup,
-    and append the ``kind=trace_merged`` record to ``log``."""
+    and append the ``kind=trace_merged`` record to ``log``.
+    ``faults``: the per-rank fault kinds of a failed run — recorded on
+    the rollup so the merged record says WHY a lane is missing."""
     from hpc_patterns_tpu.harness import collect as collectlib
     from hpc_patterns_tpu.harness.runlog import RunLog
 
@@ -183,51 +229,20 @@ def _harvest_traces(trace_dir: str, out: str, log: str | None,
     if rollup is None:
         print(f"trace: rank files under {trace_dir} held no snapshots")
         return
+    if faults:
+        rollup["faults"] = {str(r): k for r, k in sorted(faults.items())}
     print(collectlib.format_rollup(rollup))
     print(f"merged trace: {out} (open in Perfetto / chrome://tracing)")
     log = log or f"{out}.rollup.jsonl"
     RunLog(log, truncate=False).emit(kind="trace_merged", **rollup)
 
 
-def run(args) -> int:
-    cmd = args.cmd
-    if cmd and cmd[0] == "--":
-        cmd = cmd[1:]
-    if not cmd:
-        print("ERROR: no command given (put it after --)")
-        return 2
-    nprocs = args.num_processes
-    if nprocs < 1:
-        print("ERROR: -np must be >= 1")
-        return 2
-    if args.slices and nprocs % args.slices:
-        print(f"ERROR: -np {nprocs} must divide by --slices {args.slices}")
-        return 2
-    # distributed-trace handoff: children see HPCPAT_TRACE_DIR and (if
-    # run with --trace) write rank<id>.trace.json there at exit; the
-    # path is absolute because children may chdir. Without --trace-out
-    # nothing is exported and the launch is byte-identical to before.
-    trace_dir = made_trace_dir = None
-    if args.trace_out:
-        if args.trace_dir:
-            trace_dir = os.path.abspath(args.trace_dir)
-            os.makedirs(trace_dir, exist_ok=True)
-            # a reused dir must not leak a previous run's ranks into
-            # this merge (stale rank files would stand in for ranks
-            # that crashed before writing, silently) — nor a previous
-            # run's collective fingerprints into this run's hang report
-            for pattern in ("rank*.trace.json", "rank*.sched.json"):
-                for stale in Path(trace_dir).glob(pattern):
-                    stale.unlink()
-        else:
-            trace_dir = made_trace_dir = tempfile.mkdtemp(
-                prefix="hpcpat_trace_")
-    elif args.trace_dir or args.log:
-        print("note: --trace-dir/--log do nothing without --trace-out "
-              "(the distributed-trace pipeline is off)")
-    base_env = dict(os.environ)
-    if trace_dir:
-        base_env[topology.ENV_TRACE_DIR] = trace_dir
+def _attempt(cmd, base_env, nprocs, args, trace_dir) -> tuple[
+        list, bool, dict]:
+    """One launch attempt: spawn the ranks, wait them out, print the
+    timeout forensics when they hang. Returns ``(codes, timed_out,
+    last_lines)`` where ``codes[pid]`` is None for a rank that never
+    exited (killed after the timeout)."""
     coord = f"127.0.0.1:{args.port or _free_port()}"
     procs, pumps = [], []
     last_lines: dict[int, str] = {}
@@ -250,13 +265,12 @@ def run(args) -> int:
         procs.append(proc)
         pumps.append(t)
 
-    codes = []
     timed_out = False
+    stuck: list[int] = []
     deadline = time.monotonic() + args.timeout
     try:
         for proc in procs:
-            codes.append(proc.wait(
-                timeout=max(0.0, deadline - time.monotonic())))
+            proc.wait(timeout=max(0.0, deadline - time.monotonic()))
     except subprocess.TimeoutExpired:
         timed_out = True
         # name the hung ranks BEFORE killing them: rank id + the last
@@ -266,6 +280,14 @@ def run(args) -> int:
                  if proc.poll() is None]
         for proc in procs:
             proc.kill()
+        for proc in procs:
+            # reap the kills: un-waited children stay zombies for the
+            # launcher's lifetime, and --retry would stack nprocs more
+            # per timed-out attempt
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
         print(f"FAILURE: timeout after {args.timeout}s — "
               f"{len(stuck)}/{nprocs} rank(s) had not exited:")
         fps = _read_sched_progress(trace_dir) if trace_dir else {}
@@ -288,23 +310,126 @@ def run(args) -> int:
     finally:
         for t in pumps:
             t.join(timeout=5)
+    codes = [proc.poll() for proc in procs]
+    if timed_out:
+        # a killed-on-timeout rank reports None ("timeout"), not the
+        # SIGKILL code of the launcher's OWN kill — by the time poll()
+        # runs, the kill has been reaped and returncode reads -9, the
+        # chaos worker-death signature; membership in the pre-kill
+        # stuck list is what distinguishes a hang from a death
+        codes = [None if pid in stuck else c
+                 for pid, c in enumerate(codes)]
+    return codes, timed_out, last_lines
+
+
+def run(args) -> int:
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        print("ERROR: no command given (put it after --)")
+        return 2
+    nprocs = args.num_processes
+    if nprocs < 1:
+        print("ERROR: -np must be >= 1")
+        return 2
+    if args.slices and nprocs % args.slices:
+        print(f"ERROR: -np {nprocs} must divide by --slices {args.slices}")
+        return 2
+    if args.chaos:
+        # validate NOW: a typo'd chaos spec injecting nothing would
+        # fake a healthy run out of a chaos scenario
+        from hpc_patterns_tpu.harness import chaos as chaoslib
+
+        try:
+            chaoslib.parse(args.chaos)
+        except ValueError as e:
+            print(f"ERROR: bad --chaos spec: {e}")
+            return 2
+    # distributed-trace handoff: children see HPCPAT_TRACE_DIR and (if
+    # run with --trace) write rank<id>.trace.json there at exit; the
+    # path is absolute because children may chdir. Without --trace-out
+    # nothing is exported and the launch is byte-identical to before.
+    trace_dir = made_trace_dir = None
+    if args.trace_out:
+        if args.trace_dir:
+            trace_dir = os.path.abspath(args.trace_dir)
+            os.makedirs(trace_dir, exist_ok=True)
+        else:
+            trace_dir = made_trace_dir = tempfile.mkdtemp(
+                prefix="hpcpat_trace_")
+    elif args.trace_dir or args.log:
+        print("note: --trace-dir/--log do nothing without --trace-out "
+              "(the distributed-trace pipeline is off)")
+    base_env = dict(os.environ)
+    if trace_dir:
+        base_env[topology.ENV_TRACE_DIR] = trace_dir
+    if args.chaos:
+        from hpc_patterns_tpu.harness import chaos as chaoslib
+
+        base_env[chaoslib.ENV_CHAOS] = args.chaos
+    attempts = max(0, args.retry) + 1
+    backoff = max(0.0, args.retry_backoff)
+    ok = False
+    faults: dict[int, str] = {}
+    try:
+        for attempt in range(attempts):
+            if attempt:
+                print(f"retrying launch (attempt {attempt + 1}/"
+                      f"{attempts}) after {backoff:.1f}s backoff")
+                time.sleep(backoff)
+                backoff *= 2
+            if trace_dir:
+                # each attempt starts clean: a prior run's (or failed
+                # attempt's) rank files must not stand in for ranks
+                # that crashed before writing, nor its collective
+                # fingerprints leak into this attempt's hang report
+                for pattern in ("rank*.trace.json", "rank*.sched.json"):
+                    for stale in Path(trace_dir).glob(pattern):
+                        stale.unlink()
+            codes, timed_out, last_lines = _attempt(
+                cmd, base_env, nprocs, args, trace_dir)
+            faults = {pid: _fault_kind(c) for pid, c in enumerate(codes)}
+            ok = not timed_out and all(c == 0 for c in codes)
+            if timed_out:
+                continue
+            print(f"launch -np {nprocs}: exit codes {codes}")
+            if not ok:
+                # the rank report, fault-kind edition: a worker that
+                # DIED mid-stream (negative returncode — SIGKILLed,
+                # OOMed, chaos-injected death) is named with what
+                # killed it, its last output, and the collective it
+                # was at (the same forensics the timeout path prints)
+                fps = (_read_sched_progress(trace_dir)
+                       if trace_dir else {})
+                for pid, c in enumerate(codes):
+                    if c == 0:
+                        continue
+                    last = last_lines.get(pid, "<no output>")
+                    print(f"  rank {pid}: fault: {faults[pid]} — "
+                          f"last output: {last}")
+                    e = fps.get(pid)
+                    if e:
+                        print(f"  rank {pid}: was at {e['last']['op']}"
+                              f"#{e['last']['seq']} ({e['n']} "
+                              f"collective(s) issued)")
+            print("SUCCESS" if ok else "FAILURE")
+            if ok:
+                break
+    finally:
         if trace_dir:
-            # harvest even after a timeout: ranks that finished (or
-            # crashed cleanly) already wrote their snapshots
+            # harvest even after a timeout or a killed worker: ranks
+            # that finished (or crashed cleanly) already wrote their
+            # snapshots — the partial set is the surviving evidence
             try:
                 _harvest_traces(trace_dir, args.trace_out, args.log,
-                                nprocs)
+                                nprocs,
+                                faults=None if ok else faults)
             finally:
-                if made_trace_dir and not timed_out:
+                if made_trace_dir and ok:
                     shutil.rmtree(made_trace_dir, ignore_errors=True)
                 elif made_trace_dir:
                     print(f"per-rank trace files kept: {made_trace_dir}")
-
-    if timed_out:
-        return 1
-    ok = all(c == 0 for c in codes)
-    print(f"launch -np {nprocs}: exit codes {codes}")
-    print("SUCCESS" if ok else "FAILURE")
     return 0 if ok else 1
 
 

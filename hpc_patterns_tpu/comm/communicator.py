@@ -25,6 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from hpc_patterns_tpu.analysis import runtime as analysis_runtime
 from hpc_patterns_tpu.comm import collectives, ring
+from hpc_patterns_tpu.harness import chaos as chaoslib
 from hpc_patterns_tpu.harness import metrics as metricslib
 from hpc_patterns_tpu.harness import trace as tracelib
 from hpc_patterns_tpu.topology import shard_map
@@ -79,6 +80,18 @@ def _ready_in_span(result, op: str = "collective", seq: int | None = None,
         # it claims to measure has elapsed
         jax.block_until_ready(result)
     return result
+
+
+def _inject_chaos(seq: int) -> None:
+    """Chaos injection, straggler site — called by every collective
+    method BEFORE the shard_map closure is even built, so the injected
+    delay precedes the dispatch itself: the straggler's device work for
+    collective ``seq`` genuinely starts late (the other ranks stretch
+    waiting for it), and the skew evidence in the cross-rank merge is
+    the real perturbation, not an artifact of marker placement. One
+    cached-config read when no chaos is active."""
+    if chaoslib.active() is not None:
+        chaoslib.maybe_inject("collective", seq)
 
 
 def record_collective_bandwidth(op: str, nbytes: int, seconds: float,
@@ -183,11 +196,12 @@ class Communicator:
         ``"collective"``; the :173-182 hand ring for ``"ring"``;
         two-phase bandwidth-optimal ring for ``"ring_chunked"``)."""
         impl = _ALLREDUCE[algorithm]
+        seq = self._next_seq()
+        _inject_chaos(seq)
         with metricslib.span("comm.allreduce", algorithm=algorithm):
             return _ready_in_span(
                 self._shmap(lambda local: impl(local, self.axis), x)(x),
-                op=f"allreduce.{algorithm}", seq=self._next_seq(),
-                axis=self.axis)
+                op=f"allreduce.{algorithm}", seq=seq, axis=self.axis)
 
     def jit_allreduce(self, x, algorithm: Algorithm = "collective"):
         """The compiled allreduce closure for ``x``'s shape — what a
@@ -198,9 +212,11 @@ class Communicator:
     def pingpong(self, x) -> jax.Array:
         """Pairwise even/odd exchange: row r swaps with row r^1 — the
         pt2pt ping-pong config of BASELINE.json."""
+        seq = self._next_seq()
+        _inject_chaos(seq)
         with metricslib.span("comm.pingpong"):
             return _ready_in_span(self.jit_pingpong(x)(x),
-                                  op="pingpong", seq=self._next_seq(),
+                                  op="pingpong", seq=seq,
                                   axis=self.axis)
 
     def jit_pingpong(self, x):
@@ -210,37 +226,45 @@ class Communicator:
     def sendrecv_ring(self, x, shift: int = 1) -> jax.Array:
         """One ring hop: row r moves to row (r+shift) % size
         (SendRecvRing, allreduce-mpi-sycl.cpp:43-59)."""
+        seq = self._next_seq()
+        _inject_chaos(seq)
         with metricslib.span("comm.sendrecv_ring", shift=shift):
             return _ready_in_span(self._shmap(
                 lambda l: ring.ring_shift(l, self.axis, shift), x)(x),
-                op="sendrecv_ring", seq=self._next_seq(), axis=self.axis)
+                op="sendrecv_ring", seq=seq, axis=self.axis)
 
     def all_gather(self, x) -> jax.Array:
         """Every rank receives every row: (size, n) -> (size, size, n)."""
         fn = lambda l: collectives.all_gather(l, self.axis, tiled=False).squeeze(1)[None]
         spec = P(self.axis, None, *([None] * (jnp.ndim(x) - 1)))
+        seq = self._next_seq()
+        _inject_chaos(seq)
         with metricslib.span("comm.all_gather"):
             return _ready_in_span(self._shmap(fn, x, out_specs=spec)(x),
-                                  op="all_gather", seq=self._next_seq(),
+                                  op="all_gather", seq=seq,
                                   axis=self.axis)
 
     def reduce_scatter(self, x) -> jax.Array:
         """(size, size*n) rows -> (size, n): rank r gets chunk r of the sum."""
         fn = lambda l: collectives.reduce_scatter(l, self.axis, scatter_axis=jnp.ndim(x) - 1)
+        seq = self._next_seq()
+        _inject_chaos(seq)
         with metricslib.span("comm.reduce_scatter"):
             return _ready_in_span(self._shmap(
                 fn, x,
                 out_specs=P(self.axis, *([None] * (jnp.ndim(x) - 1))))(x),
-                op="reduce_scatter", seq=self._next_seq(), axis=self.axis)
+                op="reduce_scatter", seq=seq, axis=self.axis)
 
     def all_to_all(self, x) -> jax.Array:
         """Row r's chunk c goes to row c's chunk r (MPI_Alltoall)."""
         fn = lambda l: collectives.all_to_all(
             l, self.axis, split_axis=jnp.ndim(x) - 1, concat_axis=jnp.ndim(x) - 1
         )
+        seq = self._next_seq()
+        _inject_chaos(seq)
         with metricslib.span("comm.all_to_all"):
             return _ready_in_span(self._shmap(fn, x)(x),
-                                  op="all_to_all", seq=self._next_seq(),
+                                  op="all_to_all", seq=seq,
                                   axis=self.axis)
 
     # -- miniapp-style buffer init ---------------------------------------

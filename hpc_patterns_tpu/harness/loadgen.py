@@ -1,0 +1,250 @@
+"""Open-loop load generation: seeded arrival processes for serving.
+
+``bench_serving``'s original stream is CLOSED-loop: every request is
+queued up front and a new one only makes progress when the engine frees
+capacity — so the offered load adapts to the server and overload can
+never happen. Real traffic is OPEN-loop: arrivals come on the *users'*
+clock (the classic closed-vs-open distinction; under-provisioned
+open-loop systems build queues and blow deadlines instead of politely
+slowing the benchmark down). This module generates those arrival
+schedules:
+
+- **poisson** — memoryless arrivals at a constant mean rate (the
+  steady-traffic null model);
+- **bursty** — a two-phase Markov-modulated process: quiet periods at
+  the base rate alternate with bursts at ``burst_factor`` times it
+  (queue-depth spikes, the admission-control stressor);
+- **diurnal** — a sinusoidally modulated rate (period ``period_s``,
+  modulation depth ``depth``) sampled by thinning (peak-hour vs
+  trough, the capacity-planning shape).
+
+Every schedule is DETERMINISTIC given its parameters and seed, and
+round-trips through JSON (:meth:`Schedule.to_json`) — so a chaos run's
+exact traffic can be replayed against a fix, and a scenario row in a
+benchmark names the schedule that produced it.
+
+Requests carry a **priority class** (:class:`PriorityClass`: lower
+``priority`` number = more important, the P0/P1 convention) with
+per-class SLO targets (consumed by ``harness/slo.py``) and an optional
+queue ``deadline_s`` (consumed by the engine's shedding policy). The
+serving engine admits in priority order and — with ``preempt=True`` —
+evicts lower classes under page pressure (``models/serving.py``).
+
+Import-light (numpy only): schedules must be buildable from jax-free
+drivers and launcher children.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Any, Sequence
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class PriorityClass:
+    """One traffic class. ``priority``: lower = more important (the
+    engine admits lower numbers first and may preempt higher ones for
+    them). ``weight``: relative share of arrivals. ``ttft_slo_s`` /
+    ``tpot_slo_s``: the class's SLO targets (None = no target —
+    trivially attained). ``deadline_s``: queue-time shedding deadline
+    (None = never shed)."""
+    name: str
+    priority: int
+    weight: float = 1.0
+    ttft_slo_s: float | None = None
+    tpot_slo_s: float | None = None
+    deadline_s: float | None = None
+
+
+@dataclass(frozen=True)
+class ScheduledRequest:
+    """One arrival: WHEN it enters (``t_arrival_s``, relative to the
+    run start), what class it belongs to, and its shape (prompt
+    length, generation budget). Prompt token CONTENT is the driver's
+    job (seeded separately) — the schedule is shape + timing only, so
+    one schedule replays against any vocabulary."""
+    index: int
+    t_arrival_s: float
+    cls: str
+    priority: int
+    prompt_len: int
+    max_new: int
+    deadline_s: float | None = None
+
+
+@dataclass(frozen=True)
+class Schedule:
+    """A replayable arrival schedule: the requests in arrival order
+    plus the generating spec (provenance — a benchmark row can name
+    exactly which traffic produced it)."""
+    requests: tuple[ScheduledRequest, ...]
+    spec: dict = field(default_factory=dict)
+
+    @property
+    def n(self) -> int:
+        return len(self.requests)
+
+    @property
+    def duration_s(self) -> float:
+        return self.requests[-1].t_arrival_s if self.requests else 0.0
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "spec": self.spec,
+            "requests": [asdict(r) for r in self.requests],
+        })
+
+    @classmethod
+    def from_json(cls, text: str) -> "Schedule":
+        obj = json.loads(text)
+        return cls(
+            requests=tuple(ScheduledRequest(**r)
+                           for r in obj.get("requests", [])),
+            spec=dict(obj.get("spec", {})),
+        )
+
+
+# ---------------------------------------------------------------------------
+# arrival processes (times only; all driven by one RandomState)
+# ---------------------------------------------------------------------------
+
+
+def poisson_times(n: int, rate_rps: float,
+                  rng: np.random.RandomState) -> np.ndarray:
+    """n arrival instants of a homogeneous Poisson process: cumulative
+    exponential inter-arrivals at mean ``1/rate``."""
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+    return np.cumsum(rng.exponential(1.0 / rate_rps, size=n))
+
+
+def bursty_times(n: int, rate_rps: float, rng: np.random.RandomState,
+                 *, burst_factor: float = 8.0,
+                 mean_quiet_s: float = 1.0,
+                 mean_burst_s: float = 0.25) -> np.ndarray:
+    """Two-phase modulated Poisson: exponential quiet phases at the
+    base rate alternating with exponential burst phases at
+    ``burst_factor``× it. The phase sequence and the arrivals inside
+    each phase all come from ``rng`` — one seed, one schedule."""
+    if burst_factor < 1.0:
+        raise ValueError(f"burst_factor must be >= 1, got {burst_factor}")
+    times: list[float] = []
+    t = 0.0
+    burst = False
+    while len(times) < n:
+        phase = rng.exponential(mean_burst_s if burst else mean_quiet_s)
+        rate = rate_rps * (burst_factor if burst else 1.0)
+        # arrivals inside this phase: sequential exponentials until the
+        # phase ends (keeps the draw count deterministic per phase)
+        u = t
+        while True:
+            u += rng.exponential(1.0 / rate)
+            if u > t + phase or len(times) >= n:
+                break
+            times.append(u)
+        t += phase
+        burst = not burst
+    return np.asarray(times[:n])
+
+
+def diurnal_times(n: int, rate_rps: float, rng: np.random.RandomState,
+                  *, period_s: float = 60.0,
+                  depth: float = 0.8) -> np.ndarray:
+    """Sinusoidally modulated Poisson sampled by thinning: the
+    instantaneous rate is ``rate*(1 + depth*sin(2πt/period))``;
+    candidates are generated at the peak rate and accepted with
+    probability rate(t)/peak — the standard exact thinning
+    construction, deterministic given ``rng``."""
+    if not 0.0 <= depth < 1.0:
+        raise ValueError(f"depth must be in [0, 1), got {depth}")
+    peak = rate_rps * (1.0 + depth)
+    times: list[float] = []
+    t = 0.0
+    while len(times) < n:
+        t += rng.exponential(1.0 / peak)
+        rate_t = rate_rps * (1.0 + depth * np.sin(2 * np.pi * t / period_s))
+        if rng.uniform() * peak <= rate_t:
+            times.append(t)
+    return np.asarray(times)
+
+
+_PROCESSES = {
+    "poisson": poisson_times,
+    "bursty": bursty_times,
+    "diurnal": diurnal_times,
+}
+
+
+# ---------------------------------------------------------------------------
+# schedule assembly
+# ---------------------------------------------------------------------------
+
+
+def make_schedule(n: int, *, rate_rps: float,
+                  classes: Sequence[PriorityClass],
+                  prompt_lens: Sequence[int],
+                  budgets: Sequence[int],
+                  budget_probs: Sequence[float] | None = None,
+                  process: str = "poisson", seed: int = 0,
+                  **process_kw: Any) -> Schedule:
+    """The one constructor: ``n`` arrivals from the named process, each
+    assigned a class (by weight), a prompt length, and a budget — all
+    from ONE seeded RandomState, so (params, seed) fully determine the
+    schedule. ``process_kw`` passes through to the arrival process
+    (``burst_factor``, ``period_s``, ...)."""
+    if n < 1:
+        raise ValueError(f"n must be >= 1, got {n}")
+    if not classes:
+        raise ValueError("need at least one PriorityClass")
+    gen = _PROCESSES.get(process)
+    if gen is None:
+        raise ValueError(f"unknown process {process!r} "
+                         f"(known: {', '.join(sorted(_PROCESSES))})")
+    rng = np.random.RandomState(seed)
+    times = gen(n, rate_rps, rng, **process_kw)
+    weights = np.asarray([c.weight for c in classes], np.float64)
+    if weights.sum() <= 0:
+        raise ValueError("class weights must sum > 0")
+    weights = weights / weights.sum()
+    cls_idx = rng.choice(len(classes), size=n, p=weights)
+    plens = rng.choice(np.asarray(prompt_lens, np.int64), size=n)
+    budgets_arr = np.asarray(budgets, np.int64)
+    probs = (np.asarray(budget_probs, np.float64)
+             if budget_probs is not None else None)
+    news = rng.choice(budgets_arr, size=n, p=probs)
+    reqs = []
+    for i in range(n):
+        c = classes[int(cls_idx[i])]
+        reqs.append(ScheduledRequest(
+            index=i, t_arrival_s=float(times[i]), cls=c.name,
+            priority=c.priority, prompt_len=int(plens[i]),
+            max_new=int(news[i]), deadline_s=c.deadline_s))
+    spec = {"process": process, "n": n, "rate_rps": rate_rps,
+            "seed": seed, "prompt_lens": list(map(int, prompt_lens)),
+            "budgets": list(map(int, budgets)),
+            "classes": [asdict(c) for c in classes], **process_kw}
+    return Schedule(requests=tuple(reqs), spec=spec)
+
+
+def staged_schedule(stages: Sequence[tuple[float, PriorityClass, int, int]],
+                    spec: dict | None = None) -> Schedule:
+    """An explicit hand-staged schedule — (t_arrival_s, class,
+    prompt_len, max_new) tuples in arrival order. The deterministic
+    building block for CI scenario smokes, where the preemption trigger
+    must not depend on a random draw; still a :class:`Schedule`, so it
+    serializes and replays exactly like a generated one."""
+    reqs = []
+    last = -np.inf
+    for i, (t, c, plen, mnew) in enumerate(stages):
+        if t < last:
+            raise ValueError("staged arrivals must be non-decreasing")
+        last = t
+        reqs.append(ScheduledRequest(
+            index=i, t_arrival_s=float(t), cls=c.name,
+            priority=c.priority, prompt_len=int(plen),
+            max_new=int(mnew), deadline_s=c.deadline_s))
+    return Schedule(requests=tuple(reqs),
+                    spec={"process": "staged", **(spec or {})})

@@ -97,10 +97,16 @@ class Histogram:
 
     def percentile(self, q: float) -> float:
         """Rank-based percentile at bucket resolution, clamped to the
-        observed [min, max] so p0/p100 are exact."""
+        observed [min, max] so p0/p100 are exact. The last order
+        statistic IS the tracked max — returning the bucket midpoint
+        there undershot it whenever the max sat in the upper half of
+        its log bucket (a real flake: a load-spiked rep set whose
+        samples all share one bucket)."""
         if not self.count:
             return math.nan
         rank = max(1, math.ceil(q / 100.0 * self.count))
+        if rank >= self.count:
+            return self.max
         cum = 0
         for i in sorted(self.counts):
             cum += self.counts[i]

@@ -26,6 +26,7 @@ from typing import Callable, Sequence
 import jax
 
 from hpc_patterns_tpu.analysis import runtime as _runtimelib
+from hpc_patterns_tpu.harness import chaos as chaoslib
 from hpc_patterns_tpu.harness import metrics as metricslib
 
 
@@ -81,9 +82,17 @@ def measure(
     match rank A's rep k against rank B's rep k and draw the skew fan.
     Disabled (the default), this is the identical code path as always:
     no spans, no records, no extra work.
+
+    Chaos (harness/chaos.py): each timed repetition probes the
+    ``collective`` injection site at its ``seq`` index — the timed rep
+    IS the collective loop of the launched benchmarks (the same
+    identification PR 5 made for the skew fan), so a seeded straggler
+    rank is late in exactly the windows the cross-rank merge measures.
+    One cached-config read per rep when no chaos is active.
     """
     if repetitions < 1:
         raise ValueError("repetitions must be >= 1")
+    chaos_on = chaoslib.active() is not None
     m = metricslib.get_metrics()
     # the instrumented path also engages when a flight recorder is
     # installed (--trace): the warmup/timed spans then land on the
@@ -93,10 +102,17 @@ def measure(
         for _ in range(warmup):
             fn()
         times = []
-        for _ in range(repetitions):
-            t0 = time.perf_counter()
-            fn()
-            times.append(time.perf_counter() - t0)
+        for seq in range(repetitions):
+            if chaos_on:
+                chaoslib.maybe_inject("collective", seq)
+                with chaoslib.suppress("collective"):
+                    t0 = time.perf_counter()
+                    fn()
+                    times.append(time.perf_counter() - t0)
+            else:
+                t0 = time.perf_counter()
+                fn()
+                times.append(time.perf_counter() - t0)
         return TimingResult(tuple(_native_identity(times)))
     from hpc_patterns_tpu.harness import trace as tracelib
 
@@ -108,6 +124,11 @@ def measure(
     times = []
     with m.span(f"{label}.timed", repetitions=repetitions):
         for seq in range(repetitions):
+            if chaos_on:
+                # the straggler site: inject BEFORE the dispatch marker
+                # so the delayed rank's window STARTS late — the shape
+                # a genuinely slow rank has in the skew fan
+                chaoslib.maybe_inject("collective", seq)
             if rec is not None:
                 # fingerprint the rep into the per-rank schedule hash
                 # chain (analysis/runtime.py) BEFORE dispatching: every
@@ -119,7 +140,13 @@ def measure(
                 _runtimelib.record_collective(label, seq)
                 t_disp = rec.mark_dispatch(label, args={"seq": seq})
             t0 = time.perf_counter()
-            fn()  # blocking by contract: completion, not dispatch
+            if chaos_on:
+                # the rep owns the collective site: an eager collective
+                # inside fn() must not re-inject the same fault
+                with chaoslib.suppress("collective"):
+                    fn()
+            else:
+                fn()  # blocking by contract: completion, not dispatch
             dt = time.perf_counter() - t0
             if rec is not None:
                 rec.mark_complete(label, t_disp, args={"seq": seq})

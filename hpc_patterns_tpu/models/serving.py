@@ -52,10 +52,44 @@ Production shape (round 6), three coupled levers:
   which preserves the law but not the draws — its oracle is
   distributional.
 
+Robustness shape (round 8) — the scenario layer for traffic that does
+not cooperate:
+
+- **priority classes + admission control**: requests carry a
+  ``priority`` (lower number = more important); admission serves
+  classes in priority order, a ``admit_highwater`` mark makes fresh
+  admissions back off before the pool is exhausted (headroom reserved
+  for resumes), and requests with a queue ``deadline_s`` are SHED once
+  it expires instead of silently aging;
+- **preemption-and-resume under memory pressure** (``preempt=True``):
+  when a higher-priority request cannot get pages, the lowest-priority
+  victim is EVICTED at a chunk boundary — its generated tokens and
+  (sampled mode) its per-row key state snapshot to host, its pages
+  return to the arena — and later RESUMED through the ordinary prefill
+  path with prompt = original prompt + generated-so-far. Causality
+  makes the resumed cache exactly the uninterrupted one, and the
+  split/pick order of ``_admit_row`` matches ``_chunk_step``'s, so a
+  preempted-and-resumed sequence's tokens are BYTE-IDENTICAL to an
+  uninterrupted run with the same request key (oracle-tested);
+- **open-loop serving** (``run(arrivals=...)``): requests enter on the
+  schedule's clock (harness/loadgen.py), not on completion — overload
+  builds queues and blows deadlines where a closed loop would just
+  slow down;
+- **SLO accounting** (``slo={priority: harness.slo.SLOTarget}``):
+  per-class TTFT/TPOT tracking against declared targets; after each
+  run ``last_slo`` carries the attainment rollup and goodput
+  (SLO-attained tok/s) lands next to raw tok/s in the metrics
+  registry;
+- **chaos hook**: each scheduler round probes
+  ``harness.chaos.maybe_inject("engine_round", ...)`` so a seeded
+  stalled-host fault perturbs the real loop (and shows up as bubble in
+  the trace rollups).
+
 Correctness contract (oracle-tested): every admitted sequence's
 emitted tokens are exactly ``paged_generate``'s for the same prompt,
 budget, and (when sampling) per-request key, regardless of what was
-scheduled around it.
+scheduled around it — including sequences preempted and resumed along
+the way.
 
 Reference lineage: the benchmark-IS-the-test discipline
 (aurora.mpich.miniapps/src/CMakeLists.txt:39-50) — the engine's
@@ -66,6 +100,7 @@ oracle on every run.
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
 
@@ -74,7 +109,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from hpc_patterns_tpu.harness import chaos as chaoslib
 from hpc_patterns_tpu.harness import metrics as metricslib
+from hpc_patterns_tpu.harness import slo as slolib
 from hpc_patterns_tpu.harness import trace as tracelib
 from hpc_patterns_tpu.models.decode import (
     _pick,
@@ -130,13 +167,21 @@ class Request:
     queue entry so admission can attribute time-to-first-token.
     ``temperature``/``key``: per-request sampling overrides (None =
     the engine's defaults; the default key is
-    ``ContinuousBatcher.request_key(seq_id)``)."""
+    ``ContinuousBatcher.request_key(seq_id)``). ``priority``: lower
+    number = more important (admission order; preemption eligibility).
+    ``deadline_s``: queue-time shedding deadline relative to submit
+    (None = never shed). ``resume_prefix``: internal — tokens this
+    request already emitted before being preempted; its prompt then
+    already carries them, and the engine prepends them to the output."""
     prompt: np.ndarray
     max_new: int
     seq_id: int = -1
     t_submit: float = 0.0
     temperature: float | None = None
     key: jax.Array | None = None
+    priority: int = 0
+    deadline_s: float | None = None
+    resume_prefix: np.ndarray | None = None
 
 
 @dataclass
@@ -151,6 +196,11 @@ class _Slot:
     t_admit: float = 0.0
     t_dispatch: float = 0.0  # admission-dispatch trace stamp
     first_dev: jax.Array | None = None  # pending first-token readback
+    prompt: np.ndarray | None = None  # THIS admission's unpadded prompt
+    priority: int = 0
+    deadline_s: float | None = None
+    temp_override: float | None = None
+    prefix: list = field(default_factory=list)  # pre-preemption tokens
 
 
 @partial(jax.jit,
@@ -364,6 +414,21 @@ class ContinuousBatcher:
     rounds run the live rejection-sampling acceptance — emitted law
     exactly target-only sampling, draws not reproducible row-wise
     (the distribution oracle covers it).
+
+    ``preempt``: allow eviction of a lower-priority active row when a
+    higher-priority (numerically smaller) request cannot get pages —
+    the victim's tokens and key state snapshot to host at a chunk
+    boundary, its pages return to the arena, and it re-enters through
+    the ordinary prefill path with prompt = original + generated, so
+    its final output is byte-identical to an uninterrupted run.
+    ``admit_highwater``: fraction of pool pages FRESH admissions may
+    fill (1.0 = off); the remainder is headroom reserved for resumes
+    (fresh admissions back off, resumes bypass the mark). ``slo``:
+    ``{priority: harness.slo.SLOTarget}`` — enables per-class
+    TTFT/TPOT tracking; after each :meth:`run`, ``last_slo`` holds the
+    attainment rollup (goodput next to raw tok/s) and the
+    ``serve.goodput_tok_s``/``serve.tok_s`` gauges are set. Per-request
+    outcomes accumulate in ``stats`` either way.
     """
 
     def __init__(self, params, cfg: TransformerConfig, *, slots: int,
@@ -373,7 +438,9 @@ class ContinuousBatcher:
                  = None, gamma: int = 4, emit=None,
                  prompt_buckets=None, overlap: bool = True,
                  temperature: float = 0.0, top_k: int = 0,
-                 seed: int = 0):
+                 seed: int = 0, preempt: bool = False,
+                 admit_highwater: float = 1.0,
+                 slo: dict[int, slolib.SLOTarget] | None = None):
         if cfg.n_experts:
             # paged serving is dense-model territory so far
             raise ValueError("continuous batching: dense models only")
@@ -396,8 +463,14 @@ class ContinuousBatcher:
                     f"bucket rung {rungs[-1]} exceeds max_seq "
                     f"{cfg.max_seq} (padded prompts must still fit)")
             prompt_buckets = rungs
+        if not 0.0 < admit_highwater <= 1.0:
+            raise ValueError(
+                f"admit_highwater must be in (0, 1], got {admit_highwater}")
         self.prompt_buckets = prompt_buckets
         self.overlap = bool(overlap)
+        self.preempt = bool(preempt)
+        self.admit_highwater = float(admit_highwater)
+        self.slo = slo
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.greedy = self.temperature <= 0.0
@@ -433,6 +506,7 @@ class ContinuousBatcher:
                 pool_pages=pool_pages + 1, table=jnp.asarray(table),
             )
         self.free_pages = list(range(pool_pages))
+        self.pool_pages = pool_pages  # arena size (trash page excluded)
         self._table = table  # host mirror
         self.pos = jnp.zeros((slots,), jnp.int32)
         self.limit = jnp.zeros((slots,), jnp.int32)
@@ -445,6 +519,12 @@ class ContinuousBatcher:
         self.finished: dict[int, np.ndarray] = {}
         self._next_id = 0
         self.last_bubble_frac = 0.0  # of the most recent run()
+        # per-request outcome table (harness/slo.py's input): t_submit /
+        # t_first / t_finish / tokens / priority / outcome ("ok"|"shed")
+        # / preemptions, keyed by seq_id; survives across runs
+        self.stats: dict[int, dict] = {}
+        self.last_slo: dict | None = None  # attainment of the last run
+        self._serve_s = 0.0  # cumulative run() wall time (goodput base)
         # observability hook (the framework's metrics/logging
         # subsystem, SURVEY.md §5): a callable taking keyword fields —
         # pass harness.RunLog.emit for JSONL records of admissions,
@@ -484,11 +564,16 @@ class ContinuousBatcher:
         return jax.random.fold_in(self._req_key_base, seq_id)
 
     def submit(self, prompt, max_new: int, seq_id: int | None = None, *,
-               temperature: float | None = None, key=None) -> int:
+               temperature: float | None = None, key=None,
+               priority: int = 0, deadline_s: float | None = None) -> int:
         """Enqueue a sequence; returns its id. Tokens appear in
         ``finished[id]`` once served. ``temperature``/``key``: per-row
         sampling overrides (sampling engines only; key defaults to
-        :meth:`request_key`)."""
+        :meth:`request_key`). ``priority``: lower = more important
+        (admission order; with ``preempt=True``, may evict
+        numerically-higher classes under page pressure).
+        ``deadline_s``: shed the request (empty output, outcome
+        ``"shed"``) if still queued this long after submit."""
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1 or prompt.size == 0:
             raise ValueError(f"prompt must be 1-D nonempty, {prompt.shape}")
@@ -534,30 +619,101 @@ class ContinuousBatcher:
                 "would silently merge under one key"
             )
         self._next_id = max(self._next_id, sid) + 1
-        self._queue.append(Request(prompt, max_new, sid,
-                                   t_submit=time.perf_counter(),
-                                   temperature=temperature, key=key))
+        now = time.perf_counter()
+        self._queue.append(Request(prompt, max_new, sid, t_submit=now,
+                                   temperature=temperature, key=key,
+                                   priority=int(priority),
+                                   deadline_s=deadline_s))
+        self.stats[sid] = {
+            "priority": int(priority), "t_submit": now, "t_first": None,
+            "t_finish": None, "tokens": 0, "outcome": None,
+            "preemptions": 0,
+        }
         metricslib.get_metrics().gauge("serve.queue_depth").set(
             len(self._queue))
         return sid
 
-    def _try_admit(self, overlapped: bool = False) -> bool:
-        """Admit the longest-waiting request that fits a free slot and
-        the free page list. FCFS with skip: a large request at the head
-        does not block a small one behind it (documented head-of-line
-        tradeoff; flip to strict FCFS by breaking instead of
-        continuing)."""
-        free_slot = next(
-            (i for i, s in enumerate(self._slots) if not s.active), None)
-        if free_slot is None:
-            return False
-        for qi, req in enumerate(self._queue):
+    def _queue_order(self) -> list[int]:
+        """Queue indices in admission order: priority class first
+        (lower number = more important), resumes before fresh arrivals
+        within a class (a preempted row's pages were taken FROM it; it
+        re-enters ahead of new same-class work), FCFS within that."""
+        return sorted(
+            range(len(self._queue)),
+            key=lambda qi: (self._queue[qi].priority,
+                            self._queue[qi].resume_prefix is None, qi))
+
+    def _shed_expired(self) -> None:
+        """Admission control, shed side: queued FRESH requests whose
+        ``deadline_s`` expired are dropped with an empty output and
+        outcome ``"shed"`` (resumes are exempt — their tokens are
+        already paid for and preemption guarantees re-admission).
+        Host-list bookkeeping only: no device op, nothing dispatched."""
+        if not any(req.deadline_s is not None
+                   and req.resume_prefix is None
+                   for req in self._queue):
+            return  # deadline-free traffic: the common fast path
+        now = time.perf_counter()
+        kept = []
+        for req in self._queue:
+            if (req.deadline_s is None or req.resume_prefix is not None
+                    or now - req.t_submit <= req.deadline_s):
+                kept.append(req)
+                continue
+            self.finished[req.seq_id] = np.zeros((0,), np.int32)
+            rec = self.stats.get(req.seq_id)
+            if rec is not None:
+                rec["outcome"] = "shed"
+                rec["t_finish"] = now
+            self._emit(kind="serve_shed", seq_id=req.seq_id,
+                       priority=req.priority,
+                       waited_s=now - req.t_submit,
+                       deadline_s=req.deadline_s)
+            m = metricslib.get_metrics()
+            if m.enabled:
+                m.counter("serve.shed").inc()
+        self._queue = kept
+        metricslib.get_metrics().gauge("serve.queue_depth").set(
+            len(self._queue))
+
+    def _try_admit(self, overlapped: bool = False) -> int:
+        """ONE admission pass per scheduler round: shed, then walk the
+        queue in admission order — priority classes first, resumes
+        before fresh arrivals within a class, FCFS with skip inside
+        that (a large request does not block a small one behind it —
+        the documented head-of-line tradeoff) — admitting every
+        request that fits. Fresh admissions respect
+        ``admit_highwater``: past the mark they back off and stay
+        queued (headroom for resumes); resumes bypass it. One shed
+        scan and one order sort per ROUND (the admission window is the
+        measured bubble; bookkeeping must not inflate it). Admissions
+        only consume slots/pages, so a request skipped earlier in the
+        pass cannot become admissible later in it — the single sorted
+        walk decides exactly what a per-admission re-sort would.
+        Returns the number admitted."""
+        self._shed_expired()
+        order = [self._queue[qi] for qi in self._queue_order()]
+        admitted = 0
+        for req in order:
+            free_slot = next(
+                (i for i, s in enumerate(self._slots) if not s.active),
+                None)
+            if free_slot is None:
+                break
             need = self._pages_for(req.prompt.size, req.max_new)
-            if need <= len(self.free_pages):
-                self._queue.pop(qi)
-                self._admit(free_slot, req, need, overlapped)
-                return True
-        return False
+            # ONE admissibility definition (_admissible): the policy
+            # _maybe_preempt predicts with must be the one applied here
+            if not self._admissible(need,
+                                    fresh=req.resume_prefix is None):
+                continue
+            # identity-keyed removal BEFORE _admit (whose telemetry
+            # reads the queue depth): Request is a value dataclass
+            # holding ndarrays, so list.remove/__eq__ would be both
+            # ambiguous and wrong here
+            self._queue = [r for r in self._queue if r is not req]
+            self._admit(free_slot, req, need, overlapped)
+            admitted += 1
+        return admitted
 
     def _admit(self, slot: int, req: Request, need: int,
                overlapped: bool):
@@ -632,6 +788,12 @@ class ContinuousBatcher:
         st.first_dev = first_dev
         st.t_submit = req.t_submit
         st.t_admit = time.perf_counter()
+        st.prompt = req.prompt
+        st.priority = req.priority
+        st.deadline_s = req.deadline_s
+        st.temp_override = req.temperature
+        st.prefix = ([] if req.resume_prefix is None
+                     else [int(t) for t in req.resume_prefix])
         rec = tracelib.active()
         if rec is not None:
             # all admission device work (table upload, prefill, first-
@@ -650,7 +812,8 @@ class ContinuousBatcher:
                    pages=need, prompt_len=T, padded_len=padded,
                    budget=req.max_new, overlapped=overlapped,
                    free_pages=len(self.free_pages),
-                   queued=len(self._queue))
+                   queued=len(self._queue), priority=req.priority,
+                   resumed=req.resume_prefix is not None)
         m = metricslib.get_metrics()
         if m.enabled:
             m.gauge("serve.queue_depth").set(len(self._queue))
@@ -669,7 +832,10 @@ class ContinuousBatcher:
             st = self._slots[slot]
             first = int(jax.device_get(st.first_dev))
             st.first_dev = None
-            st.out = [first]
+            # a resumed row's output re-opens with everything it had
+            # already emitted before preemption (its prompt carries
+            # those tokens, so the device never re-emits them)
+            st.out = list(st.prefix) + [first]
             rec = tracelib.active()
             if rec is not None and st.t_dispatch:
                 # the readback IS completion: the admission's device
@@ -678,13 +844,22 @@ class ContinuousBatcher:
                                   {"seq_id": st.seq_id, "slot": slot},
                                   track=slot + 1)
                 st.t_dispatch = 0.0
+            now = time.perf_counter()
+            rec_s = self.stats.get(st.seq_id)
+            resumed = bool(st.prefix)
+            if rec_s is not None and rec_s["t_first"] is None:
+                rec_s["t_first"] = now
             m = metricslib.get_metrics()
-            if m.enabled:
+            if m.enabled and not resumed:
                 # prefill emitted the first token: its readback IS
-                # first-token availability (TTFT counted from submit)
-                m.histogram("serve.ttft_s").observe(
-                    time.perf_counter() - (st.t_submit
-                                           or time.perf_counter()))
+                # first-token availability (TTFT counted from submit;
+                # a resume keeps its ORIGINAL first-token time — the
+                # user saw it before the preemption)
+                ttft = now - (st.t_submit or now)
+                m.histogram("serve.ttft_s").observe(ttft)
+                if self.slo is not None:
+                    m.histogram(
+                        f"serve.ttft_s.p{st.priority}").observe(ttft)
             if (self.eos_id >= 0 and first == self.eos_id) \
                     or st.budget == 1:
                 self._finish(slot)
@@ -692,20 +867,13 @@ class ContinuousBatcher:
 
     # -- completion --------------------------------------------------------
 
-    def _finish(self, slot: int):
+    def _release_slot(self, slot: int):
+        """Return a row's pages to the arena and reset its cursors —
+        the shared tail of completion AND eviction. The table upload is
+        dispatch-only; pos/limit zeroing freezes the row out of future
+        chunks (stale keys/temps in an inactive row are never
+        consumed)."""
         st = self._slots[slot]
-        self.finished[st.seq_id] = np.asarray(st.out, np.int32)
-        self._emit(kind="serve_finish", seq_id=st.seq_id, slot=slot,
-                   tokens=len(st.out), pages_freed=len(st.pages))
-        m = metricslib.get_metrics()
-        if m.enabled:
-            dt = time.perf_counter() - st.t_admit
-            m.histogram("serve.per_token_s").observe(
-                dt / max(1, len(st.out)))
-            m.counter("serve.finished").inc()
-            m.counter("serve.tokens").inc(len(st.out))
-            m.gauge("serve.free_pages").set(
-                len(self.free_pages) + len(st.pages))
         self.free_pages.extend(st.pages)
         self._table[slot] = self.trash
         self.cache["table"] = jnp.asarray(self._table)
@@ -714,6 +882,168 @@ class ContinuousBatcher:
         self._slots[slot] = _Slot()
         self.pos = self.pos.at[slot].set(0)
         self.limit = self.limit.at[slot].set(0)
+
+    def _finish(self, slot: int):
+        st = self._slots[slot]
+        self.finished[st.seq_id] = np.asarray(st.out, np.int32)
+        self._emit(kind="serve_finish", seq_id=st.seq_id, slot=slot,
+                   tokens=len(st.out), pages_freed=len(st.pages))
+        now = time.perf_counter()
+        rec_s = self.stats.get(st.seq_id)
+        if rec_s is not None:
+            rec_s["t_finish"] = now
+            rec_s["tokens"] = len(st.out)
+            rec_s["outcome"] = "ok"
+        m = metricslib.get_metrics()
+        if m.enabled:
+            dt = now - st.t_admit
+            m.histogram("serve.per_token_s").observe(
+                dt / max(1, len(st.out)))
+            if self.slo is not None and rec_s is not None \
+                    and rec_s["t_first"] is not None and len(st.out) > 1:
+                m.histogram(f"serve.tpot_s.p{st.priority}").observe(
+                    (now - rec_s["t_first"]) / (len(st.out) - 1))
+            m.counter("serve.finished").inc()
+            m.counter("serve.tokens").inc(len(st.out))
+            m.gauge("serve.free_pages").set(
+                len(self.free_pages) + len(st.pages))
+        self._release_slot(slot)
+
+    # -- preemption --------------------------------------------------------
+
+    def _admissible(self, need: int, fresh: bool) -> bool:
+        """Would a request needing ``need`` pages admit right now?
+        (free slot + free pages + the fresh-admission high-water mark
+        — the same three checks :meth:`_try_admit` applies)."""
+        if not any(not s.active for s in self._slots):
+            return False
+        if need > len(self.free_pages):
+            return False
+        if fresh:
+            used = self.pool_pages - len(self.free_pages)
+            if used + need > self.admit_highwater * self.pool_pages:
+                return False
+        return True
+
+    def _can_resume(self, slot: int) -> bool:
+        """Is this active row safely evictable? Its resume request
+        (prompt = this admission's prompt + tokens generated since)
+        must fit the bucket ladder, the per-sequence table width, and
+        the arena — a victim whose resume could never re-admit must
+        not be evicted. Host bookkeeping only; no device op."""
+        st = self._slots[slot]
+        if not st.active or slot in self._pending or st.prompt is None:
+            return False
+        emitted = len(st.out) - len(st.prefix)
+        remaining = st.budget - emitted
+        if remaining < 1:
+            return False  # about to finish; nothing left to resume
+        resumed_len = int(st.prompt.size) + emitted
+        if self.prompt_buckets is not None \
+                and resumed_len > max(self.prompt_buckets):
+            return False
+        pages = self._pages_for(resumed_len, remaining)
+        return pages <= min(self.pages_per_seq, self.pool_pages)
+
+    def _maybe_preempt(self):
+        """Preemption policy, decision half (runs at a chunk boundary,
+        nothing in flight): when the most urgent waiting request cannot
+        be admitted for lack of pages, evict strictly-lower-priority
+        victims — lowest class first, most recently admitted first
+        within a class (least sunk latency) — until it fits or no
+        eligible victim remains. Only the head of the admission order
+        is served per round (starvation-free: it stays the head until
+        admitted)."""
+        # shed first: an already-expired request must not evict a
+        # victim only to be dropped by the admission pass right after
+        self._shed_expired()
+        if not self._queue:
+            return
+        order = self._queue_order()
+        req = self._queue[order[0]]
+        need = self._pages_for(req.prompt.size, req.max_new)
+        fresh = req.resume_prefix is None
+        if self._admissible(need, fresh):
+            return  # ordinary admission will take it this round
+        victims = [
+            v for v in sorted(
+                (i for i, s in enumerate(self._slots)
+                 if s.active and s.priority > req.priority),
+                key=lambda i: (-self._slots[i].priority,
+                               -self._slots[i].t_admit))
+            if self._can_resume(v)
+        ]
+        # feasibility BEFORE the first eviction: would evicting EVERY
+        # eligible victim actually admit the head? Pages held by
+        # non-victim rows (same-or-higher priority) still count toward
+        # the fresh high-water cap, so a head they keep over the mark
+        # must not trigger evictions — the victim's resume bypasses the
+        # mark and re-admits the same round, and the next round evicts
+        # it again: an evict/re-prefill thrash loop that collapses
+        # goodput while the head stays stuck regardless
+        freeable = sum(len(self._slots[v].pages) for v in victims)
+        if need > len(self.free_pages) + freeable:
+            return
+        if fresh:
+            used_after = (self.pool_pages - len(self.free_pages)
+                          - freeable)
+            if used_after + need > self.admit_highwater * self.pool_pages:
+                return
+        for v in victims:
+            if self._admissible(need, fresh):
+                break
+            self._preempt(v, for_sid=req.seq_id)
+
+    def _preempt(self, slot: int, for_sid: int | None = None):
+        """Evict one active row: snapshot its generated tokens and (in
+        sampled mode) its per-row key state to host, return its pages
+        to the arena, and re-queue it as a RESUME request whose prompt
+        is this admission's prompt + the tokens generated since.
+        Causality makes the resumed prefill's cache exactly the
+        uninterrupted one, and ``_admit_row`` consumes the snapshot key
+        with the same split/pick order ``_chunk_step`` would have — so
+        the resumed row's remaining tokens are byte-identical to never
+        having been preempted (the oracle in tests/test_serving.py)."""
+        st = self._slots[slot]
+        new = st.out[len(st.prefix):]
+        remaining = st.budget - len(new)
+        key = None
+        if not self.greedy:
+            # jaxlint: disable=host-sync-in-dispatch — eviction IS a
+            # deliberate sync point: it runs at a chunk boundary with
+            # the victim's last chunk already collected, and the key
+            # snapshot is the resume contract (np.array COPIES — the
+            # device_get view aliases a buffer _chunk_step donates)
+            key = jnp.asarray(np.array(jax.device_get(self.keys))[slot])
+        # jaxlint: disable=host-sync-in-dispatch — host-list packing,
+        # not a device readback: st.out/new are plain Python ints the
+        # collected chunks already materialized
+        new_arr = np.asarray(new, np.int32)
+        prompt = (np.concatenate([st.prompt, new_arr])
+                  if new else st.prompt)
+        req = Request(prompt, remaining, st.seq_id,
+                      t_submit=st.t_submit,
+                      temperature=st.temp_override, key=key,
+                      priority=st.priority, deadline_s=st.deadline_s,
+                      # jaxlint: disable=host-sync-in-dispatch — same
+                      # host-list packing as the prompt above
+                      resume_prefix=np.asarray(st.out, np.int32))
+        rec_s = self.stats.get(st.seq_id)
+        if rec_s is not None:
+            rec_s["preemptions"] += 1
+        self._emit(kind="serve_preempt", seq_id=st.seq_id, slot=slot,
+                   tokens_done=len(st.out), remaining=remaining,
+                   pages_freed=len(st.pages), priority=st.priority,
+                   for_seq_id=for_sid)
+        m = metricslib.get_metrics()
+        if m.enabled:
+            m.counter("serve.preempted").inc()
+            m.gauge("serve.free_pages").set(
+                len(self.free_pages) + len(st.pages))
+        self._release_slot(slot)
+        self._queue.append(req)
+        if m.enabled:
+            m.gauge("serve.queue_depth").set(len(self._queue))
 
     # -- the loop ----------------------------------------------------------
 
@@ -820,10 +1150,10 @@ class ContinuousBatcher:
             if pos_np[i] >= limit_np[i]:
                 self._finish(i)
 
-    def run(self):
-        """Serve until queue and slots drain. Returns ``finished``:
-        {seq_id: np.ndarray of emitted tokens (<= max_new; ends at
-        eos_id when enabled)}.
+    def run(self, *, arrivals=None, max_rounds: int | None = None):
+        """Serve until queue, slots, and (open-loop) arrivals drain.
+        Returns ``finished``: {seq_id: np.ndarray of emitted tokens
+        (<= max_new; ends at eos_id when enabled)}.
 
         Loop shape (``overlap=True``): DISPATCH the chunk for the rows
         already running, then do this round's admissions behind it —
@@ -834,20 +1164,76 @@ class ContinuousBatcher:
         admission-only iteration) is the ADMISSION BUBBLE; its fraction
         of the run lands in ``last_bubble_frac`` and the
         ``serve.admit_bubble_frac`` gauge. ``overlap=False`` keeps the
-        serial order (admit, then decode) — the measurable baseline."""
+        serial order (admit, then decode) — the measurable baseline.
+
+        ``arrivals``: OPEN-loop traffic — ``(t_rel_s, submit_kwargs)``
+        pairs; each is submitted once the run clock passes its arrival
+        instant (``harness/loadgen.py`` schedules replay this way —
+        see ``benchmarks/bench_serving.run_scenario``). The loop idles
+        in bounded sleeps when nothing is servable but arrivals remain:
+        open-loop means traffic comes on the USERS' clock, so overload
+        builds queues (and sheds / preempts) instead of slowing the
+        offered load. ``max_rounds``: return after this many scheduler
+        rounds — state parks at a chunk boundary and a later ``run()``
+        continues (the staged-scenario and preemption-test handle); a
+        bounded run never idle-waits for a future arrival (undelivered
+        arrivals are dropped — re-pass them to the continuing call).
+
+        Robustness hooks per round: the chaos injector's
+        ``engine_round`` site fires first (a seeded stalled-host fault
+        pauses the real loop), then the preemption policy runs at the
+        chunk boundary (nothing in flight), then the ordinary
+        dispatch/admit/collect round."""
         t_run0 = time.perf_counter()
         t_exposed = 0.0
         spec = self.draft_params is not None
         dispatch = self._dispatch_spec if spec else self._dispatch_chunk
         collect = self._collect_spec if spec else self._collect_chunk
-        while self._queue or any(s.active for s in self._slots):
+        pending_arrivals = (deque(sorted(arrivals, key=lambda a: a[0]))
+                            if arrivals else None)
+        chaos_on = chaoslib.active() is not None
+        rounds = 0
+        while True:
+            if pending_arrivals:
+                now_rel = time.perf_counter() - t_run0
+                while pending_arrivals \
+                        and pending_arrivals[0][0] <= now_rel:
+                    t_arr, kw = pending_arrivals.popleft()
+                    sid = self.submit(**kw)
+                    # the request entered on the SCHEDULE's clock, not
+                    # when the loop got around to draining it: TTFT,
+                    # deadlines, and the gated goodput must charge the
+                    # queueing delay the user actually experienced
+                    # (the drain can lag a whole chunk round or an
+                    # injected stall behind the arrival instant)
+                    t_abs = t_run0 + t_arr
+                    self._queue[-1].t_submit = t_abs
+                    self.stats[sid]["t_submit"] = t_abs
+            if not (self._queue or any(s.active for s in self._slots)):
+                if not pending_arrivals:
+                    break
+                if max_rounds is not None:
+                    # a bounded run parks at the chunk boundary — it
+                    # must not block idling for a future arrival
+                    break
+                # open-loop idle: nothing servable until the next
+                # arrival — wait on the schedule's clock, boundedly
+                wait = pending_arrivals[0][0] - (time.perf_counter()
+                                                 - t_run0)
+                time.sleep(min(max(wait, 0.0), 0.005))
+                continue
+            if max_rounds is not None and rounds >= max_rounds:
+                break
+            rounds += 1
+            if chaos_on:
+                chaoslib.maybe_inject("engine_round", rounds - 1)
+            if self.preempt:
+                self._maybe_preempt()
             inflight = None
             if self.overlap and any(s.active for s in self._slots):
                 inflight = dispatch()
             t0 = time.perf_counter()
-            admitted = 0
-            while self._try_admit(overlapped=inflight is not None):
-                admitted += 1
+            admitted = self._try_admit(overlapped=inflight is not None)
             self._resolve_pending()
             if inflight is None:
                 t_exposed += time.perf_counter() - t0
@@ -856,15 +1242,28 @@ class ContinuousBatcher:
                         raise RuntimeError(
                             "serving deadlock: waiting requests but no "
                             "admissible slot/pages (pool too small for "
-                            "the smallest waiting request)"
+                            "the smallest waiting request, or "
+                            "admit_highwater leaves it no headroom)"
                         )
                     continue  # everything admitted finished at admit
                 inflight = dispatch()
             collect(inflight)
         total = time.perf_counter() - t_run0
         self.last_bubble_frac = (t_exposed / total) if total > 0 else 0.0
+        self._serve_s += total
         m = metricslib.get_metrics()
         if m.enabled:
             m.gauge("serve.admit_bubble_frac").set(self.last_bubble_frac)
             m.gauge("serve.prefill_compiles").set(prefill_cache_size())
+        if self.slo is not None:
+            # goodput (SLO-attained tok/s) lands NEXT TO raw tok/s —
+            # the whole point of declaring targets; the base is the
+            # engine's cumulative serve time so re-used engines stay
+            # consistent across waves
+            self.last_slo = slolib.attainment(self.stats, self.slo,
+                                              self._serve_s)
+            if m.enabled:
+                tot = self.last_slo["total"]
+                m.gauge("serve.tok_s").set(tot["tok_s"])
+                m.gauge("serve.goodput_tok_s").set(tot["goodput_tok_s"])
         return self.finished

@@ -1,0 +1,216 @@
+"""Chaos injectors (harness/chaos.py): spec parsing, deterministic
+scheduling/jitter, the env/override precedence, and the live wiring
+into the two hot-path sites — the serving loop's ``engine_round`` and
+the eager Communicator's ``collective``. The launcher-level scenarios
+(straggler named by the merged rollup, worker death in the rank
+report) live in tests/test_launch.py; the serving-side preemption
+composition in tests/test_serving.py."""
+
+import time
+
+import numpy as np
+import pytest
+
+from hpc_patterns_tpu.harness import chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos(monkeypatch):
+    monkeypatch.delenv(chaos.ENV_CHAOS, raising=False)
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+class TestParse:
+    def test_straggler_spec(self):
+        (f,) = chaos.parse("straggler:rank=1,delay_ms=40")
+        assert f.kind == "straggler" and f.site == "collective"
+        assert f.rank == 1 and f.delay_s == pytest.approx(0.04)
+        assert f.every == 1  # stragglers recur by default
+
+    def test_stall_and_die_fire_once_by_default(self):
+        stall, die = chaos.parse("stall:at=3,delay_ms=100;die:rank=0,at=5")
+        assert stall.site == "engine_round" and stall.every == 0
+        assert stall.matches("engine_round", 3, 0)
+        assert not stall.matches("engine_round", 4, 0)
+        assert die.every == 0 and die.exit_code is None
+
+    def test_every_and_at_schedule(self):
+        (f,) = chaos.parse("straggler:delay_ms=1,at=2,every=4")
+        fired = [i for i in range(12) if f.matches("collective", i, 0)]
+        assert fired == [2, 6, 10]
+
+    def test_rank_filter(self):
+        (f,) = chaos.parse("straggler:rank=1,delay_ms=1")
+        assert f.matches("collective", 0, 1)
+        assert not f.matches("collective", 0, 0)
+        (g,) = chaos.parse("straggler:delay_ms=1")  # rank omitted = all
+        assert g.matches("collective", 0, 0) and g.matches("collective", 0, 7)
+
+    def test_bad_specs_raise(self):
+        # a typo'd spec silently injecting nothing would fake a healthy
+        # run out of a chaos scenario — every unknown token is an error
+        with pytest.raises(ValueError, match="unknown chaos kind"):
+            chaos.parse("stragler:delay_ms=1")
+        with pytest.raises(ValueError, match="unknown chaos key"):
+            chaos.parse("straggler:delay=1")
+        with pytest.raises(ValueError, match="unknown chaos site"):
+            chaos.parse("straggler:site=nowhere")
+
+    def test_deterministic_jitter(self):
+        (f,) = chaos.parse("straggler:delay_ms=10,jitter_ms=10,seed=3")
+        a = [f.delay_at("collective", i) for i in range(8)]
+        b = [f.delay_at("collective", i) for i in range(8)]
+        assert a == b  # pure hash: a replay is the same perturbation
+        assert all(0.01 <= d <= 0.02 for d in a)
+        assert len(set(a)) > 1  # and it IS jitter, not a constant
+        (g,) = chaos.parse("straggler:delay_ms=10,jitter_ms=10,seed=4")
+        assert [g.delay_at("collective", i) for i in range(8)] != a
+
+
+class TestActivation:
+    def test_env_spec_parsed_and_cached(self, monkeypatch):
+        monkeypatch.setenv(chaos.ENV_CHAOS, "stall:at=1,delay_ms=5")
+        (f,) = chaos.active()
+        assert f.kind == "stall"
+        assert chaos.active()[0] is f  # cached per env value
+
+    def test_configure_overrides_env_and_none_disables(self, monkeypatch):
+        monkeypatch.setenv(chaos.ENV_CHAOS, "stall:at=1,delay_ms=5")
+        chaos.configure("straggler:delay_ms=1")
+        assert chaos.active()[0].kind == "straggler"
+        chaos.configure(None)  # explicitly OFF, env notwithstanding
+        assert chaos.active() is None
+        chaos.reset()
+        assert chaos.active()[0].kind == "stall"
+
+    def test_no_spec_means_no_chaos(self):
+        assert chaos.active() is None
+        chaos.maybe_inject("collective", 0)  # no-op, no log
+        assert chaos.injections() == ()
+
+    def test_process_id_env_is_the_rank(self, monkeypatch):
+        # stays a literal in chaos.py so it imports jax-free; must
+        # match topology's constant (same discipline as analysis/runtime)
+        from hpc_patterns_tpu import topology
+
+        assert chaos.ENV_PROCESS_ID == topology.ENV_PROCESS_ID
+        chaos.configure("stall:rank=3,at=0,delay_ms=0")
+        monkeypatch.setenv(chaos.ENV_PROCESS_ID, "3")
+        chaos.maybe_inject("engine_round", 0)
+        assert len(chaos.injections()) == 1
+        monkeypatch.setenv(chaos.ENV_PROCESS_ID, "2")
+        chaos.configure("stall:rank=3,at=0,delay_ms=0")
+        chaos.maybe_inject("engine_round", 0)
+        assert chaos.injections() == ()
+
+
+class TestInjection:
+    def test_straggler_sleeps_and_logs(self):
+        chaos.configure("straggler:delay_ms=30,at=1")
+        t0 = time.perf_counter()
+        chaos.maybe_inject("collective", 0)  # below at: no delay
+        assert time.perf_counter() - t0 < 0.02
+        t0 = time.perf_counter()
+        chaos.maybe_inject("collective", 1)
+        assert time.perf_counter() - t0 >= 0.03
+        log = chaos.injections()
+        assert [e["index"] for e in log] == [1]
+        assert log[0]["delay_s"] == pytest.approx(0.03)
+
+    def test_engine_round_site_stalls_the_serving_loop(self):
+        # the REAL wiring: ContinuousBatcher.run probes engine_round
+        # once per scheduler round, so a seeded stall pauses the loop
+        import jax
+
+        from hpc_patterns_tpu.models import TransformerConfig, init_params
+        from hpc_patterns_tpu.models.serving import ContinuousBatcher
+
+        cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, max_seq=64,
+                                dtype="float32")
+        params = init_params(jax.random.PRNGKey(0), cfg)
+
+        def serve():
+            eng = ContinuousBatcher(params, cfg, slots=1, pool_pages=3,
+                                    pages_per_seq=3, page_size=8,
+                                    chunk=2)
+            sid = eng.submit(np.arange(5, dtype=np.int32), 8)
+            t0 = time.perf_counter()
+            got = eng.run()[sid]
+            return got, time.perf_counter() - t0
+
+        clean, _t_clean = serve()
+        chaos.configure("stall:at=1,delay_ms=120")
+        stalled, t_stalled = serve()
+        hits = [e for e in chaos.injections()
+                if e["site"] == "engine_round"]
+        assert len(hits) == 1 and hits[0]["index"] == 1
+        # race-free floor: the run CONTAINS the 120ms sleep, so its
+        # wall clock cannot undercut it (comparing against a one-shot
+        # clean baseline was load-flaky)
+        assert t_stalled >= 0.12
+        # a stalled host is a LATENCY fault, not a correctness one
+        np.testing.assert_array_equal(stalled, clean)
+
+    def test_collective_site_delays_timed_reps(self):
+        # the other half of the straggler wiring: harness.timing.measure
+        # probes the collective site per timed rep (the rep IS the
+        # launched benchmarks' collective loop — PR 5's skew-fan
+        # identification), on the disabled fast path too
+        from hpc_patterns_tpu.harness import timing
+
+        chaos.configure("straggler:delay_ms=30,at=1")
+        t0 = time.perf_counter()
+        r = timing.measure(lambda: None, repetitions=3, warmup=0)
+        elapsed = time.perf_counter() - t0
+        assert len(r.times_s) == 3
+        hits = [e["index"] for e in chaos.injections()
+                if e["site"] == "collective"]
+        assert hits == [1, 2]
+        assert elapsed >= 0.06
+        # the delay lands BEFORE each rep's clock starts (a late START,
+        # the straggler shape) — the rep times themselves stay honest
+        assert max(r.times_s) < 0.03
+
+    def test_timed_rep_claims_the_collective_site(self):
+        # an eager collective INSIDE a timed rep must not re-inject
+        # the fault the rep already injected — the rep IS the
+        # collective in the skew-fan identification, and a double
+        # delay would misstate the declared spec
+        from hpc_patterns_tpu.harness import timing
+
+        chaos.configure("straggler:delay_ms=0")
+
+        def fn():
+            chaos.maybe_inject("collective", 99)  # the inner probe
+
+        timing.measure(fn, repetitions=2, warmup=0)
+        assert [e["index"] for e in chaos.injections()] == [0, 1]
+        # outside a rep the inner probe fires normally
+        fn()
+        assert [e["index"] for e in chaos.injections()] == [0, 1, 99]
+
+    def test_collective_site_delays_the_communicator_hot_path(self):
+        # the straggler wiring: the eager Communicator probes the
+        # collective site per collective (seq-indexed), so the injected
+        # delay lands inside the measured issue path
+        import jax
+        from jax.sharding import Mesh
+
+        from hpc_patterns_tpu.comm.communicator import Communicator
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("x",))
+        comm = Communicator(mesh, "x")
+        x = comm.rank_filled(8)
+        comm.allreduce(x)  # seq 0: warm the compile un-delayed
+        chaos.configure("straggler:delay_ms=40,at=1")
+        t0 = time.perf_counter()
+        out = comm.allreduce(x)  # seq 1
+        assert time.perf_counter() - t0 >= 0.04
+        hits = [e for e in chaos.injections()
+                if e["site"] == "collective"]
+        assert [e["index"] for e in hits] == [1]
+        np.testing.assert_allclose(
+            np.asarray(out)[0], comm.expected_allreduce_value())

@@ -201,6 +201,10 @@ class TestLauncherMechanics:
                 for l in (tmp_path / "run.jsonl").read_text().splitlines()]
         assert recs[-1]["kind"] == "trace_merged"
         assert recs[-1]["n_ranks"] == 1
+        # the hung rank is a TIMEOUT in the fault record, not a
+        # worker death — the launcher's own kill must not read as the
+        # chaos 'die' signature
+        assert recs[-1]["faults"] == {"0": "timeout", "1": "clean"}
 
 
 class TestCollectiveScheduleLaunch:
@@ -277,6 +281,137 @@ class TestCollectiveScheduleLaunch:
         assert "rank 0: is at allreduce.collective#17" in printed
         assert "2 collective(s) issued" in printed
         assert "rank 1 (exited): was at sendrecv_ring#17" in printed
+
+
+class TestChaosLaunch:
+    """Chaos scenarios verified THROUGH the rollups (tier-1, jax-free
+    workers driving the real recording paths): the injected straggler
+    is the rank the straggler table names, a chaos-killed worker's
+    fault kind lands in the rank report while the survivors' traces
+    still merge, and a transient failure recovers under the launcher's
+    bounded retry."""
+
+    def test_straggler_rank_named_by_merged_rollup(self, tmp_path,
+                                                   capsys):
+        # HPCPAT_CHAOS (via --chaos) delays every collective on rank 1
+        # by 150ms in the same pre-dispatch position the Communicator
+        # hot path injects at; the merged rollup must NAME rank 1 from
+        # the windows — straggler table, skew fan — and the schedule
+        # verifier must stay consistent (a straggler is late, not
+        # divergent). A file barrier kills process-spawn skew so the
+        # injected delay dominates the timeline.
+        out, log = tmp_path / "merged.json", tmp_path / "run.jsonl"
+        worker = (
+            "import os, time\n"
+            "from hpc_patterns_tpu.harness import chaos, trace\n"
+            "from hpc_patterns_tpu.analysis import runtime as rt\n"
+            "pid = int(os.environ['HPCPAT_PROCESS_ID'])\n"
+            "d = os.environ['HPCPAT_TRACE_DIR']\n"
+            "rec = trace.TraceRecorder(enabled=True)\n"
+            "rt.reset_collective_schedule()\n"
+            "open(os.path.join(d, f'ready{pid}'), 'w').close()\n"
+            "while not all(os.path.exists(os.path.join(d, f'ready{q}'))\n"
+            "              for q in (0, 1)):\n"
+            "    time.sleep(0.005)\n"
+            "for seq in range(3):\n"
+            "    chaos.maybe_inject('collective', seq)\n"
+            "    t = rec.mark_dispatch('comm.allreduce', {'seq': seq})\n"
+            "    rt.record_collective('allreduce.collective', seq,\n"
+            "                         shape=(2, 8), dtype='float32',\n"
+            "                         axis='x')\n"
+            "    time.sleep(0.01)\n"
+            "    rec.mark_complete('comm.allreduce', t, {'seq': seq})\n"
+            "trace.write_rank_snapshot(rec, d)\n"
+        )
+        code = launch.main([
+            "-np", "2", "--trace-out", str(out), "--log", str(log),
+            "--trace-dir", str(tmp_path / "ranks"),
+            "--chaos", "straggler:rank=1,delay_ms=150",
+            "--", sys.executable, "-c", worker,
+        ])
+        printed = capsys.readouterr().out
+        assert code == 0, printed
+        assert ("straggler: rank 1 finished last in 3/3 matched "
+                "collective(s)") in printed
+        assert "collective schedules consistent across 2 rank(s)" in printed
+        recs = [json.loads(l) for l in log.read_text().splitlines()]
+        rollup = [r for r in recs if r["kind"] == "trace_merged"][0]
+        assert rollup["stragglers"]["1"]["last"] == 3
+        assert rollup["stragglers"]["0"]["last"] == 0
+        # the skew fan carries the injected delay, not just its sign
+        skew = rollup["skew"]["comm.allreduce"]
+        assert skew["max_start_skew_s"] > 0.1
+        assert rollup["schedule"]["verdict"] == "consistent"
+
+    def test_worker_death_fault_kind_and_partial_merge(self, tmp_path,
+                                                       capsys):
+        # a chaos-killed worker (SIGKILL at collective 1 — no exit
+        # handler, exactly an OOM-killed rank) must land in the rank
+        # report WITH its fault kind and last collective fingerprint,
+        # and the surviving rank's trace must still merge
+        out, log = tmp_path / "merged.json", tmp_path / "run.jsonl"
+        worker = (
+            "import os, time\n"
+            "from hpc_patterns_tpu.harness import chaos, trace\n"
+            "from hpc_patterns_tpu.analysis import runtime as rt\n"
+            "pid = int(os.environ['HPCPAT_PROCESS_ID'])\n"
+            "rec = trace.TraceRecorder(enabled=True)\n"
+            "rt.reset_collective_schedule()\n"
+            "for seq in range(3):\n"
+            "    rt.record_collective('allreduce.collective', seq)\n"
+            "    chaos.maybe_inject('collective', seq)\n"
+            "trace.write_rank_snapshot(rec,\n"
+            "                          os.environ['HPCPAT_TRACE_DIR'])\n"
+        )
+        code = launch.main([
+            "-np", "2", "--trace-out", str(out), "--log", str(log),
+            "--trace-dir", str(tmp_path / "ranks"),
+            "--chaos", "die:rank=1,at=1",
+            "--", sys.executable, "-c", worker,
+        ])
+        printed = capsys.readouterr().out
+        assert code == 1
+        assert "rank 1: fault: killed (SIGKILL)" in printed
+        # the progress file names the collective it died inside
+        assert "rank 1: was at allreduce.collective#1" in printed
+        assert "only 1/2 rank snapshot(s) harvested" in printed
+        assert "FAILURE" in printed
+        recs = [json.loads(l) for l in log.read_text().splitlines()]
+        rollup = [r for r in recs if r["kind"] == "trace_merged"][0]
+        assert rollup["n_ranks"] == 1  # the survivor merged anyway
+        assert rollup["faults"] == {"0": "clean",
+                                    "1": "killed (SIGKILL)"}
+
+    def test_bad_chaos_spec_is_an_error(self, capsys):
+        assert launch.main([
+            "-np", "1", "--chaos", "stragler:delay_ms=1", "--",
+            sys.executable, "-c", "pass",
+        ]) == 2
+        assert "bad --chaos spec" in capsys.readouterr().out
+
+    def test_bounded_retry_recovers_transient_failure(self, tmp_path,
+                                                      capsys):
+        # each rank fails its FIRST attempt (marker file protocol) and
+        # succeeds the second: --retry 1 must relaunch after backoff
+        # and exit 0; without retries the same launch fails
+        marker = tmp_path / "attempt"
+        worker = (
+            "import os, sys\n"
+            f"m = {str(marker)!r} + os.environ['HPCPAT_PROCESS_ID']\n"
+            "if not os.path.exists(m):\n"
+            "    open(m, 'w').close()\n"
+            "    sys.exit(3)\n"
+            "sys.exit(0)\n"
+        )
+        code = launch.main([
+            "-np", "2", "--retry", "1", "--retry-backoff", "0.1",
+            "--", sys.executable, "-c", worker,
+        ])
+        printed = capsys.readouterr().out
+        assert code == 0, printed
+        assert "rank 0: fault: exit 3" in printed
+        assert "retrying launch (attempt 2/2)" in printed
+        assert "FAILURE" in printed and "SUCCESS" in printed
 
 
 class TestDistributedTraceMerge:

@@ -482,6 +482,256 @@ class TestOverlappedAdmission:
         assert admits[2]["overlapped"] is True
 
 
+class TestPreemptionAndResume:
+    """The preempt/resume oracle: a sequence evicted under forced page
+    starvation and later resumed must emit BYTE-IDENTICAL tokens to an
+    uninterrupted standalone run with the same request key — greedy
+    and sampled. The starvation is structural (pool sized one page
+    short of the high-priority arrival), not a timing accident."""
+
+    def _starved(self, cfg, params, events=None, **over):
+        # 4-page pool; the low-priority victim takes all 4, the
+        # 8-token-prompt high-priority arrival needs 2 — page-starved
+        # by construction until the victim is evicted
+        return ContinuousBatcher(
+            params, cfg, slots=2, pool_pages=4, pages_per_seq=4,
+            page_size=8, chunk=2, preempt=True,
+            prompt_buckets=(8, 16, 24, 32),
+            emit=(lambda **kw: events.append(kw)) if events is not None
+            else None, **over)
+
+    def test_preempted_and_resumed_tokens_exact_greedy(self):
+        cfg, params = _setup()
+        events = []
+        eng = self._starved(cfg, params, events)
+        pA = np.arange(5, dtype=np.int32)
+        pB = np.arange(8, dtype=np.int32) + 7
+        a = eng.submit(pA, 20, priority=1)  # needs all 4 pages
+        eng.run(max_rounds=3)               # A mid-generation
+        b = eng.submit(pB, 4, priority=0)   # starved -> must evict A
+        got = eng.run()
+        pre = [e for e in events if e["kind"] == "serve_preempt"]
+        assert [e["seq_id"] for e in pre] == [a]
+        assert pre[0]["for_seq_id"] == b
+        assert eng.stats[a]["preemptions"] == 1
+        # the oracle: byte-identical to never having been preempted
+        np.testing.assert_array_equal(got[a], _standalone(params, cfg,
+                                                          pA, 20))
+        np.testing.assert_array_equal(got[b], _standalone(params, cfg,
+                                                          pB, 4))
+        # the arena drained; the resumed admission was flagged as such
+        assert sorted(eng.free_pages) == list(range(4))
+        resumed = [e for e in events
+                   if e["kind"] == "serve_admit" and e["resumed"]]
+        assert [e["seq_id"] for e in resumed] == [a]
+
+    def test_preempted_and_resumed_sampled_key_stream_exact(self):
+        # the sharper half of the oracle: the victim's PER-ROW KEY
+        # STATE snapshots at eviction and the resume consumes it with
+        # the same split/pick order — so even SAMPLED draws are
+        # byte-identical to the uninterrupted standalone run
+        cfg, params = _setup()
+        eng = self._starved(cfg, params, temperature=0.8, top_k=8,
+                            seed=3)
+        pA = np.arange(5, dtype=np.int32)
+        pB = np.arange(8, dtype=np.int32) + 7
+        a = eng.submit(pA, 20, priority=1)
+        eng.run(max_rounds=3)
+        b = eng.submit(pB, 4, priority=0)
+        got = eng.run()
+        assert eng.stats[a]["preemptions"] == 1
+        np.testing.assert_array_equal(
+            got[a], _standalone(params, cfg, pA, 20,
+                                key=eng.request_key(a),
+                                temperature=0.8, top_k=8))
+        np.testing.assert_array_equal(
+            got[b], _standalone(params, cfg, pB, 4,
+                                key=eng.request_key(b),
+                                temperature=0.8, top_k=8))
+
+    def test_equal_priority_never_preempts(self):
+        # preemption is a PRIORITY mechanism, not a fairness one: an
+        # equal-priority arrival waits for pages like round 6 always did
+        cfg, params = _setup()
+        events = []
+        eng = self._starved(cfg, params, events)
+        pA = np.arange(5, dtype=np.int32)
+        a = eng.submit(pA, 12, priority=1)
+        eng.run(max_rounds=2)
+        b = eng.submit(np.arange(8, dtype=np.int32), 4, priority=1)
+        got = eng.run()
+        assert not [e for e in events if e["kind"] == "serve_preempt"]
+        assert eng.stats[a]["preemptions"] == 0
+        np.testing.assert_array_equal(got[a], _standalone(params, cfg,
+                                                          pA, 12))
+
+    def test_priority_order_admission(self):
+        # both queued up front: the high-priority request admits FIRST
+        # even though the low-priority one was submitted earlier
+        cfg, params = _setup()
+        events = []
+        eng = ContinuousBatcher(params, cfg, slots=1, pool_pages=3,
+                                pages_per_seq=3, page_size=8, chunk=2,
+                                emit=lambda **kw: events.append(kw))
+        lo = eng.submit(np.arange(5, dtype=np.int32), 4, priority=2)
+        hi = eng.submit(np.arange(5, dtype=np.int32), 4, priority=0)
+        eng.run()
+        admits = [e["seq_id"] for e in events
+                  if e["kind"] == "serve_admit"]
+        assert admits == [hi, lo]
+
+    def test_shed_expired_deadline(self):
+        # a queued request whose deadline lapses is SHED: empty output,
+        # outcome "shed", telemetry event — not silent starvation
+        cfg, params = _setup()
+        events = []
+        eng = ContinuousBatcher(params, cfg, slots=1, pool_pages=3,
+                                pages_per_seq=3, page_size=8, chunk=2,
+                                emit=lambda **kw: events.append(kw))
+        a = eng.submit(np.arange(5, dtype=np.int32), 9)
+        b = eng.submit(np.arange(5, dtype=np.int32), 4,
+                       deadline_s=0.0)  # expires while a serves
+        got = eng.run()
+        assert eng.stats[b]["outcome"] == "shed"
+        assert got[b].size == 0
+        assert [e["seq_id"] for e in events
+                if e["kind"] == "serve_shed"] == [b]
+        np.testing.assert_array_equal(
+            got[a], _standalone(params, cfg,
+                                np.arange(5, dtype=np.int32), 9))
+
+    def test_highwater_defers_fresh_admissions(self):
+        # admit_highwater reserves headroom: the second fresh request
+        # would push used pages past the mark, so it waits for the
+        # first to finish even though pages are nominally free
+        cfg, params = _setup()
+        events = []
+        eng = ContinuousBatcher(params, cfg, slots=2, pool_pages=6,
+                                pages_per_seq=3, page_size=8, chunk=2,
+                                admit_highwater=0.5,
+                                emit=lambda **kw: events.append(kw))
+        a = eng.submit(np.arange(5, dtype=np.int32), 9)   # 2 pages
+        b = eng.submit(np.arange(5, dtype=np.int32), 9)   # would be 4>3
+        got = eng.run()
+        admits = [e for e in events if e["kind"] == "serve_admit"]
+        # b admitted only after a freed its pages: never 2 concurrent
+        assert admits[1]["free_pages"] >= 4
+        for sid in (a, b):
+            np.testing.assert_array_equal(
+                got[sid], _standalone(params, cfg,
+                                      np.arange(5, dtype=np.int32), 9))
+        with pytest.raises(ValueError, match="admit_highwater"):
+            ContinuousBatcher(params, cfg, slots=1, pool_pages=3,
+                              pages_per_seq=3, page_size=8,
+                              admit_highwater=0.0)
+
+    def test_infeasible_head_never_evicts(self):
+        # a fresh high-priority request whose need exceeds the
+        # high-water cap can NEVER admit — preempting for it would
+        # thrash lower classes through re-prefills every round and
+        # still end stuck. The engine must leave the victims alone,
+        # serve them to completion, and then fail LOUDLY.
+        cfg, params = _setup()
+        events = []
+        eng = ContinuousBatcher(params, cfg, slots=2, pool_pages=6,
+                                pages_per_seq=6, page_size=8, chunk=2,
+                                preempt=True, admit_highwater=0.5,
+                                emit=lambda **kw: events.append(kw))
+        pA = np.arange(5, dtype=np.int32)
+        a = eng.submit(pA, 9, priority=1)       # 2 pages <= cap 3
+        eng.run(max_rounds=2)
+        eng.submit(np.arange(10, dtype=np.int32), 16,
+                   priority=0)                  # 4 pages > cap 3: stuck
+        with pytest.raises(RuntimeError, match="admit_highwater"):
+            eng.run()
+        assert not [e for e in events if e["kind"] == "serve_preempt"]
+        np.testing.assert_array_equal(
+            eng.finished[a], _standalone(params, cfg, pA, 9))
+
+    def test_non_victim_pages_over_the_cap_never_evict(self):
+        # the thrash shape: the head is kept over the high-water cap
+        # by pages that belong to SAME-or-higher-priority rows, so
+        # evicting the lower-priority victim could never admit it —
+        # the victim's resume would bypass the mark, re-admit the same
+        # round, and be evicted again next round, forever. The
+        # feasibility check must count only victim pages as freeable.
+        cfg, params = _setup()
+        events = []
+        eng = ContinuousBatcher(params, cfg, slots=3, pool_pages=8,
+                                pages_per_seq=4, page_size=8, chunk=2,
+                                preempt=True,
+                                emit=lambda **kw: events.append(kw))
+        pA = np.arange(5, dtype=np.int32)
+        a = eng.submit(pA, 20, priority=0)   # 4 pages, non-victim
+        b = eng.submit(pA, 9, priority=2)    # 2 pages, the only victim
+        eng.run(max_rounds=2)                # both active (used 6/8)
+        # the operator tightens the mark mid-run: cap drops to 4.8 —
+        # a fresh p1 head (2 pages) now reads used 6 + 2 > 4.8, and
+        # even with b evicted the p0 row alone keeps 4 + 2 > 4.8
+        eng.admit_highwater = 0.6
+        c = eng.submit(pA, 9, priority=1)
+        eng.run(max_rounds=4)
+        assert not [e for e in events if e["kind"] == "serve_preempt"]
+        got = eng.run()  # a and b drain; c admits into the empty pool
+        for sid, budget in ((a, 20), (b, 9), (c, 9)):
+            np.testing.assert_array_equal(
+                got[sid], _standalone(params, cfg, pA, budget))
+        assert eng.stats[b]["preemptions"] == 0
+
+    def test_bounded_run_parks_instead_of_waiting_for_arrivals(self):
+        import time as _time
+
+        cfg, params = _setup()
+        eng = ContinuousBatcher(params, cfg, slots=1, pool_pages=3,
+                                pages_per_seq=3, page_size=8, chunk=2)
+        t0 = _time.perf_counter()
+        eng.run(arrivals=[(30.0, dict(prompt=np.arange(4, dtype=np.int32),
+                                      max_new=2))],
+                max_rounds=1)
+        # parks immediately: must not idle-wait the 30s arrival out
+        assert _time.perf_counter() - t0 < 5.0
+
+    def test_stats_and_slo_rollup(self):
+        from hpc_patterns_tpu.harness import slo as slolib
+
+        cfg, params = _setup()
+        targets = {0: slolib.SLOTarget(ttft_s=60.0, tpot_s=60.0)}
+        eng = ContinuousBatcher(params, cfg, slots=2, pool_pages=6,
+                                pages_per_seq=3, page_size=8, chunk=2,
+                                slo=targets)
+        ids = [eng.submit(p, m) for p, m in _requests(cfg, 4, seed=41)]
+        eng.run()
+        assert eng.last_slo is not None
+        tot = eng.last_slo["total"]
+        assert tot["served"] == 4 and tot["shed"] == 0
+        # absurdly loose targets: everything attains, goodput == raw
+        assert tot["attained"] == 4
+        assert tot["goodput_tok_s"] == pytest.approx(tot["tok_s"])
+        for sid in ids:
+            rec = eng.stats[sid]
+            assert rec["outcome"] == "ok"
+            assert rec["t_submit"] <= rec["t_first"] <= rec["t_finish"]
+            assert rec["tokens"] == len(eng.finished[sid])
+
+    def test_open_loop_arrivals_replay(self):
+        # run(arrivals=...) submits on the schedule's clock; outputs
+        # stay oracle-exact and stats carry every arrival
+        cfg, params = _setup()
+        eng = ContinuousBatcher(params, cfg, slots=2, pool_pages=6,
+                                pages_per_seq=3, page_size=8, chunk=2)
+        reqs = _requests(cfg, 4, seed=43)
+        arrivals = [
+            (0.02 * i, dict(prompt=p, max_new=m, seq_id=100 + i))
+            for i, (p, m) in enumerate(reqs)
+        ]
+        got = eng.run(arrivals=arrivals)
+        for i, (p, m) in enumerate(reqs):
+            np.testing.assert_array_equal(
+                got[100 + i], _standalone(params, cfg, p, m))
+        assert all(eng.stats[100 + i]["outcome"] == "ok"
+                   for i in range(4))
+
+
 class TestDraftSampledDistribution:
     def test_draft_assisted_sampling_preserves_law(self):
         # the distribution oracle for the one law-only serving mode:

@@ -1,0 +1,105 @@
+"""SLO accounting (harness/slo.py): attainment math over synthetic
+stats tables with hand-computable answers — goodput counts ONLY
+attained requests' tokens, shed requests count against attainment with
+zero tokens, percentiles are exact, and the format renders goodput
+next to raw tok/s."""
+
+import pytest
+
+from hpc_patterns_tpu.harness import loadgen, slo
+
+
+def _rec(prio, t_submit, t_first, t_finish, tokens, outcome="ok",
+         preemptions=0):
+    return {"priority": prio, "t_submit": t_submit, "t_first": t_first,
+            "t_finish": t_finish, "tokens": tokens, "outcome": outcome,
+            "preemptions": preemptions}
+
+
+class TestLatencies:
+    def test_ttft_and_tpot(self):
+        ttft, tpot = slo.request_latencies(_rec(0, 10.0, 10.5, 14.5, 5))
+        assert ttft == pytest.approx(0.5)
+        assert tpot == pytest.approx(1.0)  # 4s over 4 inter-token gaps
+
+    def test_single_token_has_no_tpot(self):
+        ttft, tpot = slo.request_latencies(_rec(0, 0.0, 0.2, 0.2, 1))
+        assert ttft == pytest.approx(0.2) and tpot is None
+
+    def test_attained_rules(self):
+        tight = slo.SLOTarget(ttft_s=0.1, tpot_s=0.1)
+        loose = slo.SLOTarget()
+        ok = _rec(0, 0.0, 0.05, 0.2, 3)  # ttft .05, tpot .075
+        assert slo.attained(ok, tight)
+        assert not slo.attained(_rec(0, 0.0, 0.5, 0.6, 3), tight)  # ttft
+        assert not slo.attained(_rec(0, 0.0, 0.05, 1.0, 3), tight)  # tpot
+        assert slo.attained(_rec(0, 0.0, 0.5, 9.0, 3), loose)
+        assert not slo.attained(
+            _rec(0, 0.0, None, None, 0, outcome="shed"), loose)
+
+
+class TestAttainment:
+    def test_goodput_counts_only_attained_tokens(self):
+        targets = {0: slo.SLOTarget(ttft_s=0.1), 1: slo.SLOTarget()}
+        stats = {
+            1: _rec(0, 0.0, 0.05, 1.0, 10),            # attains
+            2: _rec(0, 0.0, 0.50, 1.0, 10),            # blows TTFT
+            3: _rec(1, 0.0, 0.30, 1.0, 20),            # no target: attains
+            4: _rec(0, 0.0, None, 0.4, 0, "shed"),     # shed
+        }
+        rep = slo.attainment(stats, targets, wall_s=2.0)
+        c0, c1 = rep["classes"][0], rep["classes"][1]
+        assert c0["n"] == 3 and c0["served"] == 2 and c0["shed"] == 1
+        assert c0["attained"] == 1
+        assert c0["tok_s"] == pytest.approx(10.0)        # 20 tokens / 2s
+        assert c0["goodput_tok_s"] == pytest.approx(5.0)  # attained only
+        assert c1["attained"] == 1
+        tot = rep["total"]
+        assert tot["n"] == 4 and tot["shed"] == 1
+        assert tot["tok_s"] == pytest.approx(20.0)
+        assert tot["goodput_tok_s"] == pytest.approx(15.0)
+        assert tot["attained_frac"] == pytest.approx(2 / 4)
+
+    def test_percentiles_are_exact_not_bucketed(self):
+        targets = {0: slo.SLOTarget()}
+        stats = {i: _rec(0, 0.0, 0.01 * (i + 1), 1.0, 2)
+                 for i in range(100)}
+        rep = slo.attainment(stats, targets, wall_s=1.0)
+        p = rep["classes"][0]["ttft_s"]
+        assert p["p50"] == pytest.approx(0.505, abs=0.02)
+        assert p["p99"] == pytest.approx(0.99 + 0.01 * 0.01, abs=0.02)
+
+    def test_in_flight_requests_are_not_judged(self):
+        rep = slo.attainment(
+            {1: _rec(0, 0.0, 0.1, None, 0, outcome=None)},
+            {0: slo.SLOTarget()}, wall_s=1.0)
+        assert rep["classes"][0]["served"] == 0
+        assert rep["total"]["tokens"] == 0
+
+    def test_preemptions_rollup(self):
+        rep = slo.attainment(
+            {1: _rec(1, 0.0, 0.1, 0.5, 4, preemptions=2),
+             2: _rec(1, 0.0, 0.1, 0.5, 4, preemptions=1)},
+            {}, wall_s=1.0)
+        assert rep["classes"][1]["preemptions"] == 3
+        assert rep["total"]["preemptions"] == 3
+
+    def test_targets_from_classes(self):
+        targets = slo.targets_from_classes((
+            loadgen.PriorityClass("i", 0, ttft_slo_s=0.5, tpot_slo_s=0.1),
+            loadgen.PriorityClass("b", 1),
+        ))
+        assert targets[0] == slo.SLOTarget(ttft_s=0.5, tpot_s=0.1)
+        assert targets[1] == slo.SLOTarget()
+
+
+class TestFormat:
+    def test_goodput_renders_next_to_raw(self):
+        rep = slo.attainment(
+            {1: _rec(0, 0.0, 0.05, 1.0, 10),
+             2: _rec(0, 0.0, None, 0.4, 0, "shed")},
+            {0: slo.SLOTarget(ttft_s=0.1)}, wall_s=2.0)
+        text = slo.format_slo(rep)
+        assert "goodput" in text and "tok/s raw" in text
+        assert "1 shed" in text
+        assert "p0" in text
