@@ -81,6 +81,75 @@ def per_pass_seconds(x, mode, tripcount, cal_passes=CAL_PASSES):
                                      cal_passes=cal_passes)
 
 
+def _fused_collective_detail() -> dict:
+    """Fused-ring-collective headline keys (comm/fused.py), captured in
+    the same measurement child as the overlap headline:
+
+    - ``fused_allreduce_gbps``: ring-normalized bus bandwidth of
+      ``Communicator.allreduce(algorithm="fused")`` — the
+      device-initiated in-kernel ring;
+    - ``allreduce_overlap_frac``: 1 - t(fused allgather_matmul) /
+      t(host-driven gather-then-matmul), i.e. the fraction of the
+      serial route's time the fused kernel hides by computing each
+      matmul tile while the next shard's remote DMA is in flight
+      (clamped at 0 — interpret mode serializes DMAs, so the CPU smoke
+      legitimately measures no overlap);
+    - ``allreduce_gbps_by_algorithm``: the fused-vs-collective-vs-ring
+      comparison row (informational, not gated).
+
+    Returns {} on a single-device topology (no ring to run) or when
+    the capture itself fails — the regression gate's coverage-loss
+    check is what makes a silently vanished key visible.
+    """
+    import numpy as np
+
+    from hpc_patterns_tpu import topology
+    from hpc_patterns_tpu.comm import Communicator
+
+    if len(jax.devices()) < 2:
+        return {}
+    on_tpu = jax.default_backend() == "tpu"
+    # per-rank elements: the fused kernel keeps the whole shard + two
+    # chunk-slot arrays VMEM-resident (no grid streaming yet), so the
+    # chip shard is 4 MiB — wire-dominated but ~4x inside the kernel's
+    # VMEM budget; the CPU smoke keeps the dma-discharge interpreter fast
+    n = (1 << 20) if on_tpu else (1 << 11)
+    reps = 10 if on_tpu else 3
+    comm = Communicator(topology.make_mesh({"x": -1}), "x")
+    x = comm.shard(np.ones((comm.size, n), np.float32))
+
+    def best_seconds(fn, *args):
+        jax.block_until_ready(fn(*args))  # compile + warm outside
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    gbps = {}
+    nbytes = n * x.dtype.itemsize
+    for alg in ("fused", "collective", "ring_chunked"):
+        t = best_seconds(comm.jit_allreduce(x, alg), x)
+        # ring busbw normalization: 2*S*(size-1)/size bytes per link
+        gbps[alg] = 2 * nbytes * (comm.size - 1) / comm.size / t / 1e9
+
+    m, k, n_w = (256, 1024, 1024) if on_tpu else (4, 32, 16)
+    xa = comm.shard(np.ones((comm.size, m, k), np.float32))
+    w = comm.shard(np.ones((comm.size, k, n_w), np.float32))
+    t_fused = best_seconds(
+        lambda a, b: comm.allgather_matmul(a, b, "fused"), xa, w)
+    t_host = best_seconds(
+        lambda a, b: comm.allgather_matmul(a, b, "collective"), xa, w)
+    return {
+        "fused_allreduce_gbps": round(gbps["fused"], 3),
+        "allreduce_overlap_frac": round(
+            max(0.0, 1.0 - t_fused / t_host), 4) if t_host > 0 else 0.0,
+        "allreduce_gbps_by_algorithm": {
+            a: round(v, 3) for a, v in gbps.items()},
+    }
+
+
 def _unavailable_line(err: BaseException) -> str:
     """Degenerate-capture verdict line for a backend that won't even
     initialize (value 0.0, never a pass, the error preserved)."""
@@ -306,6 +375,14 @@ def main() -> int:
         if init_timeout > 0 and hasattr(signal, "SIGALRM"):
             signal.signal(signal.SIGALRM, _alarm)
             signal.alarm(init_timeout)
+        # the fused-collective row needs a ring: give the CPU fallback
+        # the suite's 8-device virtual mesh (host-platform only — a TPU
+        # backend ignores it)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
         import jax
         from hpc_patterns_tpu.concurrency import pipeline
         on_tpu = jax.default_backend() == "tpu"
@@ -371,6 +448,15 @@ def main() -> int:
             trips, t_comp, t_serial, t_overlap = 0, 0.0, 0.0, 0.0
             raw_pairs = []
 
+    # the fused-ring-collective row (device-initiated allreduce +
+    # overlapped allgather-matmul); a failed capture yields {} and the
+    # gate's coverage-loss warning is the tripwire for its absence
+    try:
+        fused_detail = _fused_collective_detail()
+    except Exception as err:  # noqa: BLE001 — never sink the headline
+        fused_detail = {"fused_collective_error":
+                        f"{type(err).__name__}: {err}"}
+
     # any clamped-to-zero component means the run measured nothing usable
     degenerate = min(t_overlap, t_serial, t_dma, t_comp) <= 0
     if degenerate:
@@ -401,6 +487,7 @@ def main() -> int:
                               f"{measure_error}")
                     if measure_error is not None else None,
                     "backend": jax.default_backend(),
+                    **fused_detail,
                     # the five raw (serial, overlap) pairs, measurement
                     # order — the distribution behind the median
                     "pairs_us": [

@@ -29,8 +29,18 @@ run() {
   echo "--- rc=$rc ---" | tee -a "$LOG"
 }
 
-# 0. session health + headline (the driver-style capture, kept as a row)
+# 0. session health + headline (the driver-style capture, kept as a row;
+#    since PR 8 the detail also carries fused_allreduce_gbps /
+#    allreduce_overlap_frac — the device-initiated collective row)
 run "bench.py headline" python bench.py
+
+# 0b. fused-vs-host collective sweep (comm/fused.py): the default sweep
+#     now races ring / ring_chunked / collective / FUSED per message
+#     size — the busbw-vs-size curve that shows where the in-kernel
+#     remote-DMA ring overtakes the host-driven paths. Light (compile +
+#     a few reps per point); the oracle validates every point.
+run "allreduce fused-vs-host sweep" python -m hpc_patterns_tpu.apps.allreduce_app \
+  --sweep --min-p 20 -p 26 --repetitions 5 --warmup 2
 
 # 1. T=2048 MFU row (the 73-75% config)
 run "train T=2048 kv=2" python - <<'EOF'
@@ -107,6 +117,20 @@ run "multi-proc allreduce trace (2 ranks)" env JAX_PLATFORMS=cpu \
   --trace-out "${LOG%.log}_multiproc.trace.json" \
   --log "${LOG%.log}_multiproc.jsonl" -- \
   python -m hpc_patterns_tpu.apps.allreduce_app -p 16 \
+  --repetitions 5 --warmup 2 --trace
+
+# 7c. the same 2-process traced capture on the FUSED route: the merged
+#     timeline's comm.allreduce.fused windows + the per-rank bubble
+#     rollup are the overlap evidence (the in-kernel ring shows as ONE
+#     device window where the host-driven route shows dispatch gaps),
+#     and the schedule verdict proves the fused fingerprints
+#     (op|seq|shape|dtype|axis|algorithm) still chain identically
+#     across ranks — the fast path is not blind to the verifier.
+run "multi-proc FUSED allreduce trace (2 ranks)" env JAX_PLATFORMS=cpu \
+  python -m hpc_patterns_tpu.apps.launch -np 2 --cpu-devices-per-proc 2 \
+  --trace-out "${LOG%.log}_multiproc_fused.trace.json" \
+  --log "${LOG%.log}_multiproc_fused.jsonl" -- \
+  python -m hpc_patterns_tpu.apps.allreduce_app -p 16 --algorithm fused \
   --repetitions 5 --warmup 2 --trace
 
 # 8. final health check + REGRESSION GATE: capture the closing round,

@@ -622,11 +622,24 @@ _COLLECTIVE_NAMES = frozenset({
     "ring_allreduce_chunked", "ring_reduce_scatter", "ring_all_gather",
     "ring_schedule", "halo_exchange", "jacobi_step",
     "process_allgather", "sync_global_devices", "broadcast_one_to_all",
+    # device-initiated fused entry points (comm/fused.py): the ring
+    # runs inside a Pallas kernel, but every rank must still enter the
+    # kernel in lockstep — rank-dependent control flow around these is
+    # the same deadlock shape as around a host-driven collective
+    "fused_allreduce", "allreduce_into", "allgather_matmul",
+    "fused_permute", "fused_ring_shift",
 }) | _LAX_COLLECTIVES
 
 #: final names whose call result identifies the calling rank — the
 #: taint sources for rank-dependent control flow
 _RANK_SOURCES = frozenset({"axis_index", "process_index"})
+
+#: permutation-consuming entry points audited by
+#: ``unchecked-permutation``: ``lax.ppermute`` and its
+#: device-initiated sibling ``comm.fused.fused_permute`` — both take a
+#: ``(src, dst)`` pair list as their third argument, and a malformed
+#: list silently corrupts data on either route
+_PERMUTE_CONSUMERS = frozenset({"ppermute", "fused_permute"})
 
 
 def _collective_id(mod: ModuleInfo, call: ast.Call
@@ -920,24 +933,27 @@ class CollectiveOrderRule(Rule):
 
 @register
 class UncheckedPermutationRule(Rule):
-    """A malformed ppermute pair list does not deadlock — XLA silently
-    zero-fills destinations with no incoming pair and drops duplicated
-    sources — which is WORSE: the job completes with wrong data.
-    ``comm.ring.check_permutation`` closes that gap; this rule makes
-    routing every pair list through it a checked invariant."""
+    """A malformed permutation pair list does not deadlock — XLA's
+    ``ppermute`` silently zero-fills destinations with no incoming pair
+    and drops duplicated sources, and the device-initiated
+    ``fused_permute`` would strand a rank waiting on a DMA that never
+    arrives — either way WORSE than an error: wrong data or a silent
+    hang. ``comm.ring.check_permutation`` closes that gap; this rule
+    makes routing every pair list through it a checked invariant for
+    every consumer in ``_PERMUTE_CONSUMERS``."""
 
     name = "unchecked-permutation"
-    summary = ("ppermute pair list built without "
+    summary = ("ppermute/fused_permute pair list built without "
                "ring.check_permutation")
     hint = ("bind the pair list to a name and run "
             "comm.ring.check_permutation(pairs, size) before the "
-            "ppermute — a malformed permutation silently drops or "
-            "duplicates data")
+            "ppermute/fused_permute — a malformed permutation "
+            "silently drops or duplicates data")
 
     def check(self, mod: ModuleInfo, config: AnalysisConfig
               ) -> Iterable[Finding]:
         checked: dict[ast.AST | None, set[str]] = {}
-        permutes: list[ast.Call] = []
+        permutes: list[tuple[ast.Call, str]] = []
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -946,9 +962,9 @@ class UncheckedPermutationRule(Rule):
                 if node.args and isinstance(node.args[0], ast.Name):
                     checked.setdefault(self._scope(mod, node), set()).add(
                         node.args[0].id)
-            elif base == "ppermute":
-                permutes.append(node)
-        for call in permutes:
+            elif base in _PERMUTE_CONSUMERS:
+                permutes.append((node, base))
+        for call, base in permutes:
             perm = call.args[2] if len(call.args) >= 3 else None
             if perm is None:
                 for kw in call.keywords:
@@ -959,10 +975,10 @@ class UncheckedPermutationRule(Rule):
             if isinstance(perm, ast.Name):
                 if perm.id in checked.get(self._scope(mod, call), ()):
                     continue
-                msg = (f"pair list {perm.id!r} reaches ppermute "
+                msg = (f"pair list {perm.id!r} reaches {base} "
                        f"without a check_permutation in this scope")
             else:
-                msg = ("pair list built inline in the ppermute call — "
+                msg = (f"pair list built inline in the {base} call — "
                        "it can never have been check_permutation'd")
             yield self.finding(mod, call, msg)
 

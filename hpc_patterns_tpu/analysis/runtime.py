@@ -97,9 +97,13 @@ class CollectiveSchedule:
             self.entries: deque = deque(maxlen=self.window)
 
     def record(self, op: str, seq: int, *, shape=None, dtype=None,
-               axis=None) -> dict:
+               axis=None, algorithm=None) -> dict:
+        # ``algorithm`` joined the fingerprint with the fused-collective
+        # route (PR 8): a rank running the host-driven path while its
+        # peers run the in-kernel ring is a schedule divergence even
+        # when (op, seq, shape) agree — the wire protocols differ.
         fp = (f"{op}|{int(seq)}|{tuple(shape) if shape is not None else ()}"
-              f"|{dtype or ''}|{axis or ''}")
+              f"|{dtype or ''}|{axis or ''}|{algorithm or ''}")
         with self._lock:
             digest = hashlib.sha256(
                 f"{self.digest}\x1f{fp}".encode()).hexdigest()[:16]
@@ -108,6 +112,8 @@ class CollectiveSchedule:
                 "shape": list(shape) if shape is not None else None,
                 "dtype": str(dtype) if dtype is not None else None,
                 "axis": str(axis) if axis is not None else None,
+                "algorithm": (str(algorithm) if algorithm is not None
+                              else None),
                 "digest": digest,
             }
             self.digest = digest
@@ -151,18 +157,21 @@ def _progress_path(trace_dir: str, process_id: int) -> str:
 
 
 def record_collective(op: str, seq: int, *, shape=None, dtype=None,
-                      axis=None) -> dict:
+                      axis=None, algorithm=None) -> dict:
     """Fingerprint one collective into the process chain.
 
     Called at ISSUE time (before the wait): ``comm/communicator.py``
-    records every eager collective, ``harness/timing.py`` every traced
-    timed repetition. Under a launcher (``HPCPAT_TRACE_DIR`` exported
-    by ``apps/launch.py --trace-out``) each record also persists the
-    chain head to ``rank<id>.sched.json`` — that write is what makes a
-    HUNG rank diagnosable: the rank never reaches its trace-snapshot
-    handoff, but the collective it is stuck in is already on disk for
-    the launcher's timeout report."""
-    entry = _schedule.record(op, seq, shape=shape, dtype=dtype, axis=axis)
+    records every eager collective — host-driven AND fused-kernel
+    routes, with ``algorithm`` in the fingerprint so the fast path is
+    never invisible to the verifier — and ``harness/timing.py`` every
+    traced timed repetition. Under a launcher (``HPCPAT_TRACE_DIR``
+    exported by ``apps/launch.py --trace-out``) each record also
+    persists the chain head to ``rank<id>.sched.json`` — that write is
+    what makes a HUNG rank diagnosable: the rank never reaches its
+    trace-snapshot handoff, but the collective it is stuck in is
+    already on disk for the launcher's timeout report."""
+    entry = _schedule.record(op, seq, shape=shape, dtype=dtype, axis=axis,
+                             algorithm=algorithm)
     trace_dir = os.environ.get(ENV_TRACE_DIR)
     if trace_dir:
         try:

@@ -57,8 +57,10 @@ def build_parser():
     p.add_argument(
         "--algorithm",
         default=None,
-        choices=["ring", "ring_chunked", "collective"],
-        help="explicit algorithm (overrides -a; default ring, like the reference)",
+        choices=["ring", "ring_chunked", "collective", "fused"],
+        help="explicit algorithm (overrides -a; default ring, like the "
+             "reference; 'fused' = the device-initiated in-kernel "
+             "remote-DMA ring, comm/fused.py)",
     )
     p.add_argument(
         "--world",
@@ -108,7 +110,7 @@ def run_sweep(args, log, comm) -> int:
     if args.algorithm or args.allreduce:
         algorithms = [resolve_algorithm(args)]
     else:
-        algorithms = ["ring", "ring_chunked", "collective"]
+        algorithms = ["ring", "ring_chunked", "collective", "fused"]
     n_ok = n_total = 0
     kind_cache: dict = {}  # memory-kind probe result, shared across points
     budget = _hbm_budget_bytes()

@@ -10,14 +10,17 @@ operate directly on HBM-resident sharded arrays — the TPU meaning of
 
 Two API levels:
 
-- :mod:`hpc_patterns_tpu.comm.ring` + :mod:`~.collectives` — *rank-local*
-  functions used **inside** ``shard_map``: each takes the local shard and
-  an axis name, exactly like the reference's per-rank functions take a
-  device buffer and a communicator.
+- :mod:`hpc_patterns_tpu.comm.ring` + :mod:`~.collectives` +
+  :mod:`~.fused` — *rank-local* functions used **inside**
+  ``shard_map``: each takes the local shard and an axis name, exactly
+  like the reference's per-rank functions take a device buffer and a
+  communicator. ``fused`` is the device-initiated tier: Pallas kernels
+  that run the ring schedule in-kernel over ``make_async_remote_copy``
+  and overlap each hop with the consuming compute (docs/comm.md).
 - :class:`~hpc_patterns_tpu.comm.communicator.Communicator` — array-level
   API over global ``jax.Array``\\ s: builds the ``shard_map`` for you, the
   analog of the miniapp main()s wiring buffers to MPI calls.
 """
 
-from hpc_patterns_tpu.comm import collectives, ring  # noqa: F401
+from hpc_patterns_tpu.comm import collectives, fused, ring  # noqa: F401
 from hpc_patterns_tpu.comm.communicator import Communicator  # noqa: F401

@@ -19,7 +19,14 @@ import jax.numpy as jnp
 from jax import lax
 
 def _pprod(x, axis):
-    # XLA has no native pprod; all_gather+reduce keeps exactness for ints.
+    """``prod`` reduction FALLBACK: XLA has no native pprod, so this is
+    an all-gather followed by a local product — exact for ints, but a
+    fundamentally different wire pattern from a ring reduction. That is
+    why the fused route refuses it outright
+    (:data:`hpc_patterns_tpu.comm.fused.FUSED_REDUCE_OPS` /
+    ``_check_op``): a "fused prod" silently mapped onto the sum-shaped
+    ring would return wrong data, not raise, and this fallback must
+    stay the only prod route."""
     return lax.all_gather(x, axis).prod(axis=0)
 
 

@@ -24,17 +24,17 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from hpc_patterns_tpu.analysis import runtime as analysis_runtime
-from hpc_patterns_tpu.comm import collectives, ring
+from hpc_patterns_tpu.comm import collectives, fused, ring
 from hpc_patterns_tpu.harness import chaos as chaoslib
 from hpc_patterns_tpu.harness import metrics as metricslib
 from hpc_patterns_tpu.harness import trace as tracelib
 from hpc_patterns_tpu.topology import shard_map
 
-Algorithm = Literal["collective", "ring", "ring_chunked"]
+Algorithm = Literal["collective", "ring", "ring_chunked", "fused"]
 
 
 def _ready_in_span(result, op: str = "collective", seq: int | None = None,
-                   axis: str | None = None):
+                   axis: str | None = None, algorithm: str | None = None):
     """Block before an open span exits so it measures collective
     completion, not async dispatch — the shard_map call returns an
     unready array. Only when a span actually records (metrics, trace
@@ -63,7 +63,8 @@ def _ready_in_span(result, op: str = "collective", seq: int | None = None,
             or analysis_runtime.ENV_TRACE_DIR in os.environ):
         analysis_runtime.record_collective(
             op, seq, shape=getattr(result, "shape", None),
-            dtype=str(getattr(result, "dtype", "")) or None, axis=axis)
+            dtype=str(getattr(result, "dtype", "")) or None, axis=axis,
+            algorithm=algorithm)
     if not (m.enabled or m.mirror_traces or rec is not None):
         return result
     if rec is not None:
@@ -110,8 +111,9 @@ def record_collective_bandwidth(op: str, nbytes: int, seconds: float,
     for key, value in attrs.items():
         m.gauge(f"comm.{op}.{key}").set(value)
 
-# allreduce algorithm table: library collective vs hand-built rings —
-# the comparison the reference exists to make (SURVEY.md §2.3(b)).
+# allreduce algorithm table: library collective vs hand-built rings vs
+# the device-initiated fused ring — the comparison the reference exists
+# to make (SURVEY.md §2.3(b)), extended one rung down the stack.
 _ALLREDUCE = {
     "collective": lambda x, axis: collectives.allreduce(x, axis, "sum"),
     "ring": ring.ring_allreduce,
@@ -120,6 +122,10 @@ _ALLREDUCE = {
     "ring_chunked": lambda x, axis: ring.ring_allreduce_chunked(
         x, axis, scatter_axis=x.ndim - 1
     ),
+    # the ring schedule run INSIDE a Pallas kernel (remote DMA per
+    # step); byte-exact vs ring_chunked over the padded layout —
+    # comm/fused.py. Sum only: _check_op guards the _pprod fallback.
+    "fused": lambda x, axis: fused.fused_allreduce(x, axis),
 }
 
 
@@ -141,6 +147,14 @@ class Communicator:
         # once per point, and a fresh jax.jit per call re-traces every
         # time (jaxlint: recompile-hazard)
         self._rank_filled_cache: dict = {}
+        # jitted allreduce closures by (shape, dtype, ALGORITHM):
+        # benchmark sweeps race algorithms at one shape, and a cache
+        # missing the algorithm key would thrash one slot per point
+        # (each jit_allreduce call re-tracing the loser)
+        self._jit_allreduce_cache: dict = {}
+        # allgather_matmul closures, same keying discipline — the
+        # fused-vs-collective bench times the eager method per rep
+        self._agmm_cache: dict = {}
         # per-communicator collective counter: every eager collective
         # call takes the next value, and since all ranks of an SPMD
         # program issue the identical collective sequence, (span name,
@@ -190,24 +204,51 @@ class Communicator:
 
     # -- collectives over (size, n) arrays --------------------------------
 
+    def _check_fusable(self, algorithm: str) -> None:
+        """The fused kernels bind LOGICAL neighbor ids (and jax's
+        dma-discharge interpreter binds a single named axis), so the
+        fused route requires the communicator's mesh to be one-axis.
+        Fail here with the route named rather than deep inside a
+        kernel trace."""
+        if algorithm == "fused" and len(self.mesh.axis_names) > 1:
+            raise ValueError(
+                f"algorithm 'fused' needs a single-axis mesh (logical "
+                f"ring ids); this communicator's mesh has axes "
+                f"{tuple(self.mesh.axis_names)} — use a host-driven "
+                "algorithm here, or a dedicated 1-axis mesh"
+            )
+
     def allreduce(self, x, algorithm: Algorithm = "collective") -> jax.Array:
         """Elementwise sum across ranks; every row of the result holds the
         sum (MPI_Allreduce semantics, allreduce-mpi-sycl.cpp:61-67 for
         ``"collective"``; the :173-182 hand ring for ``"ring"``;
-        two-phase bandwidth-optimal ring for ``"ring_chunked"``)."""
+        two-phase bandwidth-optimal ring for ``"ring_chunked"``; the
+        same two-phase ring as device-initiated in-kernel remote DMA
+        for ``"fused"`` — comm/fused.py, docs/comm.md)."""
         impl = _ALLREDUCE[algorithm]
+        self._check_fusable(algorithm)
         seq = self._next_seq()
         _inject_chaos(seq)
         with metricslib.span("comm.allreduce", algorithm=algorithm):
             return _ready_in_span(
                 self._shmap(lambda local: impl(local, self.axis), x)(x),
-                op=f"allreduce.{algorithm}", seq=seq, axis=self.axis)
+                op=f"allreduce.{algorithm}", seq=seq, axis=self.axis,
+                algorithm=algorithm)
 
     def jit_allreduce(self, x, algorithm: Algorithm = "collective"):
         """The compiled allreduce closure for ``x``'s shape — what a
-        benchmark should time (compile excluded per SURVEY.md §7(d))."""
-        impl = _ALLREDUCE[algorithm]
-        return self._shmap(lambda local: impl(local, self.axis), x)
+        benchmark should time (compile excluded per SURVEY.md §7(d)).
+        Cached per (shape, dtype, algorithm): an algorithm sweep at one
+        shape gets one traced closure per algorithm instead of
+        re-tracing whichever it asked for last."""
+        self._check_fusable(algorithm)
+        key = (jnp.shape(x), str(jnp.result_type(x)), algorithm)
+        fn = self._jit_allreduce_cache.get(key)
+        if fn is None:
+            impl = _ALLREDUCE[algorithm]
+            fn = self._shmap(lambda local: impl(local, self.axis), x)
+            self._jit_allreduce_cache[key] = fn
+        return fn
 
     def pingpong(self, x) -> jax.Array:
         """Pairwise even/odd exchange: row r swaps with row r^1 — the
@@ -266,6 +307,94 @@ class Communicator:
             return _ready_in_span(self._shmap(fn, x)(x),
                                   op="all_to_all", seq=seq,
                                   axis=self.axis)
+
+    # -- fused collective+consumer ops (comm/fused.py) --------------------
+
+    def allgather_matmul(self, x, w,
+                         algorithm: str = "fused") -> jax.Array:
+        """``all_gather(x) @ w`` with per-rank weight panels: ``x`` is
+        (size, m, k) — row r is rank r's activation block — and ``w``
+        is (size, k, n) — row r is rank r's panel; the result row r is
+        ``gathered_x @ w[r]`` of shape (size*m, n).
+
+        ``algorithm="fused"`` runs the gather ring inside one Pallas
+        kernel, each arriving shard feeding a matmul tile while the
+        next shard is on the wire; ``"collective"`` is the host-driven
+        oracle (XLA all-gather completes, then the tiles compute) with
+        identical per-tile accumulation, so the two are bitwise-equal
+        — the parity the fused suite asserts."""
+        if algorithm not in ("fused", "collective"):
+            raise ValueError(
+                f"allgather_matmul algorithm {algorithm!r} not in "
+                "('fused', 'collective')")
+        self._check_fusable(algorithm)
+        if jnp.ndim(x) != 3 or jnp.ndim(w) != 3:
+            raise ValueError(
+                f"want x (size, m, k) and w (size, k, n), got "
+                f"{jnp.shape(x)} and {jnp.shape(w)}")
+        key = (jnp.shape(x), str(jnp.result_type(x)), jnp.shape(w),
+               str(jnp.result_type(w)), algorithm)
+        fn = self._agmm_cache.get(key)
+        if fn is None:
+            impl = (fused.allgather_matmul if algorithm == "fused"
+                    else fused.allgather_matmul_reference)
+
+            def per_rank(xl, wl):
+                return impl(xl[0], wl[0], self.axis)[None]
+
+            spec = P(self.axis, None, None)
+            fn = jax.jit(shard_map(per_rank, mesh=self.mesh,
+                                   in_specs=(spec, spec), out_specs=spec))
+            self._agmm_cache[key] = fn
+        seq = self._next_seq()
+        _inject_chaos(seq)
+        with metricslib.span("comm.allgather_matmul",
+                             algorithm=algorithm):
+            return _ready_in_span(
+                fn(self.shard(x), self.shard(w)),
+                op=f"allgather_matmul.{algorithm}", seq=seq,
+                axis=self.axis, algorithm=algorithm)
+
+    def allreduce_into(self, x, bias=None, epilogue=None,
+                       algorithm: str = "fused") -> jax.Array:
+        """Allreduce(sum) with its consumer fused in: every row of the
+        result holds ``epilogue(sum_ranks(x) + bias)``. On the
+        ``"fused"`` route the bias add/epilogue are applied to each
+        reduced chunk AS ITS DMA LANDS (no separate pass);
+        ``"collective"`` is the host-driven oracle (psum, then the
+        epilogue as ordinary XLA ops). ``epilogue`` must be
+        elementwise — chunkwise application is what makes the fused
+        route exact."""
+        if algorithm not in ("fused", "collective"):
+            raise ValueError(
+                f"allreduce_into algorithm {algorithm!r} not in "
+                "('fused', 'collective')")
+        self._check_fusable(algorithm)
+        row_bias = None
+        if bias is not None:
+            row_bias = jnp.asarray(bias, jnp.result_type(x))
+
+        def per_rank(local):
+            if algorithm == "fused":
+                return fused.allreduce_into(
+                    local, self.axis, bias=row_bias, epilogue=epilogue)
+            out = collectives.allreduce(local, self.axis, "sum")
+            if row_bias is not None:
+                out = out + row_bias
+            if epilogue is not None:
+                out = epilogue(out)
+            # same dtype contract as the fused route (whose chunk
+            # writes land in the collective's dtype): a widening
+            # epilogue must not make the two routes diverge
+            return out.astype(local.dtype)
+
+        seq = self._next_seq()
+        _inject_chaos(seq)
+        with metricslib.span("comm.allreduce_into", algorithm=algorithm):
+            return _ready_in_span(
+                self._shmap(per_rank, x)(x),
+                op=f"allreduce_into.{algorithm}", seq=seq,
+                axis=self.axis, algorithm=algorithm)
 
     # -- miniapp-style buffer init ---------------------------------------
 

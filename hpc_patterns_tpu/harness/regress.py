@@ -93,6 +93,16 @@ SPECS: tuple[MetricSpec, ...] = (
     MetricSpec("detail.serving_goodput_tok_s", "higher"),
     MetricSpec("detail.serving_degraded_bubble_frac", "lower",
                abs_slack=0.05),
+    # the device-initiated fused-collective row (comm/fused.py, PR 8):
+    # fused ring allreduce bus bandwidth, and the fraction of the
+    # host-driven gather-then-matmul time the fused allgather_matmul
+    # hides under in-flight remote DMAs. The overlap fraction is
+    # legitimately ~0 on the CPU smoke (the dma-discharge interpreter
+    # serializes), so it gets the same near-zero absolute slack as the
+    # bubble fractions.
+    MetricSpec("detail.fused_allreduce_gbps", "higher"),
+    MetricSpec("detail.allreduce_overlap_frac", "higher",
+               abs_slack=0.05),
 )
 
 

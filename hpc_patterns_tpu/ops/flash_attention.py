@@ -348,15 +348,13 @@ def _dkv_kernel(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
 
 
 def _fit_block(block, t):
-    """Clamp ``block`` to ``t`` and halve until it divides, floored at
-    128 (the TPU lane width — smaller blocks would break tiling and
-    waste the MXU). The streamed kernels want big blocks: grid-step
-    overhead amortizes over them. Lengths that no 128-multiple divides
-    still fail validation — pad upstream."""
-    block = min(block, t)
-    while t % block and block >= 256:
-        block //= 2
-    return block
+    """Pow2 block fitting, floored at the 128 lane width — shared rule
+    in :mod:`hpc_patterns_tpu.ops.tiling` (streamed kernels want big
+    blocks; lengths that no 128-multiple divides still fail validation
+    — pad upstream)."""
+    from hpc_patterns_tpu.ops.tiling import fit_block_pow2
+
+    return fit_block_pow2(block, t)
 
 
 def _resolve(Tq, Tk, D, scale, block_q, block_k, interpret, *,

@@ -38,10 +38,11 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# CompilerParams was TPUCompilerParams before the pallas.tpu rename;
-# bind whichever this jax build exports
-_compiler_params = getattr(pltpu, "CompilerParams", None) or getattr(
-    pltpu, "TPUCompilerParams")
+from hpc_patterns_tpu.ops.tiling import (
+    default_interpret,
+    fit_block_divisor as _fit_block,
+    tpu_compiler_params as _compiler_params,
+)
 
 _SQRT_2_OVER_PI = 0.7978845608028654
 _GELU_C = 0.044715
@@ -134,22 +135,13 @@ def _bwd_kernel(x_ref, dy_ref, w1_ref, w2_ref, dxs_ref, dw1_ref, dw2_ref,
         dw2_ref[...] = dw2_acc[...]
 
 
-def _fit_block(n, cap):
-    """Largest divisor of ``n`` that is <= ``cap``: an off-size token
-    count (e.g. B*T = 768) gets a smaller even tile instead of a raw
-    ValueError mid-trace. Always succeeds (1 divides everything; tiny
-    blocks are slow, not wrong — Mosaic pads unaligned tiles)."""
-    for b in range(min(cap, n), 0, -1):
-        if n % b == 0:
-            return b
-    return 1
-
-
 def _resolve(N, D, F, block_t, block_f, interpret):
+    # block fitting + interpret default live in ops.tiling, shared with
+    # the flash and fused-collective kernels
     block_t = _fit_block(N, block_t)
     block_f = _fit_block(F, block_f)
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = default_interpret()
     return block_t, block_f, interpret
 
 

@@ -1,0 +1,72 @@
+"""Shared Pallas tiling/lowering helpers.
+
+Every Pallas call site in the tree had grown its own copy of three
+decisions — how to shrink a requested block to fit an off-size length,
+when to fall back to interpret mode, and how to spell
+``CompilerParams`` across the ``TPUCompilerParams`` rename
+(``ops/fused_mlp.py``, ``ops/flash_attention.py``, and now
+``comm/fused.py``). One module owns them so a kernel added tomorrow
+cannot disagree with the kernels that exist today.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+# CompilerParams was TPUCompilerParams before the pallas.tpu rename;
+# bind whichever this jax build exports
+_COMPILER_PARAMS_CLS = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
+
+#: kwargs the older TPUCompilerParams class rejects — dropped with a
+#: best-effort retry so one call shape serves both jax generations
+_OPTIONAL_PARAMS = ("collective_id", "has_side_effects")
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams(**kwargs)`` tolerant of the class rename
+    AND of fields the older class lacks (``collective_id`` /
+    ``has_side_effects`` are required for remote-DMA kernels on newer
+    builds but unknown to some 0.4.x ones)."""
+    kwargs = dict(kwargs)
+    while True:
+        try:
+            return _COMPILER_PARAMS_CLS(**kwargs)
+        except TypeError:
+            for name in _OPTIONAL_PARAMS:
+                if name in kwargs:
+                    del kwargs[name]
+                    break
+            else:
+                raise
+
+
+def default_interpret() -> bool:
+    """The tree-wide interpret default: compiled on TPU, interpreted
+    everywhere else (the 8-device CPU mesh the test suite runs on)."""
+    return jax.default_backend() != "tpu"
+
+
+def fit_block_divisor(n: int, cap: int) -> int:
+    """Largest divisor of ``n`` that is <= ``cap``: an off-size length
+    gets a smaller even tile instead of a raw ValueError mid-trace.
+    Always succeeds (1 divides everything; tiny blocks are slow, not
+    wrong — Mosaic pads unaligned tiles). The fused-MLP fitting rule."""
+    for b in range(min(cap, n), 0, -1):
+        if n % b == 0:
+            return b
+    return 1
+
+
+def fit_block_pow2(block: int, n: int, *, floor: int = 128) -> int:
+    """Clamp ``block`` to ``n`` and halve until it divides, floored at
+    ``floor`` (the TPU lane width — smaller blocks would break tiling
+    and waste the MXU). Lengths that no floor-multiple divides still
+    fail the caller's validation — pad upstream. The flash-attention
+    fitting rule (streamed kernels want big blocks; grid-step overhead
+    amortizes over them)."""
+    block = min(block, n)
+    while n % block and block >= 2 * floor:
+        block //= 2
+    return block

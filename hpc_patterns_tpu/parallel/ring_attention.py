@@ -86,6 +86,35 @@ def _block_step(q, k, v, acc, m, l, *, scale, q_offset, k_offset, causal):
     return acc_new, m_new, l_new
 
 
+def _kv_rotate(axis: str, shift_impl: str):
+    """The per-step K/V neighbor hop, selectable between the XLA
+    collective permute (``"ppermute"``) and the device-initiated Pallas
+    remote-DMA shift (``"fused"``, comm/fused.py) — the same
+    algorithm-selection axis the Communicator exposes for allreduce,
+    at the ring-attention step. Both produce identical bytes (a shift
+    is a pure permutation); what changes is who issues the transfer."""
+    if shift_impl == "ppermute":
+        return lambda kv: jax.tree.map(
+            lambda t: ring.ring_shift(t, axis, 1), kv)
+    if shift_impl == "fused":
+        from hpc_patterns_tpu.comm import fused
+
+        # K and V shift as two data-independent kernels the scheduler
+        # may overlap on chip — distinct collective_ids keep their
+        # barrier/DMA state apart (ids 3/4: 0-2 are taken by
+        # permute/allreduce/allgather_matmul defaults)
+        def rotate(kv):
+            k_blk, v_blk = kv
+            return (fused.fused_ring_shift(k_blk, axis, 1,
+                                           collective_id=3),
+                    fused.fused_ring_shift(v_blk, axis, 1,
+                                           collective_id=4))
+
+        return rotate
+    raise ValueError(
+        f"shift_impl {shift_impl!r} not in ('ppermute', 'fused')")
+
+
 def ring_attention(
     q,
     k,
@@ -97,6 +126,7 @@ def ring_attention(
     impl: str = "dense",
     block_q: int | None = None,
     block_k: int | None = None,
+    shift_impl: str = "ppermute",
 ):
     """Attention over a sequence sharded on mesh ``axis`` (rank-local; run
     inside ``shard_map``).
@@ -115,11 +145,16 @@ def ring_attention(
     merges partials by logsumexp — O(block) VMEM on-chip, MXU-shaped,
     and causally-skipped blocks cost no fetches or matmuls. Requires
     the local sequence to divide by the (clamped) block sizes.
+
+    ``shift_impl``: who moves the K/V block each step — ``"ppermute"``
+    (XLA collective permute, the default) or ``"fused"`` (the
+    device-initiated Pallas remote-DMA shift; single-axis meshes).
     """
     if q.ndim != 4:
         raise ValueError(f"want (batch, seq, heads, head_dim), got {q.shape}")
     if impl not in ("dense", "flash"):
         raise ValueError(f"impl {impl!r} not in ('dense', 'flash')")
+    rotate = _kv_rotate(axis, shift_impl)
     _check_gqa(q, k, v)
     size = ring.axis_size(axis)
     me = ring.axis_index(axis)
@@ -132,6 +167,7 @@ def ring_attention(
         return _ring_attention_flash(
             q, k, v, axis, size=size, me=me, q_offset=q_offset,
             causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+            rotate=rotate,
         )
 
     acc = jnp.zeros((B, H, T, D), jnp.float32)
@@ -151,14 +187,14 @@ def ring_attention(
         if step + 1 < size:
             # rotate K/V one neighbor over (ICI hop), like the reference's
             # SendRecvRing + swap(VA, VB)
-            kv = jax.tree.map(lambda x: ring.ring_shift(x, axis, 1), kv)
+            kv = rotate(kv)
 
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return jnp.einsum("bhtd->bthd", out).astype(q.dtype)
 
 
 def _ring_attention_flash(q, k, v, axis, *, size, me, q_offset, causal,
-                          scale, block_q, block_k):
+                          scale, block_q, block_k, rotate):
     """Flash per-step ring attention: each visiting K/V block is one
     Pallas partial attention (normalized within the block, with its
     logsumexp), merged into the running result by the standard
@@ -184,7 +220,7 @@ def _ring_attention_flash(q, k, v, axis, *, size, me, q_offset, causal,
                + o_b.astype(jnp.float32) * e_b[..., None]) / denom[..., None]
         lse = m + jnp.log(denom)
         if step + 1 < size:
-            kv = jax.tree.map(lambda x: ring.ring_shift(x, axis, 1), kv)
+            kv = rotate(kv)
 
     return out.astype(q.dtype)
 

@@ -1,0 +1,35 @@
+"""Known-bad: the fused (device-initiated) collective entry points in
+divergence-shaped and unchecked-permutation-shaped code. The ring runs
+inside a Pallas kernel, but every rank must still ENTER the kernel in
+lockstep — rank-guarding a fused collective is the same deadlock shape
+as rank-guarding an MPI call, and an unchecked pair list reaching
+``fused_permute`` strands a rank on a DMA that never arrives."""
+
+from jax import lax
+
+from hpc_patterns_tpu.comm import fused
+
+
+def rank_guarded_fused(x, axis):
+    me = lax.axis_index(axis)
+    if me == 0:  # EXPECT: collective-divergence
+        return fused.fused_allreduce(x, axis)
+    return x
+
+
+def fused_branch_mismatch(x, w, axis):
+    me = lax.axis_index(axis)
+    if me % 2:  # EXPECT: collective-divergence
+        y = fused.allgather_matmul(x, w, axis)
+    else:
+        y = fused.allreduce_into(x, axis)
+    return y
+
+
+def inline_pairs_fused(x, size):
+    return fused.fused_permute(x, "x", [(i, i ^ 1) for i in range(size)])  # EXPECT: unchecked-permutation
+
+
+def unchecked_name_fused(x, size):
+    pairs = [(i, (i + 3) % size) for i in range(size)]
+    return fused.fused_permute(x, "x", pairs)  # EXPECT: unchecked-permutation

@@ -1,0 +1,30 @@
+"""Known-clean: fused collectives issued unconditionally on every rank
+(rank branches stay data-only), and every ``fused_permute`` pair list
+sanitized by ``check_permutation`` first — the ``comm.fused`` module's
+own discipline."""
+
+import jax.numpy as jnp
+from jax import lax
+
+from hpc_patterns_tpu.comm import fused
+from hpc_patterns_tpu.comm.ring import check_permutation
+
+
+def data_only_rank_branch(x, axis):
+    me = lax.axis_index(axis)
+    contribution = jnp.where(me == 0, x, -x)
+    return fused.fused_allreduce(contribution, axis)
+
+
+def same_sequence_both_arms(x, w, axis, use_bias):
+    if use_bias:
+        y = fused.allgather_matmul(x, w, axis)
+    else:
+        y = fused.allgather_matmul(x, w, axis)
+    return fused.allreduce_into(y, axis)
+
+
+def checked_pairs_fused(x, size):
+    pairs = [(i, (i + 3) % size) for i in range(size)]
+    check_permutation(pairs, size)
+    return fused.fused_permute(x, "x", pairs)

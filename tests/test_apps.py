@@ -12,7 +12,8 @@ import pytest
 from hpc_patterns_tpu.apps import allreduce_app, common, pingpong_app
 
 
-@pytest.mark.parametrize("extra", [[], ["-a"], ["--algorithm", "ring_chunked"]])
+@pytest.mark.parametrize("extra", [[], ["-a"], ["--algorithm", "ring_chunked"],
+                                   ["--algorithm", "fused"]])
 def test_allreduce_app_exits_success(capsys, extra):
     # small -p keeps CPU-mesh runtime trivial; 3 reps for speed
     rc = allreduce_app.main(["-p", "10", "--repetitions", "3", "--warmup", "1"] + extra)
@@ -57,13 +58,13 @@ def test_allreduce_app_size_sweep(tmp_path, capsys):
                              "--log", str(log)])
     out = capsys.readouterr().out
     assert rc == 0, out
-    assert "sweep: 9/9 points passed" in out
+    assert "sweep: 12/12 points passed" in out
     records = [json.loads(l) for l in log.read_text().splitlines()
                if '"result"' in l]
-    assert len(records) == 9  # 3 algorithms x p in {3,4,5}
+    assert len(records) == 12  # 4 algorithms x p in {3,4,5}
     algs = {r["name"] for r in records}
     assert algs == {"allreduce[ring]", "allreduce[ring_chunked]",
-                    "allreduce[collective]"}
+                    "allreduce[collective]", "allreduce[fused]"}
     assert all(r["success"] and r["world"] == 8 for r in records)
     sizes = sorted(r["elements"] for r in records
                    if r["name"] == "allreduce[collective]")
