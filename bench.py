@@ -150,6 +150,37 @@ def _fused_collective_detail() -> dict:
     }
 
 
+def _serving_plane_detail() -> dict:
+    """Serving-plane headline keys (round 10), captured in the same
+    measurement child as the overlap headline:
+
+    - ``plane_goodput_tok_s``: SLO-attained tok/s of an open-loop
+      stream routed across a homogeneous 2-replica plane;
+    - ``kv_migration_overlap_frac``: the measured fraction of each
+      KV-handoff window hidden under the destination replica's
+      in-flight decode chunk in the disaggregated 1-prefill/1-decode
+      shape (serving_plane/router.py).
+
+    Runs ``bench_serving.run_plane``'s smoke shape (oracle-exact on
+    every leg before any number is returned). Returns {} when there is
+    nothing to run on; a failed capture surfaces through the gate's
+    coverage-loss warning."""
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    import bench_serving
+
+    r = bench_serving.run_plane(**bench_serving.plane_smoke_config(),
+                                quiet=True)
+    return {
+        "plane_goodput_tok_s": round(r["plane_goodput_tok_s"], 1),
+        "kv_migration_overlap_frac": round(
+            r["kv_migration_overlap_frac"], 4),
+        "plane_migrations": r["migrations"],
+    }
+
+
 def _unavailable_line(err: BaseException) -> str:
     """Degenerate-capture verdict line for a backend that won't even
     initialize (value 0.0, never a pass, the error preserved)."""
@@ -457,6 +488,16 @@ def main() -> int:
         fused_detail = {"fused_collective_error":
                         f"{type(err).__name__}: {err}"}
 
+    # the serving-plane row (round 10): router goodput across 2
+    # replicas + the KV-migration overlap fraction of the
+    # disaggregated 1p/1d shape (bench_serving.run_plane smoke —
+    # oracle-exact before either number exists)
+    try:
+        plane_detail = _serving_plane_detail()
+    except Exception as err:  # noqa: BLE001 — never sink the headline
+        plane_detail = {"serving_plane_error":
+                        f"{type(err).__name__}: {err}"}
+
     # any clamped-to-zero component means the run measured nothing usable
     degenerate = min(t_overlap, t_serial, t_dma, t_comp) <= 0
     if degenerate:
@@ -488,6 +529,7 @@ def main() -> int:
                     if measure_error is not None else None,
                     "backend": jax.default_backend(),
                     **fused_detail,
+                    **plane_detail,
                     # the five raw (serial, overlap) pairs, measurement
                     # order — the distribution behind the median
                     "pairs_us": [

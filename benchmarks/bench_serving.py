@@ -2,7 +2,18 @@
 
 Usage: python benchmarks/bench_serving.py [--n=N] [--slots=S] [--chunk=K]
          [--mix=0|1] [--buckets=auto|none|16,32,...] [--overlap=0|1]
-         [--temp=T] [--topk=K] [--smoke] [--scenario]
+         [--temp=T] [--topk=K] [--smoke] [--scenario] [--plane]
+
+``--plane``: the SERVING-PLANE row (round 10) — one open-loop stream
+through a single engine, a homogeneous 2-replica router plane, and
+the disaggregated 1-prefill/1-decode plane with KV-page migration
+overlapped behind the decode chunk (``hpc_patterns_tpu/
+serving_plane/``). The bucket ladder is FIT from the stream's
+observed prompt lengths (``serving.fit_bucket_ladder``) and must beat
+the default ladder's expected padding; every leg is oracle-exact
+(migrated rows included) before any number prints. Headline keys
+``plane_goodput_tok_s`` / ``kv_migration_overlap_frac`` are captured
+into ``bench.py``'s detail and gated by ``harness/regress.py``.
 
 ``--scenario``: the ROBUSTNESS row (round 8) — an OPEN-loop two-class
 stream (harness/loadgen.py) served under page pressure that forces
@@ -66,7 +77,10 @@ from hpc_patterns_tpu.models import TransformerConfig
 from hpc_patterns_tpu.models.decode import paged_generate
 from hpc_patterns_tpu.models.serving import (
     ContinuousBatcher,
+    EngineCore,
     bucket_ladder,
+    expected_padding,
+    fit_bucket_ladder,
     pad_to_bucket,
     prefill_cache_size,
 )
@@ -501,7 +515,210 @@ def run_scenario(*, cfg, params, schedule, classes, page_size, slots,
     return result
 
 
+def plane_smoke_config():
+    """The CI plane shape (tier-1 via tests/test_bench_serving.py): a
+    seeded open-loop two-class stream through (a) one engine, (b) a
+    2-replica homogeneous plane, (c) the disaggregated 1-prefill/
+    1-decode plane — small enough for seconds on the CPU mesh, long
+    enough that most migrations land behind an in-flight decode chunk
+    (the overlap floor the tier-1 test pins)."""
+    base = smoke_config()
+    return dict(cfg=base["cfg"], params=base["params"], n=12,
+                slots=3, chunk=8, page_size=16, prompt_len=32,
+                max_budget=64, rate_rps=200.0, seed=11)
+
+
+def plane_full_config(on_tpu: bool):
+    """The re-grounding shape: the scenario model at a heavier stream."""
+    base = scenario_full_config(on_tpu)
+    prompt_top = 128 if on_tpu else 32
+    budget_top = 256 if on_tpu else 128
+    return dict(cfg=base["cfg"], params=base["params"], n=32,
+                slots=8 if on_tpu else 4, chunk=16,
+                page_size=256 if on_tpu else 16,
+                prompt_len=prompt_top, max_budget=budget_top,
+                rate_rps=32.0, seed=11,
+                # per-chip replica placement (own weight copy, real
+                # cross-device KV migration) is a chip-leg claim; the
+                # CPU's virtual devices share one host
+                place_on_devices=on_tpu)
+
+
+def run_plane(*, cfg, params, n, slots, chunk, page_size, prompt_len,
+              max_budget, rate_rps, seed=11, place_on_devices=False,
+              quiet=False):
+    """The serving-plane row: one open-loop stream through three legs
+    — single engine (the baseline), a homogeneous 2-replica plane
+    (router + least-loaded placement), and the disaggregated
+    1-prefill/1-decode plane (KV migration overlapped behind the
+    decode chunk). Every leg's served sequences are token-exact vs
+    standalone ``paged_generate`` before any number is believed; the
+    ladder is FIT from the stream's observed prompt lengths
+    (serving.fit_bucket_ladder — the round-6 autotuning item) and must
+    beat the default ladder's expected padding. Reports
+    ``plane_goodput_tok_s`` (2-replica leg) and
+    ``kv_migration_overlap_frac`` (1p/1d leg), the two keys
+    ``bench.py`` captures and ``harness/regress.py`` gates."""
+    from hpc_patterns_tpu.serving_plane.router import (
+        Replica,
+        ServingPlane,
+    )
+
+    out = print if not quiet else (lambda *a, **k: None)
+    rng = np.random.RandomState(13)
+    schedule = loadgen.make_schedule(
+        n, rate_rps=rate_rps, classes=SCENARIO_CLASSES,
+        prompt_lens=(prompt_len // 4, prompt_len // 2, prompt_len),
+        budgets=(max(1, max_budget // 8), max(1, max_budget // 2),
+                 max_budget),
+        budget_probs=(0.5, 0.3, 0.2), process="poisson", seed=seed)
+    prompts = {r.index: rng.randint(0, cfg.vocab, size=r.prompt_len)
+               .astype(np.int32) for r in schedule.requests}
+    targets = slo.targets_from_classes(SCENARIO_CLASSES)
+
+    # bucket-ladder autotuning from the OBSERVED prompt-length
+    # distribution (open since round 6): the fit ladder must beat the
+    # shape-blind default on expected padding, and both router and
+    # engines run it
+    lengths = [r.prompt_len for r in schedule.requests]
+    default_ladder = bucket_ladder(prompt_len)
+    buckets = fit_bucket_ladder(lengths, len(default_ladder),
+                                max_len=prompt_len)
+    pad_fit = expected_padding(buckets, lengths)
+    pad_default = expected_padding(default_ladder, lengths)
+    assert pad_fit <= pad_default, (
+        f"fit ladder {buckets} pads worse than default "
+        f"{default_ladder}: {pad_fit:.2f} vs {pad_default:.2f}")
+
+    pages_per_seq = max(
+        EngineCore.pages_needed(r.prompt_len, r.max_new, page_size,
+                                padded_len=pad_to_bucket(
+                                    buckets, r.prompt_len))
+        for r in schedule.requests)
+    pool = slots * pages_per_seq
+
+    def mk_engine(device=None):
+        # with a device, the replica gets its OWN weight copy there
+        # (the multi-chip serving shape: one replica per chip, KV
+        # migration a real cross-device copy). Off by default on the
+        # CPU smoke: the virtual devices share one host, so placement
+        # only adds copies — the chip leg is where it means something.
+        import contextlib
+
+        p = (jax.device_put(params, device) if device is not None
+             else params)
+        ctx = (jax.default_device(device) if device is not None
+               else contextlib.nullcontext())
+        with ctx:
+            return EngineCore(
+                p, cfg, slots=slots, pool_pages=pool,
+                pages_per_seq=pages_per_seq, page_size=page_size,
+                chunk=chunk, prompt_buckets=buckets)
+
+    def arrivals():
+        return [(r.t_arrival_s,
+                 dict(prompt=prompts[r.index], max_new=r.max_new,
+                      priority=r.priority, deadline_s=r.deadline_s))
+                for r in schedule.requests]
+
+    def run_single():
+        eng = ContinuousBatcher(
+            params, cfg, slots=slots, pool_pages=pool,
+            pages_per_seq=pages_per_seq, page_size=page_size,
+            chunk=chunk, prompt_buckets=buckets, slo=targets)
+        got = eng.run(arrivals=arrivals())
+        return got, eng
+
+    def run_plane_leg(roles):
+        devs = jax.devices() if place_on_devices else []
+        replicas = []
+        for i, role in enumerate(roles):
+            dev = devs[i % len(devs)] if len(devs) > 1 else None
+            replicas.append(Replica(mk_engine(dev), name=f"r{i}",
+                                    role=role, device=dev))
+        plane = ServingPlane(replicas, slo=targets)
+        got = plane.run(arrivals=arrivals())
+        return got, plane
+
+    oracle_cache: dict = {}
+
+    def check(outs):
+        # the standalone oracle depends only on (prompt, budget) —
+        # identical across the three legs, so compute each once
+        for r in schedule.requests:
+            if len(outs.get(r.index, ())) == 0:
+                continue  # shed: empty by contract
+            want = oracle_cache.get(r.index)
+            if want is None:
+                want = oracle_cache[r.index] = np.asarray(
+                    paged_generate(
+                        params, jnp.asarray(prompts[r.index])[None],
+                        cfg, r.max_new, page_size=page_size))[0]
+            np.testing.assert_array_equal(
+                outs[r.index], want, err_msg=f"plane seq {r.index}")
+
+    # warmup (compiles shared across engines — one jit cache per
+    # static config), then the timed legs
+    run_single()
+    t0 = time.perf_counter()
+    single_out, single = run_single()
+    t_single = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    plane_out, plane2 = run_plane_leg(["both", "both"])
+    t_plane = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    disagg_out, disagg = run_plane_leg(["prefill", "decode"])
+    t_disagg = time.perf_counter() - t0
+    check(single_out)
+    check(plane_out)
+    check(disagg_out)
+    assert disagg.migrations > 0, "disaggregated leg migrated nothing"
+
+    tot1 = single.last_slo["total"]
+    tot2 = plane2.last_slo["total"]
+    totd = disagg.last_slo["total"]
+    overlap = disagg.last_kv_migration_overlap_frac or 0.0
+    result = {
+        "t_single": t_single, "t_plane": t_plane, "t_disagg": t_disagg,
+        "single_goodput_tok_s": tot1["goodput_tok_s"]
+        * single._serve_s / t_single if t_single > 0 else 0.0,
+        "plane_goodput_tok_s": tot2["goodput_tok_s"]
+        * plane2._serve_s / t_plane if t_plane > 0 else 0.0,
+        "disagg_goodput_tok_s": totd["goodput_tok_s"]
+        * disagg._serve_s / t_disagg if t_disagg > 0 else 0.0,
+        "kv_migration_overlap_frac": overlap,
+        "migrations": disagg.migrations,
+        "shed": tot2["shed"] + totd["shed"],
+        "ladder_fit": list(buckets),
+        "ladder_default": list(default_ladder),
+        "expected_padding_fit": pad_fit,
+        "expected_padding_default": pad_default,
+        "schedule": schedule.spec,
+    }
+    out(f"plane: n={n} slots={slots}x chunk={chunk} "
+        f"pool={pool}p ladder fit {buckets} "
+        f"(E[pad] {pad_fit:.1f} vs default {pad_default:.1f})")
+    out(f"  single    : {t_single:.3f}s  "
+        f"{result['single_goodput_tok_s']:,.1f} goodput tok/s")
+    out(f"  2-replica : {t_plane:.3f}s  "
+        f"{result['plane_goodput_tok_s']:,.1f} goodput tok/s  "
+        f"(routed {tot2['n']} reqs, shed {tot2['shed']})")
+    out(f"  1p/1d     : {t_disagg:.3f}s  "
+        f"{result['disagg_goodput_tok_s']:,.1f} goodput tok/s  "
+        f"migrations {disagg.migrations}  "
+        f"kv overlap {overlap:.1%}")
+    out("  oracle-exact on all three legs (migrated rows included)")
+    return result
+
+
 def main():
+    if arg("plane", False, bool):
+        if arg("smoke", False, bool):
+            run_plane(**plane_smoke_config())
+        else:
+            run_plane(**plane_full_config(
+                jax.default_backend() == "tpu"))
+        return
     if arg("scenario", False, bool):
         if arg("smoke", False, bool):
             run_scenario(**scenario_smoke_config())

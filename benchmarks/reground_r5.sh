@@ -76,6 +76,17 @@ run "serving engine vs static" python benchmarks/bench_serving.py
 #     rows byte-identical to standalone) runs before any number prints.
 run "serving chaos/SLO scenario" python benchmarks/bench_serving.py --scenario
 
+# 4c. SERVING-PLANE row (round 10): one open-loop stream through a
+#     single engine, a 2-replica router plane, and the disaggregated
+#     1-prefill/1-decode plane — per-chip replica placement on TPU
+#     (each replica its own weight copy; KV migration a real
+#     cross-device copy hidden behind the decode chunk). Headline keys
+#     plane_goodput_tok_s / kv_migration_overlap_frac are captured by
+#     bench.py and gated by harness/regress.py; the ladder is FIT from
+#     the stream (serving.fit_bucket_ladder) and every leg is
+#     oracle-exact before a number prints.
+run "serving plane 2-replica + 1p/1d" python benchmarks/bench_serving.py --plane
+
 # 5. aligned speculative pair + gamma sweep + batched impls (item 4, 7)
 run "make draft pair" python benchmarks/make_draft_pair.py --out=benchmarks/pair_r5
 run "speculative aligned sweep" python benchmarks/bench_speculative.py --pair=benchmarks/pair_r5 --batched=8
@@ -132,6 +143,20 @@ run "multi-proc FUSED allreduce trace (2 ranks)" env JAX_PLATFORMS=cpu \
   --log "${LOG%.log}_multiproc_fused.jsonl" -- \
   python -m hpc_patterns_tpu.apps.allreduce_app -p 16 --algorithm fused \
   --repetitions 5 --warmup 2 --trace
+
+# 7d. LAUNCHED serving plane, real engines (round 10): router +
+#     1 prefill + 1 decode replica as three OS processes; the merged
+#     timeline shows the KV-handoff flow arrows between the replica
+#     lanes (matched plane.kv_migration windows) and the schedule
+#     verdict proves router and replicas agreed on the handoff order.
+#     The stub tier of the same path runs in tier-1
+#     (tests/test_launch.py::TestServingPlaneLaunch).
+run "launched serving plane (1p/1d, real engines)" env JAX_PLATFORMS=cpu \
+  python -m hpc_patterns_tpu.apps.launch -np 3 --timeout 300 \
+  --trace-out "${LOG%.log}_plane.trace.json" \
+  --log "${LOG%.log}_plane.jsonl" -- \
+  python -m hpc_patterns_tpu.apps.plane_app --roles prefill,decode \
+  --rdv "${LOG%.log}_plane_rdv" --requests 8 --trace
 
 # 8. final health check + REGRESSION GATE: capture the closing round,
 #    write it as the next BENCH_rNN.json, and compare its headline

@@ -68,6 +68,20 @@ DEFAULT_DISPATCH_CRITICAL = frozenset({
     "_queue_order",
     "_admissible",
     "_can_resume",
+    # the round-10 serving-plane hot paths: one scheduler round, the
+    # router's migration export/transfer dispatch, and the KV-handoff
+    # install all run with (or behind) an in-flight decode chunk — a
+    # stray host sync there exposes exactly the handoff latency the
+    # plane exists to hide. The DELIBERATE syncs (the export snapshot,
+    # the completion measurement closing a migration window) carry
+    # justified suppressions in models/serving.py and
+    # serving_plane/router.py.
+    "service_round",
+    "export_migration",
+    "install_migration",
+    "_dispatch_migration",
+    "_install_pending",
+    "_complete_migrations",
 })
 
 # rule names are kebab-case identifiers; anything after the last name

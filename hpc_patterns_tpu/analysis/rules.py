@@ -628,6 +628,12 @@ _COLLECTIVE_NAMES = frozenset({
     # the same deadlock shape as around a host-driven collective
     "fused_allreduce", "allreduce_into", "allgather_matmul",
     "fused_permute", "fused_ring_shift",
+    # serving-plane KV handoff (serving_plane/migration.py,
+    # service.py): a migration has two parties that must agree on the
+    # (kv_migration, seq) schedule — rank-dependent control flow
+    # around the transfer entry points is the same desync shape the
+    # runtime verifier catches at merge time
+    "migrate_pages", "send_migration", "recv_migration",
 }) | _LAX_COLLECTIVES
 
 #: final names whose call result identifies the calling rank — the

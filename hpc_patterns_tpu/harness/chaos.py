@@ -39,6 +39,8 @@ Spec grammar (the ``HPCPAT_CHAOS`` env value, or
     stall:at=3,delay_ms=100                 # one stall at round 3
     die:rank=1,at=5                         # SIGKILL at collective 5
     die:rank=1,at=5,code=7                  # os._exit(7) instead
+    die:replica=2,at=5,site=replica_round   # kill ONE serving-plane
+                                            # replica at its 5th round
 
 ``rank`` matches the launcher's ``HPCPAT_PROCESS_ID`` (absent = rank 0;
 ``rank`` omitted = every rank). Delays may carry deterministic jitter
@@ -68,7 +70,13 @@ ENV_CHAOS = "HPCPAT_CHAOS"
 ENV_PROCESS_ID = "HPCPAT_PROCESS_ID"
 
 KINDS = ("straggler", "stall", "die")
-SITES = ("collective", "engine_round")
+#: ``replica_round`` (round 10): the serving plane's per-replica
+#: scheduler round (serving_plane/service.py probes it once per
+#: ``round`` message) — ``die:replica=2,at=5,site=replica_round``
+#: kills one REPLICA of many mid-stream, where the original ``die``
+#: killed one rank of one SPMD program. ``replica=`` is an alias for
+#: ``rank=``: in a launched plane each replica IS one launcher process.
+SITES = ("collective", "engine_round", "replica_round")
 
 #: default injection site per kind (overridable via ``site=``)
 _DEFAULT_SITE = {"straggler": "collective", "stall": "engine_round",
@@ -135,7 +143,10 @@ def parse(spec: str) -> tuple[Fault, ...]:
                 continue
             key, _, val = item.partition("=")
             key, val = key.strip(), val.strip()
-            if key == "rank":
+            if key in ("rank", "replica"):
+                # one replica of a launched serving plane IS one
+                # launcher process, so replica-targeting is rank-
+                # targeting under the plane's spelling
                 kw["rank"] = int(val)
             elif key == "at":
                 kw["at"] = int(val)

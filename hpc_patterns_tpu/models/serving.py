@@ -85,11 +85,32 @@ not cooperate:
   stalled-host fault perturbs the real loop (and shows up as bubble in
   the trace rollups).
 
+Serving-plane shape (round 10) — the engine core / transport split:
+
+- :class:`EngineCore` is the engine CORE — batching, paging, sampling,
+  preemption, and the per-round scheduler (:meth:`EngineCore.
+  service_round`) — with no opinion about where requests come from;
+- :class:`ContinuousBatcher` is the single-process SUBMISSION
+  TRANSPORT over it: the classic ``submit()``/``run()`` loop
+  (open-loop arrivals, bounded runs, the SLO rollup tail). Its
+  behavior is byte-identical to the pre-split engine;
+- the multi-replica serving plane (``hpc_patterns_tpu/serving_plane/``)
+  drives the SAME core through its router: N replicas each own an
+  :class:`EngineCore` and the router is just another transport. KV
+  MIGRATION (prefill/decode disaggregation) lives here as the core
+  primitives :meth:`EngineCore.export_migration` /
+  :meth:`EngineCore.install_migration`: a migrated request is
+  structurally a RESUME on another replica — the exported row state
+  (cursors, sampling key, KV pages) re-enters a peer engine exactly
+  where the donor left off, so the resume oracle extends to the
+  disaggregated path byte-for-byte (docs/serving_plane.md).
+
 Correctness contract (oracle-tested): every admitted sequence's
 emitted tokens are exactly ``paged_generate``'s for the same prompt,
 budget, and (when sampling) per-request key, regardless of what was
 scheduled around it — including sequences preempted and resumed along
-the way.
+the way, and sequences prefilled on one engine and decoded on another
+(the serving-plane migration oracle, tests/test_serving_plane.py).
 
 Reference lineage: the benchmark-IS-the-test discipline
 (aurora.mpich.miniapps/src/CMakeLists.txt:39-50) — the engine's
@@ -143,6 +164,83 @@ def bucket_ladder(max_len: int, *, lo: int = 16,
     return tuple(rungs)
 
 
+def fit_bucket_ladder(lengths, max_rungs: int, *,
+                      max_len: int | None = None) -> tuple[int, ...]:
+    """Fit a prompt-length ladder to an OBSERVED length sample: up to
+    ``max_rungs`` rungs minimizing the expected padding waste
+    ``E[rung(len) - len]`` over the sample — the data-driven
+    counterpart of :func:`bucket_ladder`'s shape-blind powers of two
+    (open since round 6; the serving plane's router and the plane
+    benchmark fit their ladder from a loadgen sample before building
+    replicas). Exact DP over the distinct observed lengths (optimal
+    rungs always sit ON sample points: lowering a rung to the largest
+    length it covers only removes padding), O(U^2 * R) for U distinct
+    lengths. ``max_len``: extend the top rung to cover prompts up to
+    this length even if the sample never reached it. Also reachable as
+    ``bucket_ladder.fit`` (the constructor spelling)."""
+    lengths = [int(t) for t in lengths]
+    if not lengths or min(lengths) < 1:
+        raise ValueError("fit_bucket_ladder needs a nonempty sample of "
+                         "positive lengths")
+    if max_rungs < 1:
+        raise ValueError(f"max_rungs must be >= 1, got {max_rungs}")
+    counts: dict[int, int] = {}
+    for t in lengths:
+        counts[t] = counts.get(t, 0) + 1
+    if max_len is not None and max_len > max(counts):
+        counts[int(max_len)] = counts.get(int(max_len), 0)
+    cand = sorted(counts)
+    n_cand = len(cand)
+    cnt = np.asarray([counts[c] for c in cand], np.int64)
+    val = np.asarray(cand, np.int64)
+    pc = np.concatenate([[0], np.cumsum(cnt)])
+    pv = np.concatenate([[0], np.cumsum(cnt * val)])
+
+    def seg_waste(i: int, j: int) -> int:
+        # lengths cand[i..j] all pad up to cand[j]
+        return int(val[j] * (pc[j + 1] - pc[i]) - (pv[j + 1] - pv[i]))
+
+    r_max = min(max_rungs, n_cand)
+    inf = float("inf")
+    # dp[r][j]: min waste covering cand[0..j] with r rungs, top = cand[j]
+    dp = [[inf] * n_cand for _ in range(r_max + 1)]
+    back = [[-1] * n_cand for _ in range(r_max + 1)]
+    for j in range(n_cand):
+        dp[1][j] = seg_waste(0, j)
+    for r in range(2, r_max + 1):
+        for j in range(r - 1, n_cand):
+            best, bi = inf, -1
+            for i in range(r - 2, j):
+                w = dp[r - 1][i] + seg_waste(i + 1, j)
+                if w < best:
+                    best, bi = w, i
+            dp[r][j], back[r][j] = best, bi
+    # the ladder must cover the sample max: chains end at the top cand
+    r_best = min(range(1, r_max + 1), key=lambda r: dp[r][n_cand - 1])
+    rungs, j, r = [], n_cand - 1, r_best
+    while r >= 1 and j >= 0:
+        rungs.append(int(val[j]))
+        j = back[r][j]
+        r -= 1
+    return tuple(sorted(rungs))
+
+
+bucket_ladder.fit = fit_bucket_ladder
+
+
+def expected_padding(buckets, lengths) -> float:
+    """Mean padded-minus-true tokens per prompt for ``lengths`` under
+    ``buckets`` (None = exact lengths, zero padding) — the objective
+    :func:`fit_bucket_ladder` minimizes, exposed so ladders can be
+    compared (the fit-beats-default pin in tests/test_serving_plane.py
+    and the plane benchmark's ladder report)."""
+    lengths = [int(t) for t in lengths]
+    if not lengths:
+        return 0.0
+    return float(sum(pad_to_bucket(buckets, t) - t
+                     for t in lengths)) / len(lengths)
+
+
 def pad_to_bucket(buckets, prompt_len: int) -> int:
     """The padded prefill length: the smallest ladder rung that fits
     (the exact length when ``buckets`` is None). THE single pad rule —
@@ -182,6 +280,45 @@ class Request:
     priority: int = 0
     deadline_s: float | None = None
     resume_prefix: np.ndarray | None = None
+
+
+@dataclass
+class MigrationBundle:
+    """One row's complete serving state, detached from its engine —
+    what a prefill-role replica hands a decode-role replica (the
+    serving plane's KV handoff, docs/serving_plane.md). Contains
+    everything :meth:`EngineCore.install_migration` needs to continue
+    the row EXACTLY where the donor stopped: the per-row cursors
+    (``pos``/``limit``), the current token, the post-admission sampling
+    key state, the per-row temperature, and the row's KV pages gathered
+    from the donor's pool (``pages_payload``: {cache key: per-layer
+    arrays with leading dim ``n_pages``} — device arrays on the
+    in-process path, numpy on the wire). A migrated request is
+    structurally a RESUME on another replica, so the round-8 resume
+    oracle extends to it byte-for-byte. ``seq`` is the plane-assigned
+    migration sequence number: both sides fingerprint it into the
+    collective schedule chain, which is how a router/replica desync is
+    caught at merge time."""
+    seq_id: int
+    prompt: np.ndarray       # THIS admission's (possibly resume) prompt
+    out: list                # tokens emitted so far (prefix included)
+    prefix: list             # tokens emitted before THIS admission
+    budget: int
+    pos: int
+    limit: int
+    token: int               # current device token (== out[-1])
+    key: np.ndarray          # (2,) uint32 post-admission key state
+    temp: float              # effective per-row temperature
+    temp_override: float | None
+    priority: int
+    deadline_s: float | None
+    t_submit: float
+    t_first: float | None
+    preemptions: int
+    n_pages: int
+    page_size: int
+    pages_payload: dict
+    seq: int = -1            # plane-assigned migration sequence number
 
 
 @dataclass
@@ -366,9 +503,24 @@ def _admit_row(pos, limit, tokens, keys, temps, logits, key, temp, slot,
     return pos, limit, tokens, keys, temps, first
 
 
-class ContinuousBatcher:
+@partial(jax.jit, donate_argnums=(0,))
+def _install_pages(pool, idx, payload):
+    """Scatter a migrated row's gathered pages into this engine's pool
+    at its newly allocated page ids — the device half of
+    :meth:`EngineCore.install_migration`. ``pool`` is donated (the pool
+    IS the capacity lever; an install must not double it), and the
+    scatter enqueues behind an in-flight decode chunk exactly like an
+    overlapped admission's table upload. Compiles per (pool shape,
+    payload page-count) — bounded by the engines' page geometries."""
+    return pool.at[idx].set(payload)
+
+
+class EngineCore:
     """Serve a stream of :class:`Request`s through ``slots`` concurrent
-    rows of one paged pool.
+    rows of one paged pool — the engine CORE (batching, paging,
+    sampling, preemption, migration), shared by the single-process
+    :class:`ContinuousBatcher` transport and the multi-replica serving
+    plane (``hpc_patterns_tpu/serving_plane/``).
 
     ``pool_pages``: the shared arena size (pages; one extra trash page
     is appended internally). ``pages_per_seq``: table width = the max
@@ -525,6 +677,11 @@ class ContinuousBatcher:
         self.stats: dict[int, dict] = {}
         self.last_slo: dict | None = None  # attainment of the last run
         self._serve_s = 0.0  # cumulative run() wall time (goodput base)
+        # chunk-window host stamps for the serving plane's migration-
+        # overlap accounting; off on the single-process path (the
+        # plane flips it on for decode-role replicas)
+        self.track_chunk_windows = False
+        self.chunk_windows: deque = deque(maxlen=8192)
         # observability hook (the framework's metrics/logging
         # subsystem, SURVEY.md §5): a callable taking keyword fields —
         # pass harness.RunLog.emit for JSONL records of admissions,
@@ -565,7 +722,8 @@ class ContinuousBatcher:
 
     def submit(self, prompt, max_new: int, seq_id: int | None = None, *,
                temperature: float | None = None, key=None,
-               priority: int = 0, deadline_s: float | None = None) -> int:
+               priority: int = 0, deadline_s: float | None = None,
+               resume_prefix=None) -> int:
         """Enqueue a sequence; returns its id. Tokens appear in
         ``finished[id]`` once served. ``temperature``/``key``: per-row
         sampling overrides (sampling engines only; key defaults to
@@ -573,7 +731,14 @@ class ContinuousBatcher:
         (admission order; with ``preempt=True``, may evict
         numerically-higher classes under page pressure).
         ``deadline_s``: shed the request (empty output, outcome
-        ``"shed"``) if still queued this long after submit."""
+        ``"shed"``) if still queued this long after submit.
+        ``resume_prefix``: tokens this request already emitted
+        elsewhere — ``prompt`` must then be the original prompt plus
+        those tokens, and the engine prepends them to the output
+        instead of re-emitting (the cross-replica resume path: the
+        serving-plane router re-queues a dead replica's in-flight
+        requests on survivors through this; within one engine,
+        preemption builds its resume Requests directly)."""
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1 or prompt.size == 0:
             raise ValueError(f"prompt must be 1-D nonempty, {prompt.shape}")
@@ -619,11 +784,18 @@ class ContinuousBatcher:
                 "would silently merge under one key"
             )
         self._next_id = max(self._next_id, sid) + 1
+        if resume_prefix is not None:
+            resume_prefix = np.asarray(resume_prefix, np.int32)
+            if resume_prefix.size > prompt.size:
+                raise ValueError(
+                    f"resume_prefix ({resume_prefix.size} tokens) longer "
+                    f"than the prompt ({prompt.size}) that must carry it")
         now = time.perf_counter()
         self._queue.append(Request(prompt, max_new, sid, t_submit=now,
                                    temperature=temperature, key=key,
                                    priority=int(priority),
-                                   deadline_s=deadline_s))
+                                   deadline_s=deadline_s,
+                                   resume_prefix=resume_prefix))
         self.stats[sid] = {
             "priority": int(priority), "t_submit": now, "t_first": None,
             "t_finish": None, "tokens": 0, "outcome": None,
@@ -1150,6 +1322,266 @@ class ContinuousBatcher:
             if pos_np[i] >= limit_np[i]:
                 self._finish(i)
 
+    def service_round(self, *, decode: bool = True, chaos_index=None,
+                      pre_collect=None) -> dict:
+        """ONE scheduler round — the core's unit of work, shared by
+        :meth:`ContinuousBatcher.run` and the serving plane's router
+        (which interleaves rounds across replicas): chaos probe,
+        preemption policy, decode-chunk dispatch (overlap mode:
+        FIRST, so admissions enqueue behind it), one admission pass,
+        deferred first-token readbacks, collect.
+
+        ``decode=False`` is the PREFILL-ROLE round: admissions run
+        (table upload, bucket-padded prefill, first-token pick) but no
+        decode chunk is ever dispatched — admitted rows park at their
+        first token awaiting :meth:`export_migration`. ``pre_collect``:
+        called with ``overlapped`` (True iff a decode chunk is in
+        flight) AFTER admissions and BEFORE the chunk readback — the
+        plane installs arrived KV migrations here, so the install's
+        device work enqueues behind the in-flight chunk exactly like an
+        overlapped admission. Returns ``{"admitted", "exposed_s"
+        (admission host time with nothing in flight), "stalled" (queue
+        waits but nothing admitted and nothing runs — the transport
+        decides whether that is a deadlock), "active"}``."""
+        if chaos_index is not None and chaoslib.active() is not None:
+            chaoslib.maybe_inject("engine_round", chaos_index)
+        if self.preempt:
+            self._maybe_preempt()
+        spec = self.draft_params is not None
+        dispatch = self._dispatch_spec if spec else self._dispatch_chunk
+        collect = self._collect_spec if spec else self._collect_chunk
+        inflight = None
+        t_chunk0 = 0.0
+        if decode and self.overlap and any(s.active for s in self._slots):
+            inflight = dispatch()
+            t_chunk0 = time.perf_counter()
+        t0 = time.perf_counter()
+        admitted = self._try_admit(overlapped=inflight is not None)
+        self._resolve_pending()
+        exposed_s = 0.0
+        stalled = False
+        if inflight is None:
+            exposed_s = time.perf_counter() - t0
+            if decode and any(s.active for s in self._slots):
+                inflight = dispatch()
+                t_chunk0 = time.perf_counter()
+            elif not any(s.active for s in self._slots):
+                stalled = bool(self._queue) and not admitted
+        if pre_collect is not None:
+            pre_collect(inflight is not None)
+        if inflight is not None:
+            collect(inflight)
+            if self.track_chunk_windows:
+                # host-clock (dispatch, readback-resolved) stamps of
+                # this chunk — the serving plane intersects migration
+                # windows with these to PROVE the KV handoff hid
+                # behind decode compute (kv_migration_overlap_frac)
+                self.chunk_windows.append(
+                    (t_chunk0, time.perf_counter()))
+        return {"admitted": admitted, "exposed_s": exposed_s,
+                "stalled": stalled,
+                "active": any(s.active for s in self._slots)}
+
+    # -- router-facing load observables ------------------------------------
+
+    @property
+    def free_page_count(self) -> int:
+        return len(self.free_pages)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def active_count(self) -> int:
+        return sum(1 for s in self._slots if s.active)
+
+    def has_work(self) -> bool:
+        return bool(self._queue) or any(s.active for s in self._slots)
+
+    def would_fit(self, prompt_len: int, max_new: int) -> bool:
+        """Could this engine EVER serve the request (table width, pool
+        size, ladder, max_seq) — the router's placement feasibility
+        check, distinct from :meth:`_admissible`'s right-now check."""
+        try:
+            padded = self._bucket_len(int(prompt_len))
+        except ValueError:
+            return False
+        need = self._pages_for(prompt_len, max_new)
+        return (need <= min(self.pages_per_seq, self.pool_pages)
+                and max(prompt_len + max_new, padded) <= self.cfg.max_seq)
+
+    # -- migration (the serving plane's KV handoff) ------------------------
+
+    def migration_admissible(self, n_pages: int) -> bool:
+        """Could :meth:`install_migration` of an ``n_pages`` bundle
+        succeed right now? Free slot + free pages; migrations bypass
+        the fresh-admission high-water mark for the same reason resumes
+        do — their tokens are already paid for."""
+        return (any(not s.active for s in self._slots)
+                and n_pages <= len(self.free_pages)
+                and n_pages <= self.pages_per_seq)
+
+    def exportable_slots(self) -> list[int]:
+        """Active rows whose first token is resolved and whose budget
+        is not yet exhausted — what a prefill-role replica offers the
+        router for migration after a ``decode=False`` round."""
+        return [i for i, s in enumerate(self._slots)
+                if s.active and i not in self._pending]
+
+    def export_migration(self, slot: int) -> MigrationBundle:
+        """Detach one active row into a :class:`MigrationBundle` and
+        release its slot/pages — the donor half of the KV handoff.
+
+        Runs at a chunk boundary with the row's device work resolved
+        (a prefill-role engine never has a chunk in flight), so the
+        cursor/key snapshot is a DELIBERATE sync point — the same
+        contract as preemption's snapshot, and the same copy
+        discipline: ``np.array`` COPIES, because the device_get view
+        aliases buffers a later ``_chunk_step`` donates. The KV pages
+        are GATHERED device-side (``pool[idx]`` — a new buffer, no
+        host readback of K/V anywhere on the in-process path)."""
+        st = self._slots[slot]
+        if not st.active or slot in self._pending or st.prompt is None:
+            raise ValueError(f"slot {slot} has no exportable row")
+        if self.draft_params is not None:
+            raise ValueError(
+                "draft-assisted engines do not migrate: the draft "
+                "cache's row state would have to move too")
+        # jaxlint: disable=host-sync-in-dispatch — the export snapshot
+        # IS a deliberate sync point at a chunk boundary (the resume
+        # contract, same as _preempt's key snapshot); np.array COPIES
+        pos = int(np.array(jax.device_get(self.pos))[slot])
+        # jaxlint: disable=host-sync-in-dispatch — same snapshot
+        limit = int(np.array(jax.device_get(self.limit))[slot])
+        # jaxlint: disable=host-sync-in-dispatch — same snapshot
+        token = int(np.array(jax.device_get(self.tokens))[slot])
+        # jaxlint: disable=host-sync-in-dispatch — same snapshot
+        key = np.array(jax.device_get(self.keys))[slot].copy()
+        # jaxlint: disable=host-sync-in-dispatch — same snapshot
+        temp = float(np.array(jax.device_get(self.temps))[slot])
+        idx = jnp.asarray(st.pages, dtype=jnp.int32)
+        payload = {
+            name: tuple(pool[idx] for pool in pools)
+            for name, pools in self.cache.items() if name != "table"
+        }
+        rec_s = self.stats.get(st.seq_id)
+        bundle = MigrationBundle(
+            seq_id=st.seq_id, prompt=st.prompt, out=list(st.out),
+            prefix=list(st.prefix), budget=st.budget, pos=pos,
+            limit=limit, token=token, key=key, temp=temp,
+            temp_override=st.temp_override, priority=st.priority,
+            deadline_s=st.deadline_s, t_submit=st.t_submit,
+            t_first=(rec_s or {}).get("t_first"),
+            preemptions=int((rec_s or {}).get("preemptions") or 0),
+            n_pages=len(st.pages), page_size=self.page_size,
+            pages_payload=payload,
+        )
+        if rec_s is not None:
+            rec_s["outcome"] = "migrated"
+        self._emit(kind="serve_migrate_out", seq_id=st.seq_id,
+                   slot=slot, pages=len(st.pages),
+                   tokens_done=len(st.out))
+        m = metricslib.get_metrics()
+        if m.enabled:
+            m.counter("serve.migrated_out").inc()
+        self._release_slot(slot)
+        return bundle
+
+    def install_migration(self, bundle: MigrationBundle) -> int:
+        """Continue a migrated row in THIS engine — the receiver half
+        of the KV handoff. Dispatch-only: the table upload, the page
+        scatters (:func:`_install_pages`, donated pools), and the
+        cursor/key seeding all enqueue without a host readback, so an
+        in-flight decode chunk is never stalled (the plane calls this
+        from ``service_round``'s ``pre_collect`` hook — behind the
+        chunk, the overlapped-admission discipline). Returns the slot.
+
+        Byte-exactness: the installed cursors/key/temp are the donor's
+        post-admission state and the KV pages are numerically
+        identical, so the next ``_chunk_step`` consumes exactly what
+        the donor's would have — the migrated row's remaining tokens
+        equal a colocated engine's (the disaggregation oracle)."""
+        if self.draft_params is not None:
+            raise ValueError("draft-assisted engines do not migrate")
+        if bundle.page_size != self.page_size:
+            raise ValueError(
+                f"page_size mismatch: bundle {bundle.page_size} vs "
+                f"engine {self.page_size} — pools are not layout-"
+                "compatible across different page sizes")
+        if not self.migration_admissible(bundle.n_pages):
+            raise ValueError(
+                f"migration of {bundle.n_pages} page(s) not admissible "
+                f"(free slots {sum(1 for s in self._slots if not s.active)}, "
+                f"free pages {len(self.free_pages)})")
+        if bundle.seq_id in self.finished \
+                or any(r.seq_id == bundle.seq_id for r in self._queue) \
+                or any(s.active and s.seq_id == bundle.seq_id
+                       for s in self._slots):
+            raise ValueError(
+                f"seq_id {bundle.seq_id} already known to this engine")
+        slot = next(i for i, s in enumerate(self._slots) if not s.active)
+        pages = [self.free_pages.pop() for _ in range(bundle.n_pages)]
+        # jaxlint: disable=host-sync-in-dispatch — host-list packing of
+        # the wire bundle's prompt, not a device readback (the same
+        # contract as _preempt's resume-Request packing)
+        prompt = np.asarray(bundle.prompt, np.int32)
+        row = np.full((self.pages_per_seq,), self.trash, np.int32)
+        row[:bundle.n_pages] = pages
+        self._table[slot] = row
+        self.cache["table"] = jnp.asarray(self._table)
+        idx = jnp.asarray(pages, dtype=jnp.int32)
+        for name, pools in list(self.cache.items()):
+            if name == "table":
+                continue
+            payload = bundle.pages_payload[name]
+            self.cache[name] = tuple(
+                _install_pages(pool, idx, jnp.asarray(pl))
+                for pool, pl in zip(pools, payload))
+        self.pos = self.pos.at[slot].set(jnp.int32(bundle.pos))
+        self.limit = self.limit.at[slot].set(jnp.int32(bundle.limit))
+        self.tokens = self.tokens.at[slot].set(jnp.int32(bundle.token))
+        self.keys = self.keys.at[slot].set(
+            jnp.asarray(bundle.key, jnp.uint32))
+        self.temps = self.temps.at[slot].set(jnp.float32(bundle.temp))
+        st = self._slots[slot]
+        st.seq_id = bundle.seq_id
+        st.pages = pages
+        st.prompt_len = int(prompt.size)
+        st.budget = bundle.budget
+        st.out = list(bundle.out)
+        st.prefix = list(bundle.prefix)
+        st.active = True
+        st.t_submit = bundle.t_submit
+        st.t_admit = time.perf_counter()
+        st.prompt = prompt
+        st.priority = bundle.priority
+        st.deadline_s = bundle.deadline_s
+        st.temp_override = bundle.temp_override
+        self.stats[bundle.seq_id] = {
+            "priority": bundle.priority, "t_submit": bundle.t_submit,
+            "t_first": bundle.t_first, "t_finish": None,
+            "tokens": 0, "outcome": None,
+            "preemptions": bundle.preemptions,
+        }
+        self._emit(kind="serve_migrate_in", seq_id=bundle.seq_id,
+                   slot=slot, pages=bundle.n_pages, seq=bundle.seq,
+                   tokens_done=len(st.out))
+        m = metricslib.get_metrics()
+        if m.enabled:
+            m.counter("serve.migrated_in").inc()
+            m.gauge("serve.free_pages").set(len(self.free_pages))
+        return slot
+
+
+class ContinuousBatcher(EngineCore):
+    """The single-process serving engine: :class:`EngineCore` plus the
+    classic submission transport — ``submit()`` requests, then
+    :meth:`run` until everything drains. The serving plane drives the
+    same core through its router instead (one EngineCore per replica);
+    this class exists so the single-process path keeps its pre-split
+    surface byte-identically."""
+
     def run(self, *, arrivals=None, max_rounds: int | None = None):
         """Serve until queue, slots, and (open-loop) arrivals drain.
         Returns ``finished``: {seq_id: np.ndarray of emitted tokens
@@ -1186,9 +1618,6 @@ class ContinuousBatcher:
         dispatch/admit/collect round."""
         t_run0 = time.perf_counter()
         t_exposed = 0.0
-        spec = self.draft_params is not None
-        dispatch = self._dispatch_spec if spec else self._dispatch_chunk
-        collect = self._collect_spec if spec else self._collect_chunk
         pending_arrivals = (deque(sorted(arrivals, key=lambda a: a[0]))
                             if arrivals else None)
         chaos_on = chaoslib.active() is not None
@@ -1225,29 +1654,16 @@ class ContinuousBatcher:
             if max_rounds is not None and rounds >= max_rounds:
                 break
             rounds += 1
-            if chaos_on:
-                chaoslib.maybe_inject("engine_round", rounds - 1)
-            if self.preempt:
-                self._maybe_preempt()
-            inflight = None
-            if self.overlap and any(s.active for s in self._slots):
-                inflight = dispatch()
-            t0 = time.perf_counter()
-            admitted = self._try_admit(overlapped=inflight is not None)
-            self._resolve_pending()
-            if inflight is None:
-                t_exposed += time.perf_counter() - t0
-                if not any(s.active for s in self._slots):
-                    if self._queue and not admitted:
-                        raise RuntimeError(
-                            "serving deadlock: waiting requests but no "
-                            "admissible slot/pages (pool too small for "
-                            "the smallest waiting request, or "
-                            "admit_highwater leaves it no headroom)"
-                        )
-                    continue  # everything admitted finished at admit
-                inflight = dispatch()
-            collect(inflight)
+            r = self.service_round(
+                chaos_index=rounds - 1 if chaos_on else None)
+            t_exposed += r["exposed_s"]
+            if r["stalled"]:
+                raise RuntimeError(
+                    "serving deadlock: waiting requests but no "
+                    "admissible slot/pages (pool too small for "
+                    "the smallest waiting request, or "
+                    "admit_highwater leaves it no headroom)"
+                )
         total = time.perf_counter() - t_run0
         self.last_bubble_frac = (t_exposed / total) if total > 0 else 0.0
         self._serve_s += total

@@ -1,0 +1,45 @@
+"""Disaggregated multi-replica serving plane (round 10).
+
+The ContinuousBatcher is a single-process engine; this package is the
+serving *system* above it — the scale jump from one engine to N:
+
+- ``router.py``   — the in-process plane: a front-end router admitting
+  an open-loop stream across N :class:`~hpc_patterns_tpu.models.
+  serving.EngineCore` replicas with a pluggable placement policy
+  (least-loaded by free pages / round-robin / prefill-decode
+  role-aware), per-replica queue-depth + goodput accounting through
+  the metrics/SLO layer, and prefill/decode DISAGGREGATION: KV pages
+  migrate from prefill-role to decode-role replicas with the transfer
+  dispatched BEFORE the decode chunk, so it hides behind compute
+  exactly like round-6 overlapped admission (the serving analog of
+  the reference's hide-traffic-behind-compute discipline).
+- ``migration.py`` — the KV-handoff transfer: device-to-device
+  dispatch for in-process replicas on distinct devices (the ICI
+  analog), plus the wire codec the cross-process path shares.
+- ``service.py``  — the cross-process plane (import-light, jax-free):
+  a socket replica server + router client driven by
+  ``apps/launch.py`` (one replica per launched process — the DCN
+  analog), with replica-death detection and resume-on-survivor.
+
+Import discipline: this ``__init__`` stays lazy so launcher children
+can ``import hpc_patterns_tpu.serving_plane.service`` without paying
+(or even having) jax. See docs/serving_plane.md.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "Replica": "hpc_patterns_tpu.serving_plane.router",
+    "ServingPlane": "hpc_patterns_tpu.serving_plane.router",
+    "PLACEMENT_POLICIES": "hpc_patterns_tpu.serving_plane.router",
+    "migrate_pages": "hpc_patterns_tpu.serving_plane.migration",
+}
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)

@@ -1,0 +1,131 @@
+"""KV-page migration transfer: the serving plane's handoff wire.
+
+The bundle itself (cursors, sampling key state, gathered KV pages) is
+built by :meth:`~hpc_patterns_tpu.models.serving.EngineCore.
+export_migration` and consumed by :meth:`~hpc_patterns_tpu.models.
+serving.EngineCore.install_migration`; this module owns what happens
+BETWEEN the two engines:
+
+- :func:`migrate_pages` — the in-process transfer: ``jax.device_put``
+  of every page payload onto the destination replica's device,
+  dispatched asynchronously so the copy flies while the destination's
+  decode chunk computes (the ICI analog of the reference's
+  hide-traffic-behind-compute pattern; replicas sharing one device
+  pass through untouched — the copy would be a no-op).
+- :func:`bundle_to_wire` / :func:`bundle_from_wire` — the byte codec
+  the cross-process plane (``serving_plane/service.py``) ships over
+  its sockets: raw little-endian buffers base64-wrapped in JSON, with
+  shape/dtype alongside, so the decode side reconstructs bit-identical
+  arrays (the disaggregation oracle crosses the wire intact).
+
+``migrate_pages`` is a COLLECTIVE in the schedule-verifier sense: both
+sides of a handoff fingerprint ``(kv_migration, seq)`` into their
+hash chains (``analysis/runtime.py``), so a router/replica desync —
+a bundle exported but never installed, or installed out of order —
+is caught at merge time exactly like a diverged allreduce schedule.
+shardlint knows the name (``_COLLECTIVE_NAMES``) for the same reason.
+"""
+
+from __future__ import annotations
+
+import base64
+from dataclasses import replace
+
+import numpy as np
+
+from hpc_patterns_tpu.models.serving import MigrationBundle
+
+
+def migrate_pages(bundle: MigrationBundle, device=None) -> MigrationBundle:
+    """Dispatch the KV-page transfer toward the destination replica.
+
+    With ``device`` set (replicas on distinct devices), every payload
+    array is ``jax.device_put`` onto it — an ASYNC copy that the
+    destination's in-flight decode chunk hides; the returned bundle's
+    payload holds the destination-resident futures. ``device=None``
+    (replicas sharing a device) passes the bundle through — the
+    install's scatter consumes the gathered arrays in place."""
+    if device is None:
+        return bundle
+    import jax
+
+    payload = {
+        name: tuple(jax.device_put(a, device) for a in arrs)
+        for name, arrs in bundle.pages_payload.items()
+    }
+    return replace(bundle, pages_payload=payload)
+
+
+# ---------------------------------------------------------------------------
+# wire codec (shared with the jax-free socket plane)
+# ---------------------------------------------------------------------------
+
+
+def _arr_to_wire(a) -> dict:
+    a = np.asarray(a)
+    return {"shape": list(a.shape), "dtype": str(a.dtype),
+            "b64": base64.b64encode(np.ascontiguousarray(a).tobytes())
+            .decode("ascii")}
+
+
+def _arr_from_wire(d) -> np.ndarray:
+    return np.frombuffer(
+        base64.b64decode(d["b64"]), dtype=np.dtype(d["dtype"])
+    ).reshape(d["shape"]).copy()
+
+
+def bundle_to_wire(bundle: MigrationBundle) -> dict:
+    """JSON-able dict for the socket plane. Device payloads are read
+    back here — the wire path IS the host-staged DCN analog; the
+    in-process path never calls this."""
+    return {
+        "seq_id": int(bundle.seq_id),
+        "prompt": _arr_to_wire(bundle.prompt),
+        "out": [int(t) for t in bundle.out],
+        "prefix": [int(t) for t in bundle.prefix],
+        "budget": int(bundle.budget),
+        "pos": int(bundle.pos), "limit": int(bundle.limit),
+        "token": int(bundle.token),
+        "key": _arr_to_wire(bundle.key),
+        "temp": float(bundle.temp),
+        "temp_override": bundle.temp_override,
+        "priority": int(bundle.priority),
+        "deadline_s": bundle.deadline_s,
+        "t_submit": float(bundle.t_submit),
+        "t_first": bundle.t_first,
+        "preemptions": int(bundle.preemptions),
+        "n_pages": int(bundle.n_pages),
+        "page_size": int(bundle.page_size),
+        "payload": {
+            name: [_arr_to_wire(a) for a in arrs]
+            for name, arrs in bundle.pages_payload.items()
+        },
+        "seq": int(bundle.seq),
+    }
+
+
+def bundle_from_wire(wire: dict) -> MigrationBundle:
+    """Reconstruct a bundle bit-identically from its wire dict."""
+    return MigrationBundle(
+        seq_id=int(wire["seq_id"]),
+        prompt=_arr_from_wire(wire["prompt"]),
+        out=list(wire["out"]), prefix=list(wire["prefix"]),
+        budget=int(wire["budget"]),
+        pos=int(wire["pos"]), limit=int(wire["limit"]),
+        token=int(wire["token"]),
+        key=_arr_from_wire(wire["key"]),
+        temp=float(wire["temp"]),
+        temp_override=wire.get("temp_override"),
+        priority=int(wire["priority"]),
+        deadline_s=wire.get("deadline_s"),
+        t_submit=float(wire["t_submit"]),
+        t_first=wire.get("t_first"),
+        preemptions=int(wire.get("preemptions") or 0),
+        n_pages=int(wire["n_pages"]),
+        page_size=int(wire["page_size"]),
+        pages_payload={
+            name: tuple(_arr_from_wire(a) for a in arrs)
+            for name, arrs in wire["payload"].items()
+        },
+        seq=int(wire.get("seq", -1)),
+    )

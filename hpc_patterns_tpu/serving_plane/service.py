@@ -1,0 +1,883 @@
+"""Cross-process serving plane: socket replicas + router client.
+
+The in-process plane (``serving_plane/router.py``) is the oracle
+tier; this module is the LAUNCHED tier — one replica per OS process
+under ``apps/launch.py`` (the mpirun analog), a router process
+driving them over localhost TCP (newline-delimited JSON). The socket
+hop is the DCN analog of the in-process ``device_put`` path: KV
+bundles cross it bit-identically (``migration.bundle_to_wire``), and
+both sides fingerprint every handoff into their collective-schedule
+chains, so the cross-rank trace merge proves the router and replicas
+agreed on the migration schedule (verdict ``consistent``) and threads
+KV-handoff flow arrows between the replica lanes.
+
+Import-light ON PURPOSE (stdlib + numpy-free): launcher children in
+the tier-1 replica-chaos tests run STUB engines — a deterministic
+jax-free token generator behind the same protocol — so the router's
+failure handling (death detection, resume-on-survivor, shed
+accounting) is exercised in milliseconds. Real engines enter through
+:class:`EngineAdapter` subclasses that import jax lazily.
+
+Protocol (one JSON object per line, request/response):
+
+- ``hello``   -> replica identity + geometry + load
+- ``submit``  -> enqueue a request (``resume_prefix`` for re-queued
+  work from a dead replica)
+- ``round``   -> run ONE service round (the chaos ``replica_round``
+  site fires here); reply carries finished rows, per-row progress
+  (the router's resume checkpoint), exported KV bundles, and load
+- ``migrate`` -> queue a KV bundle for install behind the next round's
+  decode chunk
+- ``stop``    -> drain the connection; the server loop returns
+
+Replica death: a ``die`` chaos fault (or any crash) severs the socket
+mid-call; the router marks the replica dead and RE-QUEUES its
+in-flight requests as resumes on survivors — prompt = original +
+tokens observed so far, ``resume_prefix`` carrying them — or counts
+them SHED in the SLO table when no survivor can take them. Nothing is
+dropped silently (the round-10 acceptance bar).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import socket
+import time
+from collections import deque
+from pathlib import Path
+
+from hpc_patterns_tpu.analysis import runtime as analysis_runtime
+from hpc_patterns_tpu.harness import chaos as chaoslib
+from hpc_patterns_tpu.harness import slo as slolib
+
+
+class ReplicaDead(Exception):
+    """The socket to a replica broke mid-protocol."""
+
+
+#: device-subtrack layout for ``plane.kv_migration`` windows, shared
+#: by EVERY party to a handoff (the in-process plane in router.py;
+#: the socket plane's donor and receiver here): the cross-rank merge
+#: matches windows by (name, seq), and concurrent migrations must not
+#: share a subtrack (Chrome sync slices on one track must nest). Base
+#: 64 clears the decode chunk's track 0 and the per-slot admission
+#: subtracks (slot+1) for any realistic slot count. Defined in this
+#: import-light module so the jax-free stub tier never pays for the
+#: jax-side migration codec.
+MIG_TRACK_BASE = 64
+MIG_TRACKS = 8
+
+
+def migration_track(seq: int) -> int:
+    """The device subtrack a migration's windows land on — ONE
+    formula for donor, receiver, and the in-process plane, or the
+    merged timeline's flow arrows silently stop threading."""
+    return MIG_TRACK_BASE + int(seq) % MIG_TRACKS
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+
+def send_msg(sock: socket.socket, obj: dict) -> None:
+    try:
+        sock.sendall((json.dumps(obj) + "\n").encode())
+    except OSError as e:
+        raise ReplicaDead(str(e)) from e
+
+
+def recv_msg(rfile) -> dict | None:
+    try:
+        line = rfile.readline()
+    except OSError as e:
+        raise ReplicaDead(str(e)) from e
+    if not line:
+        return None
+    return json.loads(line)
+
+
+def send_migration(sock, rfile, wire_bundle: dict) -> dict:
+    """The router's half of one KV handoff: ship the bundle and wait
+    for the ack. Fingerprinting happens on the REPLICA sides (donor at
+    export, receiver at install) — the router is the carrier, not a
+    party to the schedule."""
+    send_msg(sock, {"op": "migrate", "bundle": wire_bundle})
+    reply = recv_msg(rfile)
+    if reply is None:
+        raise ReplicaDead("EOF during migrate")
+    return reply
+
+
+def _record_handoff(wire: dict, rec) -> float:
+    """Fingerprint one side of a handoff into the schedule chain and
+    open its device-track window; returns the window stamp (0.0
+    without a recorder). Both sides derive identical fingerprints from
+    the bundle itself — the donor at export, the receiver at arrival —
+    which is what makes the merge-time verdict meaningful: with one
+    prefill and one decode replica the two chains must be EQUAL, so a
+    bundle lost, duplicated, or reordered in the router reads as a
+    schedule divergence naming the first bad (op, seq)."""
+    analysis_runtime.record_collective(
+        "kv_migration", int(wire["seq"]),
+        shape=(int(wire["n_pages"]), int(wire["page_size"])),
+        dtype=wire.get("payload_dtype") or "uint8",
+        axis="plane", algorithm="socket")
+    if rec is None:
+        return 0.0
+    return rec.mark_dispatch(
+        "plane.kv_migration",
+        {"seq": int(wire["seq"]), "pages": int(wire["n_pages"]),
+         "seq_id": int(wire["seq_id"])},
+        track=migration_track(wire["seq"]))
+
+
+def record_export(wire: dict, rec) -> None:
+    """Donor-side handoff record: fingerprint + a closed device-track
+    window at the export instant. The donor assigns ``seq`` (its
+    export counter); the router carries it verbatim, so the receiver
+    fingerprints the identical value."""
+    t_disp = _record_handoff(wire, rec)
+    if rec is not None and t_disp:
+        rec.mark_complete(
+            "plane.kv_migration", t_disp,
+            {"seq": int(wire["seq"]), "side": "export"},
+            track=migration_track(wire["seq"]))
+
+
+def recv_migration(wire: dict, adapter: "EngineAdapter", rec) -> None:
+    """The receiver's half: fingerprint + window open on arrival, then
+    queue the bundle so the install runs BEHIND the next round's
+    decode chunk (the overlap discipline; the window closes when the
+    install completes inside the round)."""
+    t_disp = _record_handoff(wire, rec)
+    adapter.queue_install(wire, t_disp)
+
+
+# ---------------------------------------------------------------------------
+# engine adapters
+# ---------------------------------------------------------------------------
+
+
+class EngineAdapter:
+    """What the replica server needs from an engine; implemented by
+    :class:`StubAdapter` (jax-free, deterministic) and
+    :class:`RealAdapter` (an EngineCore)."""
+
+    role = "both"
+
+    def describe(self) -> dict:
+        raise NotImplementedError
+
+    def submit(self, req: dict) -> None:
+        raise NotImplementedError
+
+    def queue_install(self, wire: dict, t_disp: float) -> None:
+        raise NotImplementedError
+
+    def round(self, rec) -> dict:
+        """One service round; returns the ``round`` reply body."""
+        raise NotImplementedError
+
+
+def stub_token(orig_prompt, k: int) -> int:
+    """Token ``k`` of the stub generator: a pure function of the
+    ORIGINAL prompt, so a resume (prompt = original + emitted) and a
+    migrated continuation reproduce the uninterrupted stream exactly —
+    the stub plane keeps the same byte-exactness contract the real
+    engines get from causality."""
+    key = (",".join(str(int(t)) for t in orig_prompt)).encode()
+    h = hashlib.sha256(key + int(k).to_bytes(4, "little")).digest()
+    return int.from_bytes(h[:4], "little") % 251
+
+
+class StubAdapter(EngineAdapter):
+    """A deterministic jax-free engine behind the replica protocol:
+    page-pool accounting, slot admission, ``chunk`` tokens per round
+    per active row, prefill-role export, migration install. Exists so
+    the launched plane's ROUTER mechanics (placement, death recovery,
+    shed accounting, handoff fingerprints) are tier-1-testable in
+    milliseconds."""
+
+    def __init__(self, *, slots: int = 2, pool_pages: int = 16,
+                 pages_per_seq: int = 8, page_size: int = 16,
+                 chunk: int = 4, role: str = "both"):
+        self.slots = slots
+        self.pool_pages = pool_pages
+        self.pages_per_seq = pages_per_seq
+        self.page_size = page_size
+        self.chunk = chunk
+        self.role = role
+        self.free_pages = pool_pages
+        self._queue: deque = deque()
+        self._rows: list[dict] = []
+        self._installs: deque = deque()
+        self._round = 0
+        self._mig_seq = 0
+        self.finished: dict[int, list[int]] = {}
+        self.outcomes: dict[int, str] = {}
+
+    def _pages_for(self, prompt_len: int, budget: int) -> int:
+        return -(-(prompt_len + budget) // self.page_size)
+
+    def describe(self) -> dict:
+        return {"role": self.role, "slots": self.slots,
+                "pages_per_seq": self.pages_per_seq,
+                "page_size": self.page_size, "stub": True,
+                "free_pages": self.free_pages,
+                "queue_depth": len(self._queue)}
+
+    def submit(self, req: dict) -> None:
+        prompt = [int(t) for t in req["prompt"]]
+        prefix = [int(t) for t in req.get("resume_prefix") or []]
+        need = self._pages_for(len(prompt), int(req["max_new"]))
+        if need > min(self.pages_per_seq, self.pool_pages):
+            raise ValueError(
+                f"request needs {need} pages > capacity")
+        self._queue.append({
+            "rid": int(req["rid"]), "prompt": prompt,
+            "orig": prompt[:len(prompt) - len(prefix)]
+            if prefix else prompt,
+            "prefix": prefix, "out": list(prefix),
+            "budget": int(req["max_new"]), "need": need,
+            "priority": int(req.get("priority") or 0),
+        })
+
+    def queue_install(self, wire: dict, t_disp: float) -> None:
+        self._installs.append((wire, t_disp))
+
+    def _admit(self) -> None:
+        q = sorted(self._queue, key=lambda r: r["priority"])
+        for req in q:
+            if len(self._rows) >= self.slots:
+                break
+            if req["need"] > self.free_pages:
+                continue
+            self._queue.remove(req)
+            self.free_pages -= req["need"]
+            # admission emits the first token (the prefill pick);
+            # token k is indexed from the ORIGINAL prompt's end, so a
+            # resume (out pre-seeded with its prefix) continues the
+            # exact stream
+            req["out"].append(stub_token(req["orig"], len(req["out"])))
+            self._rows.append(req)
+
+    def _install_pending(self, rec) -> None:
+        while self._installs:
+            wire, t_disp = self._installs[0]
+            need = int(wire["n_pages"])
+            if len(self._rows) >= self.slots or need > self.free_pages:
+                break
+            self._installs.popleft()
+            self.free_pages -= need
+            self._rows.append({
+                "rid": int(wire["seq_id"]),
+                "prompt": [int(t) for t in wire["prompt"]],
+                "orig": [int(t) for t in wire["orig"]],
+                "prefix": list(wire["prefix"]),
+                "out": list(wire["out"]),
+                "budget": int(wire["budget"]), "need": need,
+                "priority": int(wire.get("priority") or 0),
+            })
+            if rec is not None and t_disp:
+                rec.mark_complete(
+                    "plane.kv_migration", t_disp,
+                    {"seq": int(wire["seq"])},
+                    track=migration_track(wire["seq"]))
+
+    def round(self, rec) -> dict:
+        chaoslib.maybe_inject("replica_round", self._round)
+        self._round += 1
+        self._admit()
+        self._install_pending(rec)
+        exports = []
+        if self.role == "prefill":
+            # every admitted row leaves via migration once its first
+            # token exists (it does: admission emitted it)
+            for row in list(self._rows):
+                if len(row["out"]) - len(row["prefix"]) \
+                        >= row["budget"]:
+                    continue  # finishes below instead
+                self._rows.remove(row)
+                self.free_pages += row["need"]
+                wire = {
+                    "seq_id": row["rid"], "prompt": row["prompt"],
+                    "orig": row["orig"], "prefix": row["prefix"],
+                    "out": row["out"], "budget": row["budget"],
+                    "n_pages": row["need"],
+                    "page_size": self.page_size,
+                    "payload_dtype": "uint8",
+                    "priority": row["priority"],
+                    # the DONOR assigns seq (its export counter) and
+                    # fingerprints it; the router carries it verbatim
+                    "seq": self._mig_seq,
+                }
+                self._mig_seq += 1
+                record_export(wire, rec)
+                exports.append(wire)
+        else:
+            for row in list(self._rows):
+                emitted = len(row["out"]) - len(row["prefix"])
+                take = min(self.chunk, row["budget"] - emitted)
+                base = len(row["out"])
+                row["out"].extend(
+                    stub_token(row["orig"], base + j)
+                    for j in range(take))
+        for row in list(self._rows):
+            if len(row["out"]) - len(row["prefix"]) >= row["budget"]:
+                self._rows.remove(row)
+                self.free_pages += row["need"]
+                self.finished[row["rid"]] = row["out"]
+                self.outcomes[row["rid"]] = "ok"
+        fin = {str(r): t for r, t in self.finished.items()}
+        self.finished = {}
+        reply = {
+            "ok": 1, "round": self._round, "finished": fin,
+            "outcomes": {str(r): self.outcomes.pop(r)
+                         for r in list(self.outcomes)},
+            "progress": {str(r["rid"]): r["out"] for r in self._rows},
+            "exports": exports,
+            "free_pages": self.free_pages,
+            "queue_depth": len(self._queue),
+            "active": len(self._rows),
+        }
+        return reply
+
+
+class RealAdapter(EngineAdapter):
+    """An :class:`~hpc_patterns_tpu.models.serving.EngineCore` behind
+    the replica protocol (imports jax lazily — only replicas that
+    actually serve a model pay for it). The donor export runs after a
+    prefill-only round; installs queue and run behind the next round's
+    decode chunk through ``service_round``'s ``pre_collect`` hook."""
+
+    def __init__(self, engine, *, role: str = "both"):
+        self.engine = engine
+        self.role = role
+        self._installs: deque = deque()
+        self._round = 0
+        self._mig_seq = 0
+
+    def describe(self) -> dict:
+        e = self.engine
+        return {"role": self.role, "slots": e.slots,
+                "pages_per_seq": e.pages_per_seq,
+                "page_size": e.page_size, "stub": False,
+                "free_pages": e.free_page_count,
+                "queue_depth": e.queue_depth}
+
+    def submit(self, req: dict) -> None:
+        import numpy as np
+
+        self.engine.submit(
+            np.asarray(req["prompt"], np.int32), int(req["max_new"]),
+            seq_id=int(req["rid"]),
+            priority=int(req.get("priority") or 0),
+            deadline_s=req.get("deadline_s"),
+            resume_prefix=(np.asarray(req["resume_prefix"], np.int32)
+                           if req.get("resume_prefix") else None))
+
+    def queue_install(self, wire: dict, t_disp: float) -> None:
+        self._installs.append((wire, t_disp))
+
+    def _install_pending(self, rec, overlapped: bool) -> None:
+        from hpc_patterns_tpu.serving_plane.migration import (
+            bundle_from_wire,
+        )
+
+        while self._installs:
+            wire, t_disp = self._installs[0]
+            if not self.engine.migration_admissible(
+                    int(wire["n_pages"])):
+                break
+            self._installs.popleft()
+            self.engine.install_migration(bundle_from_wire(wire))
+            if rec is not None and t_disp:
+                rec.mark_complete(
+                    "plane.kv_migration", t_disp,
+                    {"seq": int(wire["seq"]),
+                     "overlapped": overlapped},
+                    track=migration_track(wire["seq"]))
+
+    def round(self, rec) -> dict:
+        from hpc_patterns_tpu.serving_plane.migration import (
+            bundle_to_wire,
+        )
+
+        chaoslib.maybe_inject("replica_round", self._round)
+        self._round += 1
+        e = self.engine
+        if self.role == "prefill":
+            e.service_round(decode=False)
+            exports = []
+            for slot in e.exportable_slots():
+                b = e.export_migration(slot)
+                b.seq = self._mig_seq
+                self._mig_seq += 1
+                wire = bundle_to_wire(b)
+                wire["payload_dtype"] = str(
+                    b.pages_payload["k"][0].dtype)
+                record_export(wire, rec)
+                exports.append(wire)
+        else:
+            pre = None
+            if self._installs:
+                def pre(overlapped):
+                    self._install_pending(rec, overlapped)
+            e.service_round(pre_collect=pre)
+            exports = []
+        fin = {}
+        outcomes = {}
+        for sid in list(e.finished):
+            fin[str(sid)] = [int(t) for t in e.finished.pop(sid)]
+            outcomes[str(sid)] = (e.stats.get(sid, {}).get("outcome")
+                                  or "ok")
+        progress = {str(s.seq_id): [int(t) for t in s.out]
+                    for s in e._slots if s.active}
+        return {
+            "ok": 1, "round": self._round, "finished": fin,
+            "outcomes": outcomes, "progress": progress,
+            "exports": exports,
+            "free_pages": e.free_page_count,
+            "queue_depth": e.queue_depth,
+            "active": e.active_count,
+        }
+
+
+# ---------------------------------------------------------------------------
+# replica server
+# ---------------------------------------------------------------------------
+
+
+def addr_path(rdv_dir: str | Path, rank: int) -> Path:
+    return Path(rdv_dir) / f"replica{rank:05d}.addr"
+
+
+def serve_replica(adapter: EngineAdapter, *, rank: int,
+                  rdv_dir: str | Path, timeout_s: float = 120.0,
+                  rec=None) -> int:
+    """One replica process: bind an ephemeral localhost port, publish
+    it under ``rdv_dir`` (the launcher gives every child the same
+    directory — the mpirun-hostfile analog), then serve the router's
+    protocol until ``stop`` or an idle timeout (an orphaned replica
+    must not outlive a dead router; the launcher's own timeout is the
+    backstop)."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    srv.settimeout(timeout_s)
+    host, port = srv.getsockname()
+    p = addr_path(rdv_dir, rank)
+    tmp = p.with_suffix(".tmp")
+    tmp.write_text(f"{host}:{port}")
+    os.replace(tmp, p)
+    print(f"replica {rank} ({adapter.role}) listening on {host}:{port}",
+          flush=True)
+    try:
+        conn, _ = srv.accept()
+    except socket.timeout:
+        print(f"replica {rank}: no router within {timeout_s}s",
+              flush=True)
+        return 1
+    conn.settimeout(timeout_s)
+    rfile = conn.makefile("r")
+    served_rounds = 0
+    try:
+        while True:
+            msg = recv_msg(rfile)
+            if msg is None:
+                print(f"replica {rank}: router hung up", flush=True)
+                return 0
+            op = msg.get("op")
+            if op == "hello":
+                send_msg(conn, {"ok": 1, "rank": rank,
+                                **adapter.describe()})
+            elif op == "submit":
+                try:
+                    adapter.submit(msg)
+                    send_msg(conn, {"ok": 1})
+                except Exception as e:  # noqa: BLE001 — protocol reply
+                    send_msg(conn, {"ok": 0, "error": str(e)})
+            elif op == "migrate":
+                recv_migration(msg["bundle"], adapter, rec)
+                send_msg(conn, {"ok": 1})
+            elif op == "round":
+                reply = adapter.round(rec)
+                served_rounds += 1
+                send_msg(conn, reply)
+            elif op == "stop":
+                send_msg(conn, {"ok": 1, "rounds": served_rounds})
+                print(f"replica {rank}: served {served_rounds} "
+                      "round(s)", flush=True)
+                return 0
+            else:
+                send_msg(conn, {"ok": 0, "error": f"bad op {op!r}"})
+    except (ReplicaDead, socket.timeout) as e:
+        print(f"replica {rank}: connection lost ({e})", flush=True)
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# router client
+# ---------------------------------------------------------------------------
+
+
+class ReplicaHandle:
+    def __init__(self, rank: int, addr: str, *,
+                 timeout_s: float = 120.0):
+        # the recv timeout doubles as the death detector: it must
+        # track the operator's --plane-timeout, or a slow replica
+        # round (first-round jit compiles on the real-engine leg) is
+        # misread as a death and its work double-served on survivors
+        self.rank = rank
+        host, port = addr.rsplit(":", 1)
+        self.sock = socket.create_connection((host, int(port)),
+                                             timeout=timeout_s)
+        self.rfile = self.sock.makefile("r")
+        self.alive = True
+        self.info: dict = {}
+        self.load: dict = {"free_pages": 0, "queue_depth": 0,
+                           "active": 0}
+        self.assigned: set[int] = set()
+
+    def call(self, msg: dict) -> dict:
+        send_msg(self.sock, msg)
+        reply = recv_msg(self.rfile)
+        if reply is None:
+            raise ReplicaDead(f"EOF from replica {self.rank}")
+        return reply
+
+    @property
+    def role(self) -> str:
+        return self.info.get("role", "both")
+
+    @property
+    def can_prefill(self) -> bool:
+        return self.role in ("both", "prefill")
+
+    @property
+    def can_decode(self) -> bool:
+        return self.role in ("both", "decode")
+
+
+def connect_replicas(rdv_dir: str | Path, ranks, *,
+                     wait_s: float = 60.0,
+                     timeout_s: float = 120.0) -> list[ReplicaHandle]:
+    """Wait for every replica's address file, then connect and
+    handshake. Order = rank order. ``timeout_s`` becomes each
+    handle's recv timeout (the death detector)."""
+    deadline = time.monotonic() + wait_s
+    handles = []
+    for rank in ranks:
+        p = addr_path(rdv_dir, rank)
+        while not p.exists():
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"replica {rank} never published {p}")
+            time.sleep(0.02)
+        h = ReplicaHandle(rank, p.read_text().strip(),
+                          timeout_s=timeout_s)
+        h.info = h.call({"op": "hello"})
+        h.load = {k: h.info.get(k, 0)
+                  for k in ("free_pages", "queue_depth", "active")}
+        handles.append(h)
+    return handles
+
+
+class PlaneRouter:
+    """The router process of the launched plane: admits the open-loop
+    stream across the replica handles, forwards KV handoffs from
+    prefill to decode replicas, detects replica death, re-queues the
+    dead replica's in-flight requests as resumes on survivors (or
+    counts them shed), and rolls the SLO table up at the end. All
+    timing is stamped at the ROUTER (one clock): TTFT is when the
+    router first observes tokens — the latency the front end actually
+    served."""
+
+    def __init__(self, handles: list[ReplicaHandle], *,
+                 policy: str = "least_loaded", slo_targets=None,
+                 emit=None):
+        if not handles:
+            raise ValueError("no replicas")
+        self.handles = handles
+        self.policy = policy
+        self.slo_targets = slo_targets or {}
+        self._emit = emit or (lambda **kw: None)
+        self.stats: dict[int, dict] = {}
+        self.finished: dict[int, list[int]] = {}
+        self.requests: dict[int, dict] = {}
+        self.progress: dict[int, list[int]] = {}
+        self.pending_bundles: deque = deque()
+        self._next_rid = 0
+        self._rr = 0
+        self.migrations = 0
+        self.deaths: list[int] = []
+        self.resumed: list[int] = []
+        self.shed: list[int] = []
+        self.last_slo: dict | None = None
+
+    # -- placement ---------------------------------------------------------
+
+    def _alive(self, pred=None):
+        return [h for h in self.handles
+                if h.alive and (pred is None or pred(h))]
+
+    def _pick(self, cand: list[ReplicaHandle]) -> ReplicaHandle | None:
+        if not cand:
+            return None
+        if self.policy == "round_robin":
+            h = cand[self._rr % len(cand)]
+            self._rr += 1
+            return h
+        return max(cand, key=lambda h: (h.load["free_pages"],
+                                        -h.load["queue_depth"]))
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, prompt, max_new: int, *, priority: int = 0,
+               deadline_s=None, t_submit: float | None = None) -> int:
+        rid = self._next_rid
+        self._next_rid += 1
+        now = time.perf_counter()
+        self.requests[rid] = {
+            "prompt": [int(t) for t in prompt],
+            "max_new": int(max_new), "priority": int(priority),
+            "deadline_s": deadline_s,
+        }
+        self.stats[rid] = {
+            "priority": int(priority),
+            "t_submit": t_submit if t_submit is not None else now,
+            "t_first": None, "t_finish": None, "tokens": 0,
+            "outcome": None, "preemptions": 0,
+        }
+        if not self._assign(rid, resume_prefix=None):
+            self._shed(rid)
+        return rid
+
+    def _assign(self, rid: int, *, resume_prefix) -> bool:
+        """Place one (possibly resumed) request: try candidates in
+        policy-preference order until one accepts. Fresh work goes to
+        prefill-capable replicas; a RESUME re-enters through any
+        survivor's ordinary admission path (a decode-role engine still
+        admits — its role only means it never receives fresh routing)."""
+        req = self.requests[rid]
+        prompt = list(req["prompt"])
+        if resume_prefix:
+            prompt = prompt + list(resume_prefix)
+        tried: set[int] = set()
+        while True:
+            cand = self._alive(
+                lambda h: h.rank not in tried
+                and (h.can_prefill or resume_prefix is not None))
+            h = self._pick(cand)
+            if h is None:
+                return False
+            tried.add(h.rank)
+            try:
+                reply = h.call({
+                    "op": "submit", "rid": rid, "prompt": prompt,
+                    "max_new": req["max_new"] - len(resume_prefix or []),
+                    "priority": req["priority"],
+                    "deadline_s": req["deadline_s"],
+                    "resume_prefix": list(resume_prefix or []) or None,
+                })
+            except ReplicaDead:
+                self._on_death(h)
+                continue
+            if not reply.get("ok"):
+                continue  # this replica cannot fit it; try the next
+            h.assigned.add(rid)
+            # bump the local load estimate NOW: a burst of submits
+            # between rounds must spread instead of piling onto the
+            # replica whose snapshot happened to look emptiest
+            h.load["queue_depth"] += 1
+            self._emit(kind="plane_route", seq_id=rid,
+                       replica=h.rank, resumed=bool(resume_prefix))
+            return True
+
+    def _shed(self, rid: int) -> None:
+        rec = self.stats[rid]
+        rec["outcome"] = "shed"
+        rec["t_finish"] = time.perf_counter()
+        self.finished[rid] = []
+        self.shed.append(rid)
+        self._emit(kind="plane_shed", seq_id=rid)
+
+    # -- failure handling --------------------------------------------------
+
+    def _on_death(self, h: ReplicaHandle) -> None:
+        """A replica died mid-protocol: every in-flight request it
+        held is re-queued as a RESUME on a survivor — prompt =
+        original + the tokens the router already observed (its last
+        ``progress`` report) — or counted shed. Bundles queued toward
+        it are re-routed the same way."""
+        if not h.alive:
+            return
+        h.alive = False
+        self.deaths.append(h.rank)
+        print(f"router: replica {h.rank} died; re-queueing "
+              f"{len(h.assigned)} in-flight request(s)", flush=True)
+        orphans = sorted(h.assigned)
+        h.assigned.clear()
+        for rid in orphans:
+            if self.stats[rid].get("outcome") is not None:
+                continue
+            emitted = list(self.progress.get(rid, []))
+            if len(emitted) >= self.requests[rid]["max_new"]:
+                # everything was emitted; the finish report died with
+                # the replica — the observed tokens ARE the output
+                self._finish(rid, emitted, "ok")
+                continue
+            if self._assign(rid, resume_prefix=emitted):
+                self.stats[rid]["preemptions"] += 1
+                self.resumed.append(rid)
+                self._emit(kind="plane_resume", seq_id=rid,
+                           from_rank=h.rank, tokens=len(emitted))
+            else:
+                self._shed(rid)
+
+    # -- result plumbing ---------------------------------------------------
+
+    def _finish(self, rid: int, tokens: list[int],
+                outcome: str) -> None:
+        rec = self.stats[rid]
+        if rec.get("outcome") is not None:
+            return
+        rec["outcome"] = outcome
+        rec["t_finish"] = time.perf_counter()
+        rec["tokens"] = len(tokens)
+        if rec["t_first"] is None and tokens:
+            rec["t_first"] = rec["t_finish"]
+        self.finished[rid] = tokens
+        self.progress.pop(rid, None)
+
+    def _merge_round(self, h: ReplicaHandle, reply: dict) -> None:
+        now = time.perf_counter()
+        h.load = {k: reply.get(k, 0)
+                  for k in ("free_pages", "queue_depth", "active")}
+        for rid_s, toks in reply.get("progress", {}).items():
+            rid = int(rid_s)
+            self.progress[rid] = list(toks)
+            rec = self.stats.get(rid)
+            if rec is not None and rec["t_first"] is None and toks:
+                rec["t_first"] = now
+        outcomes = reply.get("outcomes", {})
+        for rid_s, toks in reply.get("finished", {}).items():
+            rid = int(rid_s)
+            h.assigned.discard(rid)
+            self._finish(rid, list(toks),
+                         outcomes.get(rid_s, "ok"))
+            if outcomes.get(rid_s) == "shed":
+                self.shed.append(rid)
+        for wire in reply.get("exports", []):
+            # seq was assigned (and fingerprinted) by the donor; the
+            # router carries it verbatim so the receiver's fingerprint
+            # matches — renumbering here would fake a desync
+            h.assigned.discard(int(wire["seq_id"]))
+            # the wire carries the prefill-side tokens: seed the
+            # resume checkpoint NOW, so a receiver that dies between
+            # delivery and its next round reply does not cost the
+            # router the tokens it was already holding
+            rid = int(wire["seq_id"])
+            if len(wire.get("out", [])) > len(self.progress.get(rid,
+                                                                ())):
+                self.progress[rid] = list(wire["out"])
+                rec = self.stats.get(rid)
+                if rec is not None and rec["t_first"] is None:
+                    rec["t_first"] = now
+            self.pending_bundles.append(wire)
+
+    def _forward_bundles(self) -> None:
+        still: deque = deque()
+        while self.pending_bundles:
+            wire = self.pending_bundles.popleft()
+            need = int(wire["n_pages"])
+            cand = self._alive(
+                lambda h: h.can_decode
+                and h.load["free_pages"] >= need
+                # table width too (hello carries it): an oversized
+                # bundle delivered to a replica that can NEVER install
+                # it would wedge that replica's whole install queue
+                # behind the head-of-line break
+                and need <= int(h.info.get("pages_per_seq", need)))
+            h = self._pick(cand)
+            if h is None:
+                still.append(wire)
+                continue
+            try:
+                h.call({"op": "migrate", "bundle": wire})
+            except ReplicaDead:
+                self._on_death(h)
+                still.append(wire)
+                continue
+            h.assigned.add(int(wire["seq_id"]))
+            h.load["free_pages"] -= int(wire["n_pages"])
+            self.migrations += 1
+        self.pending_bundles = still
+
+    # -- the loop ----------------------------------------------------------
+
+    def _unresolved(self) -> list[int]:
+        return [rid for rid, rec in self.stats.items()
+                if rec.get("outcome") is None]
+
+    def run(self, arrivals, *, timeout_s: float = 300.0) -> dict:
+        """Admit the open-loop schedule, drive replica rounds until
+        every request resolves (finished, resumed-and-finished, or
+        shed), and return the report."""
+        t0 = time.perf_counter()
+        pending = deque(sorted(arrivals, key=lambda a: a[0]))
+        deadline = t0 + timeout_s
+        while True:
+            now_rel = time.perf_counter() - t0
+            while pending and pending[0][0] <= now_rel:
+                t_arr, kw = pending.popleft()
+                self.submit(t_submit=t0 + t_arr, **kw)
+            if not pending and not self._unresolved():
+                break
+            if time.perf_counter() > deadline:
+                for rid in self._unresolved():
+                    self._shed(rid)
+                print("router: timeout — remaining in-flight "
+                      "requests counted shed", flush=True)
+                break
+            if not self._alive():
+                for rid in self._unresolved():
+                    self._shed(rid)
+                print("router: no replicas left alive", flush=True)
+                break
+            if pending and not self._unresolved():
+                # nothing in flight, next arrival in the future: wait
+                # on the schedule's clock, boundedly
+                wait = pending[0][0] - (time.perf_counter() - t0)
+                time.sleep(min(max(wait, 0.0), 0.005))
+                continue
+            for h in list(self._alive()):
+                try:
+                    reply = h.call({"op": "round"})
+                except ReplicaDead:
+                    self._on_death(h)
+                    continue
+                self._merge_round(h, reply)
+            self._forward_bundles()
+        for h in self._alive():
+            try:
+                h.call({"op": "stop"})
+            except ReplicaDead:
+                h.alive = False
+        wall = time.perf_counter() - t0
+        self.last_slo = slolib.attainment(
+            self.stats, self.slo_targets, wall)
+        return {
+            "wall_s": wall,
+            "n": len(self.stats),
+            "served": sum(1 for r in self.stats.values()
+                          if r.get("outcome") == "ok"),
+            "shed": sorted(set(self.shed)),
+            "deaths": list(self.deaths),
+            "resumed": sorted(set(self.resumed)),
+            "migrations": self.migrations,
+            "slo": self.last_slo,
+        }

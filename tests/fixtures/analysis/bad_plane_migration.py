@@ -1,0 +1,35 @@
+"""Known-bad: serving-plane KV-handoff hazards, minimized.
+
+Two shapes the round-10 plane made possible: (1) a handoff issued
+under rank-dependent control flow — the donor migrates while the
+other rank does not, so the two sides' ``(kv_migration, seq)`` chains
+diverge and the receiver waits on a bundle that never comes (the
+merge-time verifier names it; shardlint catches it before the run);
+(2) a host readback inside a migration dispatch function — the
+transfer exists to hide behind the in-flight decode chunk, and a
+sync there exposes exactly the latency it should be hiding.
+
+Lines carrying ``EXPECT: <rule>`` markers are the golden findings
+tests/test_analysis.py asserts, line-exact.
+"""
+
+import os
+
+import numpy as np
+
+from hpc_patterns_tpu.serving_plane.migration import migrate_pages
+
+
+def rank_branched_handoff(bundle, x, device):
+    if int(os.environ.get("HPCPAT_PROCESS_ID") or 0) == 0:  # EXPECT: collective-divergence
+        out = migrate_pages(bundle, device)
+    else:
+        out = x
+    return out
+
+
+def _dispatch_migration(engine, slot, device):
+    pos_now = np.asarray(engine.pos)  # EXPECT: host-sync-in-dispatch
+    bundle = engine.export_migration(slot)
+    bundle.pos = int(pos_now[slot])
+    return migrate_pages(bundle, device)

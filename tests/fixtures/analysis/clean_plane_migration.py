@@ -1,0 +1,23 @@
+"""Known-clean: the serving-plane handoff discipline.
+
+Every rank (router and replicas alike) issues the same migration
+sequence — placement is DATA the router computes, never a branch on
+the executing rank — and the migration dispatch path stays
+dispatch-only: the gather, the cross-device copy, and the install all
+enqueue behind the in-flight decode chunk; the one deliberate
+readback (the donor's cursor snapshot) lives inside
+``export_migration`` with its justified suppression, not here.
+"""
+
+from hpc_patterns_tpu.serving_plane.migration import migrate_pages
+
+
+def uniform_handoff(bundle, device):
+    # every rank migrates; the destination is data, not rank identity
+    return migrate_pages(bundle, device)
+
+
+def _dispatch_migration(engine, slot, device):
+    # dispatch-only: export gathers on device, the copy enqueues async
+    bundle = engine.export_migration(slot)
+    return migrate_pages(bundle, device)

@@ -214,3 +214,42 @@ class TestInjection:
         assert [e["index"] for e in hits] == [1]
         np.testing.assert_allclose(
             np.asarray(out)[0], comm.expected_allreduce_value())
+
+
+class TestReplicaSite:
+    """Round 10: replica-level chaos — ``replica_round`` is the
+    serving plane's per-replica scheduler-round site and ``replica=``
+    aliases ``rank=`` (one launched replica IS one launcher process).
+    The end-to-end drill (die kills one replica of three, the router
+    resumes its work on survivors) lives in
+    tests/test_launch.py::TestServingPlaneLaunch."""
+
+    def test_replica_key_aliases_rank(self):
+        (f,) = chaos.parse("die:replica=2,at=5,site=replica_round")
+        assert f.kind == "die" and f.site == "replica_round"
+        assert f.rank == 2 and f.at == 5
+        assert f.every == 0  # death still fires once definitionally
+
+    def test_replica_round_site_matches_only_itself(self):
+        (f,) = chaos.parse(
+            "stall:replica=1,at=2,site=replica_round,delay_ms=5")
+        assert f.matches("replica_round", 2, 1)
+        assert not f.matches("engine_round", 2, 1)
+        assert not f.matches("replica_round", 2, 0)
+
+    def test_stub_replica_round_probe_fires(self, monkeypatch):
+        # the plane's stub replica probes the site once per protocol
+        # round — the same probe the real adapter makes
+        from hpc_patterns_tpu.serving_plane.service import StubAdapter
+
+        chaos.configure("stall:at=1,delay_ms=30,site=replica_round")
+        adapter = StubAdapter(slots=1, pool_pages=4, pages_per_seq=4,
+                              page_size=8, chunk=2)
+        t0 = time.perf_counter()
+        adapter.round(None)
+        adapter.round(None)  # index 1: the stall fires here
+        dt = time.perf_counter() - t0
+        fired = [e for e in chaos.injections()
+                 if e["site"] == "replica_round"]
+        assert len(fired) == 1 and fired[0]["index"] == 1
+        assert dt >= 0.03
