@@ -3,6 +3,18 @@
 Usage: python benchmarks/bench_serving.py [--n=N] [--slots=S] [--chunk=K]
          [--mix=0|1] [--buckets=auto|none|16,32,...] [--overlap=0|1]
          [--temp=T] [--topk=K] [--smoke] [--scenario] [--plane]
+         [--offload]
+
+``--offload``: the TIERED-MEMORY row (round 11) — the same stream
+through an all-HBM engine and an engine whose HBM pool is capped well
+below the working set, fronting a host-resident pool via the
+residency manager (``hpc_patterns_tpu/memory/``): cold rows page out
+at chunk boundaries, swapped rows prefetch back with the pull
+dispatched before the decode chunk. Token-identical to the all-HBM
+engine (oracle before any number), the cap must force REAL eviction,
+and the headline keys ``offload_goodput_tok_s`` /
+``prefetch_overlap_frac`` are captured into ``bench.py``'s detail and
+gated by ``harness/regress.py`` (docs/memory.md).
 
 ``--plane``: the SERVING-PLANE row (round 10) — one open-loop stream
 through a single engine, a homogeneous 2-replica router plane, and
@@ -515,6 +527,156 @@ def run_scenario(*, cfg, params, schedule, classes, page_size, slots,
     return result
 
 
+def offload_smoke_config():
+    """The CI tiered-memory shape (tier-1 via
+    tests/test_bench_serving.py): the smoke model, an HBM pool capped
+    well below the stream's working set (REAL eviction by
+    construction, asserted), a deterministic cold-after-N rotation
+    policy, and a host pool big enough for everything paged out."""
+    base = smoke_config()
+    return dict(cfg=base["cfg"], params=base["params"], n=8, slots=4,
+                chunk=16, page_size=16, prompt_len=32, max_budget=96,
+                hbm_frac=0.5, cold_n=2)
+
+
+def offload_full_config(on_tpu: bool):
+    """The re-grounding shape: the scenario model with a long-context
+    stream whose KV exceeds the HBM cap ~2.5x — the 131k-offload-row
+    regime generalized from a one-shot training trick to a serving
+    policy knob."""
+    base = scenario_full_config(on_tpu)
+    prompt_top = 256 if on_tpu else 32
+    budget_top = 512 if on_tpu else 128
+    return dict(cfg=base["cfg"], params=base["params"],
+                n=24 if on_tpu else 8, slots=8 if on_tpu else 4,
+                chunk=16, page_size=256 if on_tpu else 16,
+                prompt_len=prompt_top, max_budget=budget_top,
+                hbm_frac=0.4, cold_n=3)
+
+
+def run_offload(*, cfg, params, n, slots, chunk, page_size, prompt_len,
+                max_budget, hbm_frac, cold_n, quiet=False):
+    """The tiered-memory row: the same stream through (a) an all-HBM
+    engine (pool sized to the whole working set — the baseline and
+    the ORACLE) and (b) a constrained engine whose HBM pool is capped
+    at ``hbm_frac`` of that, fronting a host-resident pool through
+    the residency manager (``hpc_patterns_tpu/memory/``) — cold rows
+    page out at chunk boundaries, swapped rows prefetch back with the
+    pull dispatched before the decode chunk. The constrained engine's
+    outputs must be TOKEN-IDENTICAL to the all-HBM engine's (and to
+    standalone ``paged_generate``) before any number is believed, and
+    the cap must have forced real evictions. Reports
+    ``offload_goodput_tok_s`` (SLO-attained tok/s of the constrained
+    engine) and ``prefetch_overlap_frac`` (measured fraction of
+    prefetch-window time hidden under the decode chunk), the two keys
+    ``bench.py`` captures and ``harness/regress.py`` gates."""
+    from hpc_patterns_tpu.memory import ColdAfterNPolicy, ResidencyManager
+
+    out = print if not quiet else (lambda *a, **k: None)
+    rng = np.random.RandomState(7)
+    lengths = [prompt_len // 2, (3 * prompt_len) // 4, prompt_len]
+    reqs = []
+    for _ in range(n):
+        t = int(rng.choice(lengths))
+        prompt = rng.randint(0, cfg.vocab, size=t).astype(np.int32)
+        budget = int(rng.choice(
+            [max(1, max_budget // 2), max_budget], p=[0.4, 0.6]))
+        reqs.append((prompt, budget))
+    total_tokens = sum(b for _, b in reqs)
+    buckets = bucket_ladder(prompt_len)
+    targets = slo.targets_from_classes(SCENARIO_CLASSES)
+
+    pages_per_seq = max(
+        ContinuousBatcher.pages_needed(len(p), b, page_size,
+                                       padded_len=pad_to_bucket(
+                                           buckets, len(p)))
+        for p, b in reqs)
+    full_pool = slots * pages_per_seq
+    hbm_pool = max(pages_per_seq, int(full_pool * hbm_frac))
+    assert hbm_pool < full_pool, (
+        f"hbm_frac {hbm_frac} does not constrain the pool "
+        f"({hbm_pool} vs {full_pool}) — nothing would evict")
+
+    def run_full():
+        eng = ContinuousBatcher(
+            params, cfg, slots=slots, pool_pages=full_pool,
+            pages_per_seq=pages_per_seq, page_size=page_size,
+            chunk=chunk, prompt_buckets=buckets, slo=targets)
+        ids = [eng.submit(p, b) for p, b in reqs]
+        got = eng.run()
+        return {i: got[s] for i, s in enumerate(ids)}, eng
+
+    def run_tiered():
+        mgr = ResidencyManager(host_blocks=2 * full_pool,
+                               policy=ColdAfterNPolicy(cold_n))
+        eng = ContinuousBatcher(
+            params, cfg, slots=slots, pool_pages=hbm_pool,
+            pages_per_seq=pages_per_seq, page_size=page_size,
+            chunk=chunk, prompt_buckets=buckets, slo=targets,
+            residency=mgr)
+        ids = [eng.submit(p, b) for p, b in reqs]
+        got = eng.run()
+        return {i: got[s] for i, s in enumerate(ids)}, eng, mgr
+
+    # warmup (compiles), then the timed legs
+    run_full()
+    run_tiered()
+    t0 = time.perf_counter()
+    full_out, full_eng = run_full()
+    t_full = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    tier_out, tier_eng, mgr = run_tiered()
+    t_tier = time.perf_counter() - t0
+
+    # oracle before any number is believed: the constrained-HBM engine
+    # is token-identical to the all-HBM one AND to standalone decode,
+    # and the cap really forced the tier to do work
+    for i, (prompt, b) in enumerate(reqs):
+        want = np.asarray(paged_generate(
+            params, jnp.asarray(prompt)[None], cfg, b,
+            page_size=page_size))[0]
+        np.testing.assert_array_equal(full_out[i], want,
+                                      err_msg=f"all-HBM seq {i}")
+        np.testing.assert_array_equal(tier_out[i], want,
+                                      err_msg=f"tiered seq {i}")
+    assert mgr.swap_outs > 0 and mgr.swap_ins > 0, (
+        f"HBM cap {hbm_pool}/{full_pool} pages forced no paging — "
+        "the row measured nothing")
+
+    tot_full = full_eng.last_slo["total"]
+    tot_tier = tier_eng.last_slo["total"]
+    overlap = mgr.prefetch_overlap_frac or 0.0
+    result = {
+        "t_full": t_full, "t_tiered": t_tier, "tokens": total_tokens,
+        "tokens_per_s_full": total_tokens / t_full,
+        "tokens_per_s_tiered": total_tokens / t_tier,
+        "full_goodput_tok_s": tot_full["goodput_tok_s"]
+        * full_eng._serve_s / t_full if t_full > 0 else 0.0,
+        "offload_goodput_tok_s": tot_tier["goodput_tok_s"]
+        * tier_eng._serve_s / t_tier if t_tier > 0 else 0.0,
+        "prefetch_overlap_frac": overlap,
+        "swap_outs": mgr.swap_outs, "swap_ins": mgr.swap_ins,
+        "prefetch_bytes": mgr.prefetch_bytes,
+        "hbm_pool": hbm_pool, "full_pool": full_pool,
+        "bubble_frac": tier_eng.last_bubble_frac,
+    }
+    out(f"offload: n={n} slots={slots} chunk={chunk} "
+        f"hbm={hbm_pool}p of {full_pool}p working set "
+        f"(host pool {2 * full_pool}p) tokens={total_tokens}")
+    out(f"  all-HBM : {t_full:.3f}s  "
+        f"{result['tokens_per_s_full']:,.1f} tok/s  "
+        f"goodput {result['full_goodput_tok_s']:,.1f}")
+    out(f"  tiered  : {t_tier:.3f}s  "
+        f"{result['tokens_per_s_tiered']:,.1f} tok/s  "
+        f"goodput {result['offload_goodput_tok_s']:,.1f}  "
+        f"swaps {mgr.swap_outs}/{mgr.swap_ins}  "
+        f"prefetch overlap {overlap:.1%}")
+    out(f"  capacity {t_full / t_tier:.3f}x wall cost for "
+        f"{full_pool / hbm_pool:.1f}x pool oversubscription "
+        "(token-identical, oracle-exact)")
+    return result
+
+
 def plane_smoke_config():
     """The CI plane shape (tier-1 via tests/test_bench_serving.py): a
     seeded open-loop two-class stream through (a) one engine, (b) a
@@ -712,6 +874,13 @@ def run_plane(*, cfg, params, n, slots, chunk, page_size, prompt_len,
 
 
 def main():
+    if arg("offload", False, bool):
+        if arg("smoke", False, bool):
+            run_offload(**offload_smoke_config())
+        else:
+            run_offload(**offload_full_config(
+                jax.default_backend() == "tpu"))
+        return
     if arg("plane", False, bool):
         if arg("smoke", False, bool):
             run_plane(**plane_smoke_config())

@@ -65,7 +65,15 @@ def main():
     if offload:
         from hpc_patterns_tpu.models.train import offload_opt_state
 
-        opt_state = offload_opt_state(opt_state)
+        hosted = offload_opt_state(opt_state)
+        if hosted is opt_state:
+            # the probe-gated identity fallback fired: measuring this
+            # as the offload row would silently report a no-op tier
+            print("note: pinned_host unusable on this backend; "
+                  "running baseline instead of a no-op offload row")
+            offload = False
+        else:
+            opt_state = hosted
     tokens = make_batch(jax.random.PRNGKey(1), cfg, batch, seq)
 
     if offload:

@@ -87,6 +87,19 @@ run "serving chaos/SLO scenario" python benchmarks/bench_serving.py --scenario
 #     oracle-exact before a number prints.
 run "serving plane 2-replica + 1p/1d" python benchmarks/bench_serving.py --plane
 
+# 4d. TIERED-MEMORY row (round 11): the same stream through an
+#     all-HBM engine and a constrained engine whose HBM pool is capped
+#     at ~40% of the working set, fronting a host-resident pool via
+#     the residency manager (hpc_patterns_tpu/memory/) — cold rows
+#     page to pinned_host at chunk boundaries, swapped rows prefetch
+#     back with the pull dispatched before the decode chunk. The
+#     oracle (token-identical to all-HBM, real eviction forced) runs
+#     before any number prints; headline keys offload_goodput_tok_s /
+#     prefetch_overlap_frac are captured by bench.py and gated by
+#     harness/regress.py. On chip this is the first REAL-DMA-rate
+#     measurement of the tier (the CPU smoke's host tier is a copy).
+run "serving tiered HBM/host offload" python benchmarks/bench_serving.py --offload
+
 # 5. aligned speculative pair + gamma sweep + batched impls (item 4, 7)
 run "make draft pair" python benchmarks/make_draft_pair.py --out=benchmarks/pair_r5
 run "speculative aligned sweep" python benchmarks/bench_speculative.py --pair=benchmarks/pair_r5 --batched=8

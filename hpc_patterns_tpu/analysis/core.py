@@ -82,6 +82,29 @@ DEFAULT_DISPATCH_CRITICAL = frozenset({
     "_dispatch_migration",
     "_install_pending",
     "_complete_migrations",
+    # the round-11 tiered-memory paths: the residency manager's
+    # prefetch/evict transfer pipeline and the serving engine's swap
+    # machinery all run with (or ahead of) an in-flight decode chunk —
+    # a stray host sync there serializes exactly the host<->HBM
+    # latency the tier exists to hide. The DELIBERATE syncs (the
+    # numpy-fallback host tier, the round-boundary window completions,
+    # the swap-out cursor snapshot inside _detach_row) carry justified
+    # suppressions in memory/residency.py and models/serving.py.
+    "_dispatch_prefetch",
+    "_install_prefetched",
+    "_complete_prefetches",
+    "_residency_balance",
+    "_swap_out",
+    "pull_payload",
+    "push_payload",
+    "_close_ripe_evicts",
+    # the shared detach/attach primitives under export_migration /
+    # install_migration / swap (round 11 refactor): the deliberate
+    # chunk-boundary snapshot inside _detach_row carries the same
+    # justified suppressions export_migration's body did before it
+    # was hoisted
+    "_detach_row",
+    "_attach_row",
 })
 
 # rule names are kebab-case identifiers; anything after the last name

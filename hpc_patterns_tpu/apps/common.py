@@ -176,9 +176,8 @@ def all_processes_agree(ok: bool) -> bool:
 
 def supports_memory_kind(kind: str) -> bool:
     """Whether the backend exposes the given JAX memory kind (TPU has
-    pinned_host + device; CPU meshes typically only the default)."""
-    try:
-        memories = jax.devices()[0].addressable_memories()
-    except Exception:
-        return False
-    return any(m.kind == kind for m in memories)
+    pinned_host + device; CPU meshes typically only the default).
+    Delegates to the single probe home (memory/kinds.py)."""
+    from hpc_patterns_tpu.memory import kinds as kindslib
+
+    return kindslib.supports_memory_kind(kind)

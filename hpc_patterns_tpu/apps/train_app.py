@@ -428,9 +428,16 @@ def _run_pp(args, log, cfg) -> int:
         else:
             from hpc_patterns_tpu.models.train import offload_opt_state
 
-            opt_state = offload_opt_state(opt_state)
-            offload_example = opt_state
-            log.print("optimizer state offloaded to pinned_host")
+            hosted = offload_opt_state(opt_state)
+            if hosted is opt_state:
+                # probe-gated identity fallback: say so instead of
+                # logging an offload that did not happen
+                log.print("note: pinned_host unusable on this "
+                          "backend; optimizer state left in place")
+            else:
+                opt_state = hosted
+                offload_example = opt_state
+                log.print("optimizer state offloaded to pinned_host")
     step_fn = pplib.make_pp_train_step(
         cfg, mesh, microbatches=args.microbatches,
         axis_dp="dp" if dp > 1 else None, axis_fsdp=axis_fsdp,
@@ -574,9 +581,16 @@ def run(args) -> int:
         else:
             from hpc_patterns_tpu.models.train import offload_opt_state
 
-            opt_state = offload_opt_state(opt_state)
-            offload_example = opt_state
-            log.print("optimizer state offloaded to pinned_host")
+            hosted = offload_opt_state(opt_state)
+            if hosted is opt_state:
+                # probe-gated identity fallback: say so instead of
+                # logging an offload that did not happen
+                log.print("note: pinned_host unusable on this "
+                          "backend; optimizer state left in place")
+            else:
+                opt_state = hosted
+                offload_example = opt_state
+                log.print("optimizer state offloaded to pinned_host")
     step_fn = make_train_step(cfg, mesh, optimizer=optimizer,
                               accum_steps=args.accum,
                               offload_opt_example=offload_example)

@@ -33,55 +33,17 @@ import jax.numpy as jnp
 from hpc_patterns_tpu.analysis import dispatch_critical
 from hpc_patterns_tpu.concurrency import kernels
 
-
-def _kind_sharding(device, kind: str):
-    return jax.sharding.SingleDeviceSharding(device, memory_kind=kind)
-
+# the probe/sharding/transfer helpers live in memory/kinds.py since
+# round 11 (the residency manager needs the same answers); the old
+# private names stay as delegating aliases so every command keeps its
+# call sites and the memoized probe is shared process-wide
+from hpc_patterns_tpu.memory.kinds import (
+    kind_sharding as _kind_sharding,
+    memory_kind_transfers_work as _memory_kind_transfers_work,
+    move_to_kind as _move_to_kind,
+)
 
 _fresh_copy = jax.jit(lambda x: x + 0)  # shared across D2M instances
-
-_MOVE_CACHE: dict[tuple, object] = {}
-
-
-def _move_to_kind(device, kind: str):
-    """Cached jitted transfer program targeting ``kind`` on ``device`` —
-    every copy command of the same direction shares one compile (the
-    autotuner alone builds several probe commands per run)."""
-    key = (device, kind)
-    if key not in _MOVE_CACHE:
-        _MOVE_CACHE[key] = jax.jit(
-            lambda x: x, out_shardings=_kind_sharding(device, kind)
-        )
-    return _MOVE_CACHE[key]
-
-
-_MEMORY_KIND_PROBE: dict[str, bool] = {}
-
-
-def _memory_kind_transfers_work(device) -> bool:
-    """Whether host↔device memory-kind transfers actually *execute* on
-    this backend. Backends can advertise ``pinned_host`` in
-    ``addressable_memories`` yet reject placement at runtime (CPU does),
-    so probe by running one tiny round-trip, memoized per platform."""
-    key = device.platform
-    if key not in _MEMORY_KIND_PROBE:
-        try:
-            kinds = {m.kind for m in device.addressable_memories()}
-            if "pinned_host" not in kinds:
-                raise ValueError("no pinned_host memory")
-            tiny = jax.device_put(
-                jnp.zeros((8,), jnp.float32), _kind_sharding(device, "pinned_host")
-            )
-            # the probe executes the SAME cached transfer program real
-            # copy commands use (a fresh jax.jit here would re-trace on
-            # every probe — jaxlint: recompile-hazard — and prove a
-            # different executable than the one commands dispatch)
-            moved = _move_to_kind(device, "device")(tiny)
-            jax.block_until_ready(moved)
-            _MEMORY_KIND_PROBE[key] = True
-        except Exception:
-            _MEMORY_KIND_PROBE[key] = False
-    return _MEMORY_KIND_PROBE[key]
 
 
 class Command:

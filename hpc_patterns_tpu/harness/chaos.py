@@ -29,6 +29,11 @@ same spec + the same workload reproduces the same perturbation):
   reaches an exit handler. The launcher's rank report records the
   fault kind and still merges the surviving ranks' trace files
   (``apps/launch.py``).
+- ``slow_host_transfer``: injected delay at the ``host_transfer`` site
+  — the tiered-memory residency manager (``memory/residency.py``)
+  probes it at every host->HBM prefetch dispatch, INSIDE the
+  ``mem.prefetch`` trace window, so degraded host bandwidth shows up
+  as exactly the widened window the overlap claim is gated on.
 
 Spec grammar (the ``HPCPAT_CHAOS`` env value, or
 ``apps/launch.py --chaos``; ``;``-separated faults)::
@@ -41,6 +46,9 @@ Spec grammar (the ``HPCPAT_CHAOS`` env value, or
     die:rank=1,at=5,code=7                  # os._exit(7) instead
     die:replica=2,at=5,site=replica_round   # kill ONE serving-plane
                                             # replica at its 5th round
+    slow_host_transfer:delay_ms=40          # every tiered-memory
+                                            # prefetch pays 40ms extra
+    slow_host_transfer:at=2,delay_ms=40,every=0   # only the 3rd pull
 
 ``rank`` matches the launcher's ``HPCPAT_PROCESS_ID`` (absent = rank 0;
 ``rank`` omitted = every rank). Delays may carry deterministic jitter
@@ -69,18 +77,26 @@ ENV_CHAOS = "HPCPAT_CHAOS"
 #: by tests/test_chaos.py)
 ENV_PROCESS_ID = "HPCPAT_PROCESS_ID"
 
-KINDS = ("straggler", "stall", "die")
+KINDS = ("straggler", "stall", "die", "slow_host_transfer")
 #: ``replica_round`` (round 10): the serving plane's per-replica
 #: scheduler round (serving_plane/service.py probes it once per
 #: ``round`` message) — ``die:replica=2,at=5,site=replica_round``
 #: kills one REPLICA of many mid-stream, where the original ``die``
 #: killed one rank of one SPMD program. ``replica=`` is an alias for
 #: ``rank=``: in a launched plane each replica IS one launcher process.
-SITES = ("collective", "engine_round", "replica_round")
+#: ``host_transfer`` (round 11): the tiered-memory prefetch dispatch
+#: site (memory/residency.py probes it per host->HBM pull, between the
+#: ``mem.prefetch`` window open and the transfer dispatch) —
+#: ``slow_host_transfer:delay_ms=40`` models degraded host<->device
+#: bandwidth: the injected delay WIDENS exactly the window it claims
+#: to, so a degraded-bandwidth run is replayable and trace-provable.
+SITES = ("collective", "engine_round", "replica_round",
+         "host_transfer")
 
 #: default injection site per kind (overridable via ``site=``)
 _DEFAULT_SITE = {"straggler": "collective", "stall": "engine_round",
-                 "die": "collective"}
+                 "die": "collective",
+                 "slow_host_transfer": "host_transfer"}
 
 
 @dataclass(frozen=True)

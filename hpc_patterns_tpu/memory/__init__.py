@@ -1,0 +1,58 @@
+"""Tiered-memory residency: policy-driven HBM <-> host paging.
+
+The L2 allocator axis (SURVEY.md §2's ``-H/-D/-S`` memory kinds) grown
+into a subsystem: one place that knows WHICH memory kinds a backend
+really supports (``kinds.py`` — the probe/sharding helpers every other
+module used to re-derive), and one manager that owns WHERE each block
+of serving KV / training optimizer state lives right now
+(``residency.py`` — per-block tier, pin state, last-touch round,
+pluggable eviction policies, and the overlapped prefetch/evict
+transfer pipeline measured through the flight recorder).
+
+Consumers:
+
+- ``models/serving.py``: ``EngineCore(residency=...)`` treats the HBM
+  page arena as a CACHE over a larger host-resident pool — admission
+  consults the manager instead of failing at ``free_pages == 0``, cold
+  rows page out to the host tier at chunk boundaries, and swapped rows
+  prefetch back in with the pull dispatched BEFORE the decode chunk so
+  the transfer hides under it (docs/memory.md);
+- ``models/train.py``: ``make_train_step(..., residency=...)`` streams
+  a host-resident optimizer state through the manager — the pull
+  dispatches before the gradient-accumulation phase and hides under
+  it, replacing the all-or-nothing in-jit move;
+- ``concurrency/commands.py`` / ``apps/common.py``: delegate their
+  memory-kind probes here (one probe, one answer per process).
+"""
+
+from hpc_patterns_tpu.memory.kinds import (
+    kind_sharding,
+    memory_kind_placement_works,
+    memory_kind_shardings,
+    memory_kind_transfers_work,
+    move_to_kind,
+    supports_memory_kind,
+)
+from hpc_patterns_tpu.memory.residency import (
+    BlockState,
+    ColdAfterNPolicy,
+    EvictionPolicy,
+    LRUPolicy,
+    PriorityAwarePolicy,
+    ResidencyManager,
+)
+
+__all__ = [
+    "BlockState",
+    "ColdAfterNPolicy",
+    "EvictionPolicy",
+    "LRUPolicy",
+    "PriorityAwarePolicy",
+    "ResidencyManager",
+    "kind_sharding",
+    "memory_kind_placement_works",
+    "memory_kind_shardings",
+    "memory_kind_transfers_work",
+    "move_to_kind",
+    "supports_memory_kind",
+]

@@ -619,9 +619,14 @@ def make_pp_train_step(cfg: TransformerConfig, mesh, *, microbatches: int,
     composes unchanged)."""
     optimizer = optimizer or make_optimizer()
     if offload_opt_example is not None:
-        from hpc_patterns_tpu.models.train import offload_shardings
+        # tolerant of offload_opt_state's probe-gated identity
+        # fallback (no usable pinned_host -> the example was left in
+        # place and the tiers collapse), same as make_train_step
+        from hpc_patterns_tpu.models.train import (
+            offload_example_shardings,
+        )
 
-        host_sh, hbm_sh = offload_shardings(offload_opt_example)
+        host_sh, hbm_sh = offload_example_shardings(offload_opt_example)
     else:
         host_sh = hbm_sh = None
 

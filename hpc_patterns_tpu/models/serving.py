@@ -105,12 +105,28 @@ Serving-plane shape (round 10) — the engine core / transport split:
   where the donor left off, so the resume oracle extends to the
   disaggregated path byte-for-byte (docs/serving_plane.md).
 
+Tiered-memory shape (round 11) — the HBM arena as a cache:
+
+- ``EngineCore(residency=...)`` (a :class:`hpc_patterns_tpu.memory.
+  ResidencyManager`) fronts a larger HOST-resident pool with the HBM
+  page arena: under page pressure, policy-chosen victim rows PAGE OUT
+  to the host tier at a chunk boundary (the :meth:`EngineCore.
+  _detach_row` snapshot — KV bytes move, nothing is recomputed) and
+  swapped rows prefetch back with the pull dispatched BEFORE the
+  decode chunk and the install landing behind it (the overlapped-
+  admission discipline, measured as ``mem.prefetch`` windows). So
+  admission consults the manager instead of failing at
+  ``free_pages == 0`` — context length and batch become a policy
+  knob (docs/memory.md).
+
 Correctness contract (oracle-tested): every admitted sequence's
 emitted tokens are exactly ``paged_generate``'s for the same prompt,
 budget, and (when sampling) per-request key, regardless of what was
 scheduled around it — including sequences preempted and resumed along
-the way, and sequences prefilled on one engine and decoded on another
-(the serving-plane migration oracle, tests/test_serving_plane.py).
+the way, sequences prefilled on one engine and decoded on another
+(the serving-plane migration oracle, tests/test_serving_plane.py),
+and sequences paged through the host tier and back
+(tests/test_residency_serving.py).
 
 Reference lineage: the benchmark-IS-the-test discipline
 (aurora.mpich.miniapps/src/CMakeLists.txt:39-50) — the engine's
@@ -120,9 +136,10 @@ oracle on every run.
 
 from __future__ import annotations
 
+import math
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from functools import partial
 
 import jax
@@ -581,6 +598,14 @@ class EngineCore:
     attainment rollup (goodput next to raw tok/s) and the
     ``serve.goodput_tok_s``/``serve.tok_s`` gauges are set. Per-request
     outcomes accumulate in ``stats`` either way.
+
+    ``residency``: a :class:`hpc_patterns_tpu.memory.ResidencyManager`
+    — tiered HBM<->host paging: the pool becomes a CACHE over the
+    manager's host tier, cold/demanded rows page out at chunk
+    boundaries and prefetch back under the decode chunk, and the
+    constrained engine stays token-identical to an all-HBM one
+    (docs/memory.md; draft-assisted engines refuse it — the draft
+    cache's row state would have to tier too).
     """
 
     def __init__(self, params, cfg: TransformerConfig, *, slots: int,
@@ -592,7 +617,8 @@ class EngineCore:
                  temperature: float = 0.0, top_k: int = 0,
                  seed: int = 0, preempt: bool = False,
                  admit_highwater: float = 1.0,
-                 slo: dict[int, slolib.SLOTarget] | None = None):
+                 slo: dict[int, slolib.SLOTarget] | None = None,
+                 residency=None):
         if cfg.n_experts:
             # paged serving is dense-model territory so far
             raise ValueError("continuous batching: dense models only")
@@ -682,6 +708,33 @@ class EngineCore:
         # plane flips it on for decode-role replicas)
         self.track_chunk_windows = False
         self.chunk_windows: deque = deque(maxlen=8192)
+        # tiered residency (hpc_patterns_tpu/memory/): the HBM pool as
+        # a cache over a larger host pool — admission consults the
+        # manager instead of failing at free_pages == 0; cold rows
+        # page out at chunk boundaries and page back in with the pull
+        # dispatched BEFORE the decode chunk (docs/memory.md)
+        self.residency = residency
+        self._swapped: dict[int, MigrationBundle] = {}
+        #: pulls in flight: (host bundle, device payload, window handle)
+        self._prefetching: list[tuple] = []
+        #: installed this round, window completion pending
+        self._installed_prefetch: list[tuple] = []
+        self._external_demand = 0  # router-signaled install pressure
+        if residency is not None:
+            if draft_params is not None:
+                raise ValueError(
+                    "draft-assisted engines do not page: the draft "
+                    "cache's row state would have to tier too")
+            # the overlap proof needs the chunk windows to intersect
+            self.track_chunk_windows = True
+            # per-page payload bytes (every non-table pool, all
+            # layers): the manager's block accounting unit
+            self._page_nbytes = sum(
+                int(arr.nbytes) // (pool_pages + 1)
+                for name, pools in self.cache.items() if name != "table"
+                for arr in pools)
+        else:
+            self._page_nbytes = 0
         # observability hook (the framework's metrics/logging
         # subsystem, SURVEY.md §5): a callable taking keyword fields —
         # pass harness.RunLog.emit for JSONL records of admissions,
@@ -777,6 +830,8 @@ class EngineCore:
         sid = self._next_id if seq_id is None else seq_id
         if (sid in self.finished
                 or any(r.seq_id == sid for r in self._queue)
+                or sid in self._swapped
+                or any(b.seq_id == sid for b, _, _ in self._prefetching)
                 or any(s.active and s.seq_id == sid
                        for s in self._slots)):
             raise ValueError(
@@ -895,6 +950,10 @@ class EngineCore:
         The first token's readback is deferred to
         :meth:`_resolve_pending` at the loop's next sync point."""
         pages = [self.free_pages.pop() for _ in range(need)]
+        if self.residency is not None:
+            self.residency.register_group(
+                req.seq_id, need, need * self._page_nbytes,
+                tier="hbm", priority=req.priority)
         row = np.full((self.pages_per_seq,), self.trash, np.int32)
         row[:need] = pages
         self._table[slot] = row
@@ -1055,8 +1114,16 @@ class EngineCore:
         self.pos = self.pos.at[slot].set(0)
         self.limit = self.limit.at[slot].set(0)
 
+    def _residency_release(self, seq_id: int) -> None:
+        """Drop a row's blocks from the residency accounting (it
+        finished, was preempted back to the queue, or migrated away).
+        No-op without a manager."""
+        if self.residency is not None:
+            self.residency.release_group(seq_id)
+
     def _finish(self, slot: int):
         st = self._slots[slot]
+        self._residency_release(st.seq_id)
         self.finished[st.seq_id] = np.asarray(st.out, np.int32)
         self._emit(kind="serve_finish", seq_id=st.seq_id, slot=slot,
                    tokens=len(st.out), pages_freed=len(st.pages))
@@ -1083,16 +1150,30 @@ class EngineCore:
 
     # -- preemption --------------------------------------------------------
 
+    def _reserved_prefetch_pages(self) -> int:
+        """Pages spoken for by pulls in flight (dispatched host->HBM
+        prefetches whose install has not happened yet): admissions and
+        preemption must not hand them to someone else, or the staged
+        swap-in starves behind the very traffic it yielded to."""
+        return sum(b.n_pages for b, _, _ in self._prefetching)
+
     def _admissible(self, need: int, fresh: bool) -> bool:
         """Would a request needing ``need`` pages admit right now?
         (free slot + free pages + the fresh-admission high-water mark
-        — the same three checks :meth:`_try_admit` applies)."""
-        if not any(not s.active for s in self._slots):
+        — the same three checks :meth:`_try_admit` applies). Pages and
+        slots reserved for in-flight prefetch installs are not free —
+        and for the high-water math they count as USED: the staged
+        swap-in will occupy them at install, and a fresh admission
+        that squeaked under the mark meanwhile would breach the
+        headroom the mark reserves."""
+        free_slots = sum(1 for s in self._slots if not s.active)
+        if free_slots <= len(self._prefetching):
             return False
-        if need > len(self.free_pages):
+        reserved = self._reserved_prefetch_pages()
+        if need > len(self.free_pages) - reserved:
             return False
         if fresh:
-            used = self.pool_pages - len(self.free_pages)
+            used = self.pool_pages - len(self.free_pages) + reserved
             if used + need > self.admit_highwater * self.pool_pages:
                 return False
         return True
@@ -1212,6 +1293,7 @@ class EngineCore:
             m.counter("serve.preempted").inc()
             m.gauge("serve.free_pages").set(
                 len(self.free_pages) + len(st.pages))
+        self._residency_release(st.seq_id)
         self._release_slot(slot)
         self._queue.append(req)
         if m.enabled:
@@ -1347,6 +1429,15 @@ class EngineCore:
             chaoslib.maybe_inject("engine_round", chaos_index)
         if self.preempt:
             self._maybe_preempt()
+        if self.residency is not None:
+            self.residency.begin_round()
+            for s in self._slots:
+                if s.active:
+                    self.residency.touch_group(s.seq_id)
+            # pulls for swapped rows dispatch BEFORE the decode chunk:
+            # the host->HBM copies fly while the chunk computes, and
+            # the install lands behind it at the pre_collect position
+            self._dispatch_prefetch()
         spec = self.draft_params is not None
         dispatch = self._dispatch_spec if spec else self._dispatch_chunk
         collect = self._collect_spec if spec else self._collect_chunk
@@ -1366,7 +1457,11 @@ class EngineCore:
                 inflight = dispatch()
                 t_chunk0 = time.perf_counter()
             elif not any(s.active for s in self._slots):
-                stalled = bool(self._queue) and not admitted
+                stalled = (bool(self._queue) and not admitted
+                           and not self._swapped
+                           and not self._prefetching)
+        if self.residency is not None:
+            self._install_prefetched(inflight is not None)
         if pre_collect is not None:
             pre_collect(inflight is not None)
         if inflight is not None:
@@ -1378,6 +1473,12 @@ class EngineCore:
                 # behind decode compute (kv_migration_overlap_frac)
                 self.chunk_windows.append(
                     (t_chunk0, time.perf_counter()))
+        if self.residency is not None:
+            # round boundary: the chunk is collected, nothing in
+            # flight — observe this round's prefetch completions, then
+            # run the eviction policy (cold + demanded rows page out)
+            self._complete_prefetches()
+            self._residency_balance()
         return {"admitted": admitted, "exposed_s": exposed_s,
                 "stalled": stalled,
                 "active": any(s.active for s in self._slots)}
@@ -1397,7 +1498,16 @@ class EngineCore:
         return sum(1 for s in self._slots if s.active)
 
     def has_work(self) -> bool:
-        return bool(self._queue) or any(s.active for s in self._slots)
+        return (bool(self._queue) or bool(self._swapped)
+                or bool(self._prefetching)
+                or any(s.active for s in self._slots))
+
+    def request_pages(self, n_pages: int) -> None:
+        """External install pressure (the serving-plane router waiting
+        to land a migration bundle): ask the residency manager to free
+        ``n_pages`` at this round's balance point. No-op without a
+        manager — the caller then waits for ordinary completions."""
+        self._external_demand = max(self._external_demand, int(n_pages))
 
     def would_fit(self, prompt_len: int, max_new: int) -> bool:
         """Could this engine EVER serve the request (table width, pool
@@ -1415,11 +1525,14 @@ class EngineCore:
 
     def migration_admissible(self, n_pages: int) -> bool:
         """Could :meth:`install_migration` of an ``n_pages`` bundle
-        succeed right now? Free slot + free pages; migrations bypass
-        the fresh-admission high-water mark for the same reason resumes
-        do — their tokens are already paid for."""
-        return (any(not s.active for s in self._slots)
+        succeed right now? Free slot + free pages (minus in-flight
+        prefetch reservations); migrations bypass the fresh-admission
+        high-water mark for the same reason resumes do — their tokens
+        are already paid for."""
+        free_slots = sum(1 for s in self._slots if not s.active)
+        return (free_slots > len(self._prefetching)
                 and n_pages <= len(self.free_pages)
+                - self._reserved_prefetch_pages()
                 and n_pages <= self.pages_per_seq)
 
     def exportable_slots(self) -> list[int]:
@@ -1429,9 +1542,13 @@ class EngineCore:
         return [i for i, s in enumerate(self._slots)
                 if s.active and i not in self._pending]
 
-    def export_migration(self, slot: int) -> MigrationBundle:
+    def _detach_row(self, slot: int) -> MigrationBundle:
         """Detach one active row into a :class:`MigrationBundle` and
-        release its slot/pages — the donor half of the KV handoff.
+        release its slot/pages — the snapshot half SHARED by
+        :meth:`export_migration` (the plane's KV handoff) and the
+        residency manager's swap-out (the host-tier eviction): both
+        are "this row continues elsewhere", they differ only in where
+        the pages go and in the bookkeeping around them.
 
         Runs at a chunk boundary with the row's device work resolved
         (a prefill-role engine never has a chunk in flight), so the
@@ -1477,15 +1594,55 @@ class EngineCore:
             n_pages=len(st.pages), page_size=self.page_size,
             pages_payload=payload,
         )
+        self._release_slot(slot)
+        return bundle
+
+    def export_migration(self, slot: int) -> MigrationBundle:
+        """Detach one active row for a CROSS-ENGINE handoff — the
+        donor half of the serving plane's KV migration (see
+        :meth:`_detach_row` for the snapshot contract). The row's
+        stats outcome closes as ``"migrated"``: its story continues in
+        another engine's table."""
+        bundle = self._detach_row(slot)
+        rec_s = self.stats.get(bundle.seq_id)
         if rec_s is not None:
             rec_s["outcome"] = "migrated"
-        self._emit(kind="serve_migrate_out", seq_id=st.seq_id,
-                   slot=slot, pages=len(st.pages),
-                   tokens_done=len(st.out))
+        self._residency_release(bundle.seq_id)
+        self._emit(kind="serve_migrate_out", seq_id=bundle.seq_id,
+                   slot=slot, pages=bundle.n_pages,
+                   tokens_done=len(bundle.out))
         m = metricslib.get_metrics()
         if m.enabled:
             m.counter("serve.migrated_out").inc()
-        self._release_slot(slot)
+        return bundle
+
+    def export_swapped(self, seq_id: int) -> MigrationBundle:
+        """Export a row currently parked in the HOST tier — the
+        cross-TIER migration path: an exported bundle gathers pages
+        from wherever they live, so the plane can migrate a row the
+        residency manager had swapped out without first paging it back
+        in. The payload normalizes to host numpy (the wire codec's
+        form; it was already host-resident — a deliberate readback of
+        bytes the device no longer owns)."""
+        if self.residency is None or seq_id not in self._swapped:
+            raise ValueError(
+                f"seq_id {seq_id} is not swapped out of this engine")
+        bundle = self._swapped.pop(seq_id)
+        payload = {
+            name: tuple(np.asarray(jax.device_get(a)) for a in arrs)
+            for name, arrs in bundle.pages_payload.items()
+        }
+        bundle = replace(bundle, pages_payload=payload)
+        rec_s = self.stats.get(seq_id)
+        if rec_s is not None:
+            rec_s["outcome"] = "migrated"
+        self._residency_release(seq_id)
+        self._emit(kind="serve_migrate_out", seq_id=seq_id, slot=-1,
+                   pages=bundle.n_pages, tokens_done=len(bundle.out),
+                   tier="host")
+        m = metricslib.get_metrics()
+        if m.enabled:
+            m.counter("serve.migrated_out").inc()
         return bundle
 
     def install_migration(self, bundle: MigrationBundle) -> int:
@@ -1516,10 +1673,34 @@ class EngineCore:
                 f"free pages {len(self.free_pages)})")
         if bundle.seq_id in self.finished \
                 or any(r.seq_id == bundle.seq_id for r in self._queue) \
+                or bundle.seq_id in self._swapped \
+                or any(b.seq_id == bundle.seq_id
+                       for b, _, _ in self._prefetching) \
                 or any(s.active and s.seq_id == bundle.seq_id
                        for s in self._slots):
             raise ValueError(
                 f"seq_id {bundle.seq_id} already known to this engine")
+        slot = self._attach_row(bundle)
+        if self.residency is not None:
+            self.residency.register_group(
+                bundle.seq_id, bundle.n_pages,
+                bundle.n_pages * self._page_nbytes,
+                tier="hbm", priority=bundle.priority)
+        self._emit(kind="serve_migrate_in", seq_id=bundle.seq_id,
+                   slot=slot, pages=bundle.n_pages, seq=bundle.seq,
+                   tokens_done=len(bundle.out))
+        m = metricslib.get_metrics()
+        if m.enabled:
+            m.counter("serve.migrated_in").inc()
+            m.gauge("serve.free_pages").set(len(self.free_pages))
+        return slot
+
+    def _attach_row(self, bundle: MigrationBundle) -> int:
+        """Seat a detached row in this engine — the dispatch-only
+        install half SHARED by :meth:`install_migration` (cross-engine
+        handoff) and the residency manager's swap-in (the prefetched
+        host-tier row returning to HBM). Admissibility is the
+        CALLER's to have checked. Returns the slot."""
         slot = next(i for i, s in enumerate(self._slots) if not s.active)
         pages = [self.free_pages.pop() for _ in range(bundle.n_pages)]
         # jaxlint: disable=host-sync-in-dispatch — host-list packing of
@@ -1564,14 +1745,223 @@ class EngineCore:
             "tokens": 0, "outcome": None,
             "preemptions": bundle.preemptions,
         }
-        self._emit(kind="serve_migrate_in", seq_id=bundle.seq_id,
-                   slot=slot, pages=bundle.n_pages, seq=bundle.seq,
-                   tokens_done=len(st.out))
+        return slot
+
+
+    # -- tiered residency (HBM <-> host paging, memory/residency.py) --------
+
+    def _swap_out(self, slot: int) -> None:
+        """Page one active row out to the HOST tier: detach it (the
+        :meth:`_detach_row` chunk-boundary snapshot — pages gathered
+        device-side, cursors/key to host, slot + HBM pages freed) and
+        move the gathered payload to host memory through the manager
+        (its ``mem.evict`` window; async on a real pinned-host tier).
+        The row is NOT re-prefilled on return — its KV bytes come back
+        exactly, which is why swap is strictly cheaper than preemption
+        and byte-exactness is free."""
+        st = self._slots[slot]
+        sid = st.seq_id
+        bundle = self._detach_row(slot)
+        host_payload = self.residency.push_payload(
+            bundle.pages_payload,
+            attrs={"seq_id": sid, "pages": bundle.n_pages})
+        self._swapped[sid] = replace(bundle,
+                                     pages_payload=host_payload)
+        self.residency.retier_group(sid, "host")
+        self._emit(kind="serve_swap_out", seq_id=sid, slot=slot,
+                   pages=bundle.n_pages, tokens_done=len(bundle.out),
+                   free_pages=len(self.free_pages))
         m = metricslib.get_metrics()
         if m.enabled:
-            m.counter("serve.migrated_in").inc()
+            m.counter("serve.swapped_out").inc()
             m.gauge("serve.free_pages").set(len(self.free_pages))
-        return slot
+
+    def _dispatch_prefetch(self) -> None:
+        """Dispatch host->HBM pulls for swapped rows that will fit —
+        BEFORE the round's decode chunk, so the transfer flies under
+        it (the PR 2 overlapped-admission / PR 9 migration
+        discipline). Admission order: priority class first, swap-out
+        order (FIFO) within a class, with skip — a big parked row must
+        not starve smaller ones behind it. Pulled pages/slots are
+        RESERVED (:meth:`_reserved_prefetch_pages`) until the install
+        lands in ``pre_collect``."""
+        if not self._swapped:
+            return
+        free_pages = (len(self.free_pages)
+                      - self._reserved_prefetch_pages())
+        free_slots = (sum(1 for s in self._slots if not s.active)
+                      - len(self._prefetching))
+        # a STRICTLY more urgent queued class outranks the swap-in: the
+        # freed arena goes to admission this round, not to pulling a
+        # less important row back (same class: the swapped row wins —
+        # its tokens are already paid for, the resume-before-fresh rule)
+        q_min = min((r.priority for r in self._queue), default=None)
+        for sid, bundle in sorted(self._swapped.items(),
+                                  key=lambda kv: kv[1].priority):
+            if free_slots < 1:
+                break
+            if q_min is not None and q_min < bundle.priority:
+                break
+            if bundle.n_pages > free_pages:
+                continue
+            payload, handle = self.residency.pull_payload(
+                bundle.pages_payload,
+                attrs={"seq_id": sid, "pages": bundle.n_pages})
+            self._prefetching.append((bundle, payload, handle))
+            del self._swapped[sid]
+            free_pages -= bundle.n_pages
+            free_slots -= 1
+            self._emit(kind="serve_prefetch", seq_id=sid,
+                       pages=bundle.n_pages)
+
+    def _install_prefetched(self, overlapped: bool) -> None:
+        """Seat arrived prefetches back into the arena — the
+        ``pre_collect`` position: BEHIND the in-flight decode chunk
+        when there is one (``overlapped``), exactly like an overlapped
+        admission or a migration install. A bundle that cannot seat
+        yet (its reserved slot/pages raced an admission) stays staged
+        for the next round — its device payload keeps."""
+        if not self._prefetching:
+            return
+        still = []
+        for bundle, payload, handle in self._prefetching:
+            free_slots = sum(1 for s in self._slots if not s.active)
+            if free_slots < 1 or bundle.n_pages > len(self.free_pages):
+                still.append((bundle, payload, handle))
+                continue
+            slot = self._attach_row(
+                replace(bundle, pages_payload=payload))
+            self.residency.retier_group(bundle.seq_id, "hbm")
+            self._installed_prefetch.append((bundle, handle))
+            self._emit(kind="serve_swap_in", seq_id=bundle.seq_id,
+                       slot=slot, pages=bundle.n_pages,
+                       overlapped=overlapped)
+            m = metricslib.get_metrics()
+            if m.enabled:
+                m.counter("serve.swapped_in").inc()
+        self._prefetching = still
+
+    def _complete_prefetches(self) -> None:
+        """Close this round's installed prefetch windows at an
+        OBSERVED completion and fold their overlap against the decode
+        chunk windows into the manager's ``prefetch_overlap_frac`` —
+        the Perfetto-visible proof that the pull hid under the chunk."""
+        if not self._installed_prefetch:
+            return
+        # jaxlint: disable=host-sync-in-dispatch — completion
+        # measurement at the round boundary (the chunk readback already
+        # happened); the window must not close before the install's
+        # device work it claims to cover has finished
+        jax.block_until_ready(self.temps)
+        # NON-destructive filter: on a plane replica the router's
+        # migration-overlap accounting prunes and reads this same
+        # deque — popping here would delete windows its still-open
+        # migrations intersect (and vice versa would understate the
+        # gated overlap fractions). The deque's maxlen bounds memory.
+        floor = min(h[3] for _, h in self._installed_prefetch)
+        windows = [w for w in self.chunk_windows if w[1] >= floor]
+        for _bundle, handle in self._installed_prefetch:
+            self.residency.complete_pull(handle, chunk_windows=windows)
+        self._installed_prefetch.clear()
+
+    def _residency_balance(self) -> None:
+        """Eviction decision, end of round (chunk collected, nothing
+        in flight — the same boundary preemption snapshots at): free
+        enough HBM for the most urgent DEMAND — the head queued
+        request that could not admit, the oldest swapped row waiting
+        its turn back in, or router-signaled install pressure
+        (:meth:`request_pages`) — by paging policy-chosen victims to
+        host; then proactively page out whatever the policy calls cold
+        (``ColdAfterNPolicy``). This is how ``free_pages == 0`` became
+        a policy knob instead of a refusal."""
+        r = self.residency
+        avail = len(self.free_pages) - self._reserved_prefetch_pages()
+        sizes = {g.group: g.n_blocks for g in r.groups("hbm")}
+        victims: list = []
+
+        def planned_avail():
+            # pages already slated to free by THIS pass's earlier
+            # picks count toward later demands — without the credit,
+            # co-occurring demands over-evict and the surplus victims
+            # pay a gratuitous host round trip each
+            return avail + sum(sizes.get(v, 0) for v in victims)
+
+        # (a) router-signaled install pressure: any victim class
+        demand = self._external_demand
+        self._external_demand = 0
+        if demand > planned_avail():
+            victims += r.victims(demand - planned_avail(),
+                                 exclude=victims)
+        # (b) the head queued request that cannot admit: it may only
+        # displace STRICTLY less urgent residents (the preemption
+        # victim rule, paging instead of re-prefilling) — a same-class
+        # arrival waits for completions, exactly as it would without a
+        # manager, so there is no evict/pull-back thrash loop
+        if self._queue:
+            req = self._queue[self._queue_order()[0]]
+            need = self._pages_for(req.prompt.size, req.max_new)
+            fresh = req.resume_prefix is None
+            if not self._admissible(need, fresh=fresh):
+                # size the eviction to the BINDING constraint of the
+                # _admissible check that failed: raw pages, and — for
+                # fresh heads — the admit_highwater cap too (evicting
+                # only to the page shortfall would leave a
+                # highwater-blocked head queued while the victims paid
+                # the host round trip for nothing)
+                shortfall = need - planned_avail()
+                if fresh:
+                    # mirror _admissible's high-water accounting:
+                    # reserved prefetch pages count as used, pages
+                    # already slated to free this pass do not
+                    used = (self.pool_pages - len(self.free_pages)
+                            + self._reserved_prefetch_pages()
+                            - (planned_avail() - avail))
+                    hw_cap = self.admit_highwater * self.pool_pages
+                    # host float math (math.ceil of plain ints/floats,
+                    # no device value anywhere near it)
+                    shortfall = max(shortfall,
+                                    math.ceil(used + need - hw_cap))
+                free_slots = (sum(1 for s in self._slots
+                                  if not s.active)
+                              - len(self._prefetching))
+                if shortfall <= 0 and free_slots < 1:
+                    # the binding failure is the SLOT, not pages: any
+                    # single victim frees a whole slot (its pages ride
+                    # along) — without this a slot-bound urgent head
+                    # waited behind plentiful pages it could not use
+                    shortfall = 1
+                if shortfall > 0:
+                    victims += r.victims(shortfall, exclude=victims,
+                                         min_priority=req.priority + 1)
+        # (c) the next swapped row due back in (priority class first,
+        # swap-out order within it — sorted is stable over insertion):
+        # rotation within same-or-less-urgent classes, so a parked row
+        # never displaces a more important resident
+        if self._swapped and not victims:
+            head = sorted(self._swapped.values(),
+                          key=lambda b: b.priority)[0]
+            if head.n_pages > avail:
+                victims += r.victims(head.n_pages - avail,
+                                     exclude=victims,
+                                     min_priority=head.priority)
+        cold = r.cold_groups(exclude=victims)
+        for sid in victims + cold:
+            slot = next((i for i, s in enumerate(self._slots)
+                         if s.active and s.seq_id == sid), None)
+            if slot is None or slot in self._pending:
+                continue
+            if not r.can_host(len(self._slots[slot].pages)):
+                # earlier picks in THIS pass consumed the host tier's
+                # remaining room — skip, never raise mid-balance
+                continue
+            if sid in cold and sid not in victims \
+                    and sum(1 for s in self._slots if s.active) <= 1:
+                # proactive cold paging never empties the arena: one
+                # row keeps decoding, so next round's pulls still have
+                # a chunk to hide under (demand evictions are exempt —
+                # their consumer needs the pages regardless)
+                continue
+            self._swap_out(slot)
 
 
 class ContinuousBatcher(EngineCore):
@@ -1638,7 +2028,7 @@ class ContinuousBatcher(EngineCore):
                     t_abs = t_run0 + t_arr
                     self._queue[-1].t_submit = t_abs
                     self.stats[sid]["t_submit"] = t_abs
-            if not (self._queue or any(s.active for s in self._slots)):
+            if not self.has_work():
                 if not pending_arrivals:
                     break
                 if max_rounds is not None:
@@ -1665,6 +2055,8 @@ class ContinuousBatcher(EngineCore):
                     "admit_highwater leaves it no headroom)"
                 )
         total = time.perf_counter() - t_run0
+        if self.residency is not None:
+            self.residency.drain()  # close any open mem.evict windows
         self.last_bubble_frac = (t_exposed / total) if total > 0 else 0.0
         self._serve_s += total
         m = metricslib.get_metrics()

@@ -26,6 +26,7 @@ from jax import lax
 
 from hpc_patterns_tpu.harness import metrics as metricslib
 from hpc_patterns_tpu.harness import trace as tracelib
+from hpc_patterns_tpu.memory import kinds as kindslib
 from hpc_patterns_tpu.models import sharding as shardlib
 from hpc_patterns_tpu.models.transformer import TransformerConfig, init_params, loss_fn
 
@@ -87,8 +88,10 @@ def make_optimizer(learning_rate: float = 3e-4, weight_decay: float = 0.01,
 def memory_kind_shardings(tree, kind: str):
     """Shardings of ``tree``'s (concrete) leaves retargeted to a JAX
     memory kind — the L2 allocator axis (SURVEY.md §2, ``-H/-D/-S``)
-    applied to training state."""
-    return jax.tree.map(lambda x: x.sharding.with_memory_kind(kind), tree)
+    applied to training state. Delegates to the single definition in
+    ``memory/kinds.py`` (the residency subsystem's probe/sharding
+    home); this name stays for its existing callers."""
+    return kindslib.memory_kind_shardings(tree, kind)
 
 
 def offload_opt_state(opt_state, kind: str = "pinned_host"):
@@ -96,7 +99,20 @@ def offload_opt_state(opt_state, kind: str = "pinned_host"):
     (f32) parameter footprint and are touched once per step — parking
     them in host RAM frees that HBM for batch/model/sequence headroom,
     at the cost of streaming them over PCIe each step. Pair with
-    ``make_train_step(..., offload_opt_example=...)``."""
+    ``make_train_step(..., offload_opt_example=...)``.
+
+    Gated on the SHARED placement probe (memory/kinds.py): a backend
+    that cannot actually place buffers in ``kind`` gets the input back
+    UNCHANGED with a printed note — previously this path paid the
+    ``device_put`` (and on some backends raised) while delivering none
+    of the offload's benefit, and callers could not tell."""
+    leaves = jax.tree.leaves(opt_state)
+    device = next(iter(leaves[0].devices())) if leaves else None
+    if not kindslib.memory_kind_placement_works(device, kind):
+        print(f"note: backend has no usable {kind!r} memory kind; "
+              "optimizer state left in place (no offload benefit "
+              "available here)")
+        return opt_state
     return jax.device_put(opt_state, memory_kind_shardings(opt_state, kind))
 
 
@@ -109,8 +125,29 @@ def offload_shardings(opt_state_host):
     return host_sh, memory_kind_shardings(opt_state_host, "device")
 
 
+def offload_example_shardings(example):
+    """:func:`offload_shardings`, tolerant of the probe-gated identity
+    fallback: when :func:`offload_opt_state` left the state IN PLACE
+    (no usable pinned_host on this backend), the tiers collapse onto
+    one memory — both targets are the example's own shardings, so the
+    step's staging still runs as same-memory copies instead of dying
+    inside ``with_memory_kind("device")`` with an error that looks
+    unrelated to the note the user was shown. ONE definition for every
+    step builder taking an ``offload_opt_example`` (make_train_step,
+    pp.make_pp_train_step)."""
+    leaves = jax.tree.leaves(example)
+    pinned = bool(leaves) and all(
+        getattr(x.sharding, "memory_kind", None) == "pinned_host"
+        for x in leaves)
+    if pinned:
+        return offload_shardings(example)
+    host_sh = jax.tree.map(lambda x: x.sharding, example)
+    return host_sh, host_sh
+
+
 def make_train_step(cfg: TransformerConfig, mesh=None, optimizer=None,
-                    accum_steps: int = 1, offload_opt_example=None):
+                    accum_steps: int = 1, offload_opt_example=None,
+                    residency=None):
     """Returns jitted ``step(params, opt_state, tokens) -> (loss, params,
     opt_state)`` with param/opt-state donation (in-place HBM update).
 
@@ -125,6 +162,16 @@ def make_train_step(cfg: TransformerConfig, mesh=None, optimizer=None,
     state lives — the update then pulls it to HBM, applies, and pushes
     it back, all inside the one jit (XLA schedules the transfers).
 
+    ``residency``: a :class:`hpc_patterns_tpu.memory.ResidencyManager`
+    — routes the offload through the tiered-memory subsystem instead
+    of the in-jit all-or-nothing move: the host->HBM pull is
+    DISPATCHED before the gradient phase and hides under it
+    (accumulation-phase prefetch, with a measured ``mem.prefetch``
+    window and overlap fraction), the update consumes the pulled
+    state, and the push back to host rides a ``mem.evict`` window
+    (docs/memory.md). Requires ``offload_opt_example``. Numerics are
+    the single-jit path's (same gradient and update ops, staged).
+
     Pass ``params``/``opt_state`` created by :func:`init_train_state`
     (sharded when ``mesh`` is given); the same code path is the
     single-device oracle when ``mesh`` is None (the §4 test strategy:
@@ -135,40 +182,49 @@ def make_train_step(cfg: TransformerConfig, mesh=None, optimizer=None,
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     grad_fn = jax.value_and_grad(partial(loss_fn, cfg=cfg, mesh=mesh))
     if offload_opt_example is not None:
-        host_sh, hbm_sh = offload_shardings(offload_opt_example)
+        host_sh, hbm_sh = offload_example_shardings(offload_opt_example)
     else:
         host_sh = hbm_sh = None
+
+    def accum_grads(params, tokens):
+        if accum_steps == 1:
+            return grad_fn(params, tokens)
+        B = tokens.shape[0]
+        if B % accum_steps:
+            raise ValueError(
+                f"batch {B} must divide by accum_steps {accum_steps}"
+            )
+        micro = tokens.reshape(accum_steps, B // accum_steps, -1)
+
+        def accum(carry, mb):
+            loss_sum, g_sum = carry
+            loss, g = grad_fn(params, mb)
+            return (
+                loss_sum + loss,
+                jax.tree.map(jnp.add, g_sum, g),
+            ), None
+
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        (loss, grads), _ = lax.scan(
+            accum, (jnp.zeros((), jnp.float32), zeros), micro
+        )
+        scale = 1.0 / accum_steps
+        return loss * scale, jax.tree.map(lambda g: g * scale, grads)
+
+    if residency is not None:
+        if offload_opt_example is None:
+            raise ValueError(
+                "residency streaming needs offload_opt_example (a "
+                "host-resident opt state from offload_opt_state)")
+        return _make_streamed_step(optimizer, accum_grads, host_sh,
+                                   hbm_sh, residency)
 
     def step(params, opt_state, tokens):
         if hbm_sh is not None:
             opt_state = jax.device_put(opt_state, hbm_sh)
-        if accum_steps == 1:
-            loss, grads = grad_fn(params, tokens)
-        else:
-            B = tokens.shape[0]
-            if B % accum_steps:
-                raise ValueError(
-                    f"batch {B} must divide by accum_steps {accum_steps}"
-                )
-            micro = tokens.reshape(accum_steps, B // accum_steps, -1)
-
-            def accum(carry, mb):
-                loss_sum, g_sum = carry
-                loss, g = grad_fn(params, mb)
-                return (
-                    loss_sum + loss,
-                    jax.tree.map(jnp.add, g_sum, g),
-                ), None
-
-            zeros = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params
-            )
-            (loss, grads), _ = lax.scan(
-                accum, (jnp.zeros((), jnp.float32), zeros), micro
-            )
-            scale = 1.0 / accum_steps
-            loss = loss * scale
-            grads = jax.tree.map(lambda g: g * scale, grads)
+        loss, grads = accum_grads(params, tokens)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         if host_sh is not None:
@@ -193,6 +249,76 @@ def make_train_step(cfg: TransformerConfig, mesh=None, optimizer=None,
     # AOT memory_analysis pass is a second full compile of the step
     # (use trace.record_executable_memory at an explicit AOT site)
     return tracelib.instrument_jit(jitted, "train.step")
+
+
+def _make_streamed_step(optimizer, accum_grads, host_sh, hbm_sh,
+                        residency):
+    """The residency-managed offloaded step: two jits staged around
+    the manager's instrumented transfers (see ``make_train_step``'s
+    ``residency`` doc). The pull DISPATCHES first, the gradient-
+    accumulation jit runs over it, and the pull's completion is
+    OBSERVED (blocked) while that phase still executes — so the wait
+    that remains is exactly the transfer time the accumulation failed
+    to hide, and the ``mem.prefetch`` window + overlap fraction report
+    it instead of asserting it."""
+    import jax as _jax
+
+    leaves = _jax.tree.leaves(host_sh)
+    pinned = bool(leaves) and all(
+        getattr(s, "memory_kind", None) == "pinned_host"
+        for s in leaves)
+    if not pinned:
+        # degraded tier (no real pinned_host — offload_opt_state left
+        # the state in place): the tiers collapse onto one memory, the
+        # staging/measurement pipeline still runs — the CPU test shape
+        hbm_sh = host_sh
+    accum_jit = tracelib.instrument_jit(jax.jit(accum_grads),
+                                        "train.accum")
+
+    def apply_update(params, grads, opt_state):
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state
+
+    # params + opt state donate (the in-place HBM update, as in the
+    # fused step); grads do not — only some of their buffers could
+    # alias an output, and the partial-donation warning would spam
+    # every caller for a marginal win
+    apply_jit = tracelib.instrument_jit(
+        jax.jit(apply_update, donate_argnums=(0, 2)), "train.apply")
+
+    def step(params, opt_state, tokens):
+        import time
+
+        # close the PREVIOUS step's mem.evict window first (its push
+        # had a whole step to land, so this block is cheap) — without
+        # it a traced run retains every step's host opt-state copy in
+        # the manager's open-window list, unbounded
+        residency.drain()
+        opt_dev, handle = residency.pull_payload(
+            opt_state, shardings=hbm_sh,
+            attrs={"consumer": "train.accum"})
+        t_acc0 = time.perf_counter()
+        loss, grads = accum_jit(params, tokens)
+        # observe the ACCUMULATION's completion first: the consumer
+        # window must end when the hiding compute ended. Stamping it
+        # after also waiting out the pull would extend the window over
+        # the exposed wait and read ~100% overlap for a transfer the
+        # accumulation barely covered — the one number this exists to
+        # catch on chip
+        jax.block_until_ready(loss)
+        t_acc1 = time.perf_counter()
+        # now the pull: any wait that remains is the UNHIDDEN time
+        jax.block_until_ready(opt_dev)
+        residency.complete_pull(handle,
+                                chunk_windows=((t_acc0, t_acc1),))
+        params, opt_dev = apply_jit(params, grads, opt_dev)
+        opt_host = residency.push_payload(
+            opt_dev, shardings=host_sh,
+            attrs={"consumer": "train.apply"})
+        return loss, params, opt_host
+
+    return step
 
 
 def init_train_state(key, cfg: TransformerConfig, mesh=None, optimizer=None):

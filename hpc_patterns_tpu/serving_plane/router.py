@@ -400,6 +400,12 @@ class ServingPlane:
             installed.append((b, overlapped))
             self.migrations += 1
             self.stats.setdefault(b.seq_id, {})["replica"] = r.name
+        if r.pending_migrations:
+            # a bundle is parked for lack of pages: on a tiered-memory
+            # replica (EngineCore(residency=...)) ask the manager to
+            # evict for it at this round's balance point — the install
+            # retries next round against the freed arena
+            r.engine.request_pages(r.pending_migrations[0].n_pages)
         return installed
 
     def _complete_migrations(self, r: Replica, installed: list) -> None:

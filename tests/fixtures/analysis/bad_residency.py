@@ -1,0 +1,44 @@
+"""Known-bad: tiered-memory prefetch/evict hazards, minimized.
+
+The round-11 residency manager's whole point is that the host<->HBM
+transfer hides under the in-flight decode chunk — so the hazard class
+is a host readback INSIDE the prefetch/evict dispatch paths
+(``DEFAULT_DISPATCH_CRITICAL`` names them): a sync there serializes
+exactly the latency the tier exists to hide, turning every swap into
+a stall the bubble rollup then blames on admission.
+
+Lines carrying ``EXPECT: <rule>`` markers are the golden findings
+tests/test_analysis.py asserts, line-exact.
+"""
+
+import numpy as np
+
+import jax
+
+
+def _dispatch_prefetch(engine, bundle):
+    # peeking at the cursors before the pull forces a readback while
+    # the decode chunk is (or should be) in flight
+    pos_now = np.asarray(engine.pos)  # EXPECT: host-sync-in-dispatch
+    payload, handle = engine.residency.pull_payload(
+        bundle.pages_payload, attrs={"pos": int(pos_now[0])})
+    return payload, handle
+
+
+def _install_prefetched(engine, bundle, payload):
+    slot = engine._attach_row(bundle)
+    # "confirming" the install mid-round stalls the chunk it was
+    # supposed to hide behind — completion belongs to the round
+    # boundary (_complete_prefetches)
+    jax.block_until_ready(engine.temps)  # EXPECT: host-sync-in-dispatch
+    return slot
+
+
+def _swap_out(engine, slot):
+    bundle = engine._detach_row(slot)
+    # the gathered payload is device-side by design; forcing it to
+    # host HERE is the all-or-nothing synchronous offload the manager
+    # replaced (the pinned-host tier moves it asynchronously)
+    raw = {k: tuple(np.array(jax.device_get(a)) for a in v)  # EXPECT: host-sync-in-dispatch
+           for k, v in bundle.pages_payload.items()}
+    return raw

@@ -1,0 +1,32 @@
+"""Known-clean: the tiered-memory transfer discipline.
+
+The prefetch/evict dispatch paths stay dispatch-only: pulls are async
+``device_put`` trees the decode chunk hides, installs enqueue behind
+the in-flight chunk, and the deliberate syncs (the swap-out cursor
+snapshot, the round-boundary window completions) live in
+``_detach_row`` / ``_complete_prefetches`` with their justified
+suppressions — not in the dispatch paths themselves.
+"""
+
+
+def _dispatch_prefetch(engine, bundle):
+    # dispatch-only: the pull enqueues async; the cursor decision was
+    # made from host bookkeeping, not a device readback
+    payload, handle = engine.residency.pull_payload(
+        bundle.pages_payload,
+        attrs={"seq_id": bundle.seq_id, "pages": bundle.n_pages})
+    return payload, handle
+
+
+def _install_prefetched(engine, bundle, payload):
+    # the scatter + cursor seeding enqueue behind the in-flight chunk;
+    # completion is observed at the round boundary, not here
+    return engine._attach_row(bundle)
+
+
+def _swap_out(engine, slot):
+    # the payload moves tiers THROUGH the manager: pinned-host tier =
+    # async device_put per leaf, window accounted
+    bundle = engine._detach_row(slot)
+    return engine.residency.push_payload(
+        bundle.pages_payload, attrs={"seq_id": bundle.seq_id})

@@ -36,6 +36,22 @@ class TestParse:
         assert not stall.matches("engine_round", 4, 0)
         assert die.every == 0 and die.exit_code is None
 
+    def test_slow_host_transfer_spec(self):
+        # the round-11 tiered-memory injector: defaults to the
+        # host_transfer site (the residency manager's prefetch
+        # dispatch) and recurs like a straggler — degraded bandwidth
+        # is a condition, not an event
+        (f,) = chaos.parse("slow_host_transfer:delay_ms=40")
+        assert f.kind == "slow_host_transfer"
+        assert f.site == "host_transfer"
+        assert f.delay_s == pytest.approx(0.04)
+        assert f.every == 1
+        assert f.matches("host_transfer", 0, 0)
+        assert not f.matches("collective", 0, 0)
+        (g,) = chaos.parse("slow_host_transfer:at=2,delay_ms=40,every=0")
+        assert g.matches("host_transfer", 2, 0)
+        assert not g.matches("host_transfer", 3, 0)
+
     def test_every_and_at_schedule(self):
         (f,) = chaos.parse("straggler:delay_ms=1,at=2,every=4")
         fired = [i for i in range(12) if f.matches("collective", i, 0)]

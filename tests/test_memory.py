@@ -1,0 +1,309 @@
+"""Tiered-memory subsystem (hpc_patterns_tpu/memory/): the hoisted
+memory-kind probes, the residency manager's accounting + policies, and
+the residency-managed training step.
+
+The load-bearing claims: (1) there is ONE probe/sharding home —
+concurrency/commands.py, models/train.py, and apps/common.py all
+delegate here, so "does this backend have a host tier?" has one
+memoized answer per process; (2) ``offload_opt_state`` on a backend
+without a usable pinned_host tier returns the input UNCHANGED with a
+note instead of paying a doomed transfer; (3) the residency-managed
+streamed train step (pull dispatched before the gradient phase)
+computes the SAME numbers as the fused single-jit step while the
+manager measures the transfer windows it dispatched.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from hpc_patterns_tpu.harness import metrics as metricslib
+from hpc_patterns_tpu.harness import trace as tracelib
+from hpc_patterns_tpu.memory import (
+    ColdAfterNPolicy,
+    LRUPolicy,
+    PriorityAwarePolicy,
+    ResidencyManager,
+)
+from hpc_patterns_tpu.memory import kinds as kindslib
+from hpc_patterns_tpu.memory.residency import GroupView
+
+
+class TestKindsDelegation:
+    def test_commands_delegate_to_kinds(self):
+        from hpc_patterns_tpu.concurrency import commands
+
+        assert commands._kind_sharding is kindslib.kind_sharding
+        assert (commands._memory_kind_transfers_work
+                is kindslib.memory_kind_transfers_work)
+        assert commands._move_to_kind is kindslib.move_to_kind
+
+    def test_common_delegates_to_kinds(self):
+        from hpc_patterns_tpu.apps import common
+
+        # same answer, one probe home
+        assert (common.supports_memory_kind("pinned_host")
+                == kindslib.supports_memory_kind("pinned_host"))
+
+    def test_train_delegates_to_kinds(self):
+        from hpc_patterns_tpu.models.train import memory_kind_shardings
+
+        x = jax.numpy.zeros((4,), jax.numpy.float32)
+        tree = {"a": x, "b": (x, x)}
+        kind = x.sharding.memory_kind or "unpinned_host"
+        sh = memory_kind_shardings(tree, kind)
+        assert jax.tree.structure(sh) == jax.tree.structure(tree)
+        assert all(s.memory_kind == kind for s in jax.tree.leaves(sh))
+
+    def test_move_to_kind_is_cached_per_direction(self):
+        dev = jax.devices()[0]
+        kind = {m.kind for m in dev.addressable_memories()}.pop()
+        assert (kindslib.move_to_kind(dev, kind)
+                is kindslib.move_to_kind(dev, kind))
+
+    def test_probes_are_memoized_and_never_raise(self):
+        dev = jax.devices()[0]
+        a = kindslib.memory_kind_placement_works(dev)
+        assert a == kindslib.memory_kind_placement_works(dev)
+        b = kindslib.memory_kind_transfers_work(dev)
+        assert b == kindslib.memory_kind_transfers_work(dev)
+        assert isinstance(a, bool) and isinstance(b, bool)
+        assert kindslib.supports_memory_kind("no-such-kind") is False
+
+
+def _gv(group, n=4, tier="hbm", pinned=False, priority=0, touch=0,
+        since=0):
+    return GroupView(group=group, n_blocks=n, nbytes=n * 100,
+                     tier=tier, pinned=pinned, priority=priority,
+                     last_touch=touch, resident_since=since)
+
+
+class TestPolicies:
+    def test_lru_orders_by_touch_then_residency(self):
+        groups = [_gv("a", touch=5, since=1), _gv("b", touch=3, since=2),
+                  _gv("c", touch=3, since=0)]
+        order = [g.group for g in LRUPolicy().victim_order(groups, 9)]
+        assert order == ["c", "b", "a"]
+
+    def test_priority_aware_pages_background_first(self):
+        groups = [_gv("urgent", priority=0, touch=0),
+                  _gv("batch", priority=2, touch=9),
+                  _gv("mid", priority=1, touch=0)]
+        order = [g.group
+                 for g in PriorityAwarePolicy().victim_order(groups, 9)]
+        assert order == ["batch", "mid", "urgent"]
+
+    def test_cold_after_n_is_deterministic(self):
+        pol = ColdAfterNPolicy(3)
+        fresh = _gv("fresh", touch=8, since=8)
+        cold = _gv("cold", touch=5, since=5)
+        assert not pol.is_cold(fresh, 10)
+        assert pol.is_cold(cold, 8)
+        assert not pol.is_cold(cold, 7)
+        with pytest.raises(ValueError):
+            ColdAfterNPolicy(0)
+
+
+class TestManagerAccounting:
+    def test_register_retier_release_counts(self):
+        m = ResidencyManager(host_blocks=8)
+        m.register_group("r0", 4, 400)
+        m.register_group("r1", 2, 200)
+        assert m.hbm_blocks_used() == 6 and m.host_blocks_used() == 0
+        m.retier_group("r0", "host")
+        assert m.hbm_blocks_used() == 2 and m.host_blocks_used() == 4
+        m.retier_group("r0", "hbm")
+        assert m.host_blocks_used() == 0
+        m.release_group("r0")
+        m.release_group("r1")
+        assert not m.blocks
+
+    def test_duplicate_group_and_host_capacity_refused(self):
+        m = ResidencyManager(host_blocks=4)
+        m.register_group("r0", 3, 300)
+        with pytest.raises(ValueError, match="already registered"):
+            m.register_group("r0", 1, 100)
+        m.register_group("r1", 3, 300)
+        m.retier_group("r0", "host")
+        with pytest.raises(ValueError, match="host tier full"):
+            m.retier_group("r1", "host")
+        assert not m.can_host(2)
+
+    def test_victims_respect_pin_floor_and_priority(self):
+        m = ResidencyManager(host_blocks=16, policy=LRUPolicy(),
+                             min_resident_rounds=1)
+        m.register_group("a", 4, 400, priority=1)
+        m.register_group("b", 4, 400, priority=0)
+        # round 0: everything inside the min-residency floor
+        assert m.victims(4) == []
+        m.begin_round()
+        m.pin_group("a")
+        # pinned "a" is never offered; "b" covers the need
+        assert m.victims(4) == ["b"]
+        m.pin_group("a", pinned=False)
+        # min_priority: only strictly-less-urgent groups (>= 1)
+        assert m.victims(4, min_priority=1) == ["a"]
+        assert m.victims(4, min_priority=2) == []
+        # exclusion composes
+        assert m.victims(8, exclude=("a",)) == ["b"]
+
+    def test_cold_groups_follow_policy(self):
+        m = ResidencyManager(host_blocks=16,
+                             policy=ColdAfterNPolicy(2))
+        m.register_group("a", 4, 400)
+        m.begin_round()
+        assert m.cold_groups() == []
+        m.begin_round()
+        assert m.cold_groups() == ["a"]
+        m.touch_group("a")
+        # a touch alone does not reset residency age for decode rows;
+        # cold-after-n keys on residency age too
+        assert m.cold_groups() == ["a"]
+
+    def test_gauges_land_in_registry(self):
+        metricslib.configure(enabled=True)
+        try:
+            m = ResidencyManager(host_blocks=8)
+            m.register_group("r0", 4, 400)
+            m.retier_group("r0", "host")
+            reg = metricslib.get_metrics()
+            assert reg.gauge("mem.hbm_pages").last == 0
+            assert reg.gauge("mem.host_pages").last == 4
+        finally:
+            metricslib.configure(enabled=False)
+
+
+class TestTransfers:
+    def test_overlap_not_inflated_by_late_completion(self):
+        # a pull that completes long AFTER the consumer's compute
+        # window ended must read as mostly UNHIDDEN — the honesty
+        # property the train step's window-end choice relies on
+        import time
+
+        m = ResidencyManager(host_blocks=8)
+        payload = {"k": (np.zeros((4,), np.float32),)}
+        dev, handle = m.pull_payload(payload)
+        jax.block_until_ready(dev)
+        t0 = handle[3]
+        time.sleep(0.05)
+        m.complete_pull(handle, chunk_windows=((t0, t0 + 0.001),))
+        assert m.prefetch_overlap_frac < 0.5
+
+    def test_pull_and_push_roundtrip_and_windows(self):
+        rec = tracelib.configure(enabled=True)
+        try:
+            m = ResidencyManager(host_blocks=8)
+            payload = {"k": (np.arange(8, dtype=np.float32),)}
+            dev, handle = m.pull_payload(payload)
+            jax.block_until_ready(dev)
+            m.complete_pull(handle, chunk_windows=())
+            host = m.push_payload(dev)
+            m.drain()
+            np.testing.assert_array_equal(
+                np.asarray(jax.device_get(host["k"][0])),
+                payload["k"][0])
+            names = [ev[2] for ev in rec.events
+                     if ev[0] == "X" and ev[1] == "device"]
+            assert "mem.prefetch" in names and "mem.evict" in names
+            assert m.prefetch_bytes == 32 and m.swap_ins == 1
+            assert m.prefetch_overlap_frac is not None
+        finally:
+            tracelib.configure(enabled=False)
+            metricslib.configure(enabled=False)
+
+
+TINY = dict(vocab=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+            max_seq=16, dtype="float32")
+
+
+class TestTrainOffload:
+    def _state(self):
+        from hpc_patterns_tpu.models import TransformerConfig
+        from hpc_patterns_tpu.models.train import (
+            init_train_state,
+            make_optimizer,
+        )
+
+        cfg = TransformerConfig(**TINY)
+        opt = make_optimizer()
+        params, st = init_train_state(jax.random.PRNGKey(0), cfg,
+                                      optimizer=opt)
+        return cfg, opt, params, st
+
+    def test_offload_unsupported_backend_returns_input_unchanged(
+            self, monkeypatch, capsys):
+        # the round-11 gap fix: no usable pinned_host -> identity + a
+        # note, instead of paying (or dying on) a doomed device_put
+        from hpc_patterns_tpu.models.train import offload_opt_state
+
+        monkeypatch.setattr(kindslib, "memory_kind_placement_works",
+                            lambda device=None, kind="pinned_host":
+                            False)
+        _, _, _, st = self._state()
+        hosted = offload_opt_state(st)
+        assert hosted is st
+        assert "no usable 'pinned_host'" in capsys.readouterr().out
+
+    def test_offload_supported_backend_moves_state(self, monkeypatch):
+        # with the probe green the old behavior is untouched: every
+        # leaf retargets to the host kind (placement asserted via the
+        # device_put call seam, so the test runs on any backend)
+        from hpc_patterns_tpu.models import train as trainlib
+
+        monkeypatch.setattr(kindslib, "memory_kind_placement_works",
+                            lambda device=None, kind="pinned_host":
+                            True)
+        seen = {}
+
+        def fake_put(tree, shardings):
+            seen["kinds"] = {s.memory_kind
+                             for s in jax.tree.leaves(shardings)}
+            return tree
+
+        monkeypatch.setattr(trainlib.jax, "device_put", fake_put)
+        monkeypatch.setattr(
+            trainlib, "memory_kind_shardings",
+            lambda tree, kind: jax.tree.map(
+                lambda x: type("S", (), {"memory_kind": kind})(), tree))
+        _, _, _, st = self._state()
+        trainlib.offload_opt_state(st)
+        assert seen["kinds"] == {"pinned_host"}
+
+    def test_streamed_step_matches_single_jit_step(self):
+        from hpc_patterns_tpu.models.train import (
+            make_batch,
+            make_train_step,
+        )
+
+        cfg, opt, params, st = self._state()
+        tokens = make_batch(jax.random.PRNGKey(1), cfg, 4, 16)
+        step = make_train_step(cfg, optimizer=opt, accum_steps=2)
+        l1, p1, _ = step(params, st, tokens)
+
+        cfg2, opt2, params2, st2 = self._state()
+        mgr = ResidencyManager(host_blocks=64)
+        sstep = make_train_step(cfg2, optimizer=opt2, accum_steps=2,
+                                offload_opt_example=st2, residency=mgr)
+        l2, p2, s2 = sstep(params2, st2, tokens)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(p1["layers"]["wqkv"])),
+            np.asarray(jax.device_get(p2["layers"]["wqkv"])),
+            atol=1e-6)
+        # the manager really moved the state and measured the pull
+        assert mgr.swap_ins == 1 and mgr.swap_outs == 1
+        assert mgr.prefetch_bytes > 0
+        assert 0.0 <= (mgr.prefetch_overlap_frac or 0.0) <= 1.0
+        # the pushed-back state feeds the next step (the loop contract)
+        l3, _, _ = sstep(p2, s2, tokens)
+        assert np.isfinite(float(l3))
+        mgr.drain()
+
+    def test_streamed_step_requires_offload_example(self):
+        from hpc_patterns_tpu.models import TransformerConfig
+        from hpc_patterns_tpu.models.train import make_train_step
+
+        with pytest.raises(ValueError, match="offload_opt_example"):
+            make_train_step(TransformerConfig(**TINY),
+                            residency=ResidencyManager(host_blocks=4))

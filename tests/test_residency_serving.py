@@ -1,0 +1,395 @@
+"""Tiered-memory serving (EngineCore + memory/residency.py): the
+byte-exact capacity oracle.
+
+THE claim of round 11, in the repo's standard form: an engine whose
+HBM page pool is too small to hold its streams' KV — fronting a
+host-resident pool through the residency manager, with cold rows
+paged out at chunk boundaries and swapped rows prefetched back under
+the decode chunk — emits TOKEN-IDENTICAL streams to an all-HBM engine
+(greedy AND sampled), with preemption-and-resume and cross-engine
+migration composing on top (an exported bundle gathers pages from
+whichever tier holds them). Everything else (demand rules, windows,
+the slow_host_transfer chaos site, reservation bookkeeping) is pinned
+around that.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from hpc_patterns_tpu.harness import chaos as chaoslib
+from hpc_patterns_tpu.harness import metrics as metricslib
+from hpc_patterns_tpu.harness import trace as tracelib
+from hpc_patterns_tpu.harness import slo as slolib
+from hpc_patterns_tpu.memory import (
+    ColdAfterNPolicy,
+    LRUPolicy,
+    PriorityAwarePolicy,
+    ResidencyManager,
+)
+from hpc_patterns_tpu.models import TransformerConfig, init_params
+from hpc_patterns_tpu.models.serving import ContinuousBatcher, EngineCore
+
+CFG = TransformerConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                        d_ff=64, max_seq=128, dtype="float32",
+                        decode_attn="gather")
+PAGE = 8
+PROMPT_LEN, BUDGET = 8, 24
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def reqs():
+    rng = np.random.RandomState(3)
+    return [(rng.randint(0, CFG.vocab, size=PROMPT_LEN)
+             .astype(np.int32), BUDGET) for _ in range(5)]
+
+
+PPS = ContinuousBatcher.pages_needed(PROMPT_LEN, BUDGET, PAGE)
+
+
+def _engine(params, pool_rows, mgr=None, slots=5, **kw):
+    return ContinuousBatcher(
+        params, CFG, slots=slots, pool_pages=pool_rows * PPS,
+        pages_per_seq=PPS, page_size=PAGE, chunk=4, residency=mgr,
+        **kw)
+
+
+@pytest.fixture(scope="module")
+def base(params, reqs):
+    """The all-HBM oracle outputs, greedy: {req index: tokens}."""
+    eng = _engine(params, 5)
+    ids = [eng.submit(p, b) for p, b in reqs]
+    got = eng.run()
+    return {i: got[s] for i, s in enumerate(ids)}
+
+
+class TestTieredOracle:
+    def test_greedy_token_identical_under_rotation(self, params, reqs,
+                                                   base):
+        # 2-row HBM pool under a 5-row working set, deterministic
+        # cold-after-N rotation: real paging, byte-exact output
+        mgr = ResidencyManager(host_blocks=5 * PPS,
+                               policy=ColdAfterNPolicy(2))
+        eng = _engine(params, 2, mgr)
+        ids = [eng.submit(p, b) for p, b in reqs]
+        got = eng.run()
+        for i, s in enumerate(ids):
+            np.testing.assert_array_equal(base[i], got[s],
+                                          err_msg=f"seq {i}")
+        assert mgr.swap_outs > 0 and mgr.swap_ins > 0
+        assert not eng._swapped and not eng._prefetching
+
+    def test_lru_same_class_completes_without_thrash(self, params,
+                                                     reqs, base):
+        # pool holds ONE row; same-class arrivals wait for completions
+        # (the no-manager behavior) instead of evict/pull-back cycling
+        mgr = ResidencyManager(host_blocks=8 * PPS, policy=LRUPolicy())
+        eng = _engine(params, 1, mgr, slots=4)
+        ids = [eng.submit(p, b) for p, b in reqs[:4]]
+        got = eng.run()
+        for i, s in enumerate(ids):
+            np.testing.assert_array_equal(base[i], got[s],
+                                          err_msg=f"seq {i}")
+        assert mgr.swap_outs == 0  # nothing demanded paging
+
+    def test_sampled_token_identical(self, params, reqs):
+        kw = dict(temperature=0.8, top_k=8, seed=5)
+        full = _engine(params, 5, **kw)
+        fids = [full.submit(p, b) for p, b in reqs]
+        want = full.run()
+        mgr = ResidencyManager(host_blocks=8 * PPS,
+                               policy=ColdAfterNPolicy(2))
+        eng = _engine(params, 2, mgr, **kw)
+        ids = [eng.submit(p, b) for p, b in reqs]
+        got = eng.run()
+        for i, s in enumerate(ids):
+            np.testing.assert_array_equal(want[fids[i]], got[s],
+                                          err_msg=f"sampled seq {i}")
+        # the sampled key state crossed the tier boundary and back
+        assert mgr.swap_outs > 0
+
+    def test_urgent_arrival_pages_out_background(self, params, reqs,
+                                                 base):
+        # soft preemption: a priority-0 arrival displaces a priority-1
+        # resident via the HOST tier — no re-prefill, tokens preserved
+        # (preempt stays OFF: the manager alone must serve the urgent
+        # class; with preempt=True the hard path may fire first at a
+        # run boundary, which the host-tier-full test covers)
+        mgr = ResidencyManager(host_blocks=8 * PPS,
+                               policy=PriorityAwarePolicy())
+        eng = _engine(params, 2, mgr, slots=3)
+        sids = [eng.submit(p, b, priority=1) for p, b in reqs[:3]]
+        eng.run(max_rounds=3)
+        hi = eng.submit(reqs[3][0], reqs[3][1], priority=0)
+        got = eng.run()
+        for i, s in enumerate(sids):
+            np.testing.assert_array_equal(base[i], got[s],
+                                          err_msg=f"seq {i}")
+        np.testing.assert_array_equal(base[3], got[hi])
+        assert mgr.swap_outs > 0
+        preempts = sum(st["preemptions"] for st in eng.stats.values())
+        assert preempts == 0  # paging, not re-prefill, served class 0
+
+    def test_hard_preemption_composes_when_host_tier_full(
+            self, params, reqs, base):
+        # host pool smaller than one row: the manager cannot help, so
+        # the round-8 preemption machinery fires — and the resumed
+        # victim is still byte-exact
+        mgr = ResidencyManager(host_blocks=2, policy=LRUPolicy())
+        eng = _engine(params, 2, mgr, slots=3, preempt=True)
+        sids = [eng.submit(p, b, priority=1) for p, b in reqs[:2]]
+        eng.run(max_rounds=3)
+        hi = eng.submit(reqs[3][0], reqs[3][1], priority=0)
+        got = eng.run()
+        for i, s in enumerate(sids):
+            np.testing.assert_array_equal(base[i], got[s],
+                                          err_msg=f"seq {i}")
+        np.testing.assert_array_equal(base[3], got[hi])
+        assert sum(st["preemptions"]
+                   for st in eng.stats.values()) >= 1
+        assert mgr.swap_outs == 0
+
+    def test_migration_bundles_gather_across_tiers(self, params, reqs,
+                                                   base):
+        # one row exported from the HOST tier (swapped out), one from
+        # HBM — both install on an all-HBM engine and finish exactly
+        mgr = ResidencyManager(host_blocks=8 * PPS,
+                               policy=ColdAfterNPolicy(1))
+        src = EngineCore(params, CFG, slots=3, pool_pages=2 * PPS,
+                         pages_per_seq=PPS, page_size=PAGE, chunk=4,
+                         residency=mgr)
+        for i in range(3):
+            src.submit(reqs[i][0], reqs[i][1], seq_id=i)
+        for _ in range(20):
+            src.service_round()
+            if src._swapped and any(s.active for s in src._slots):
+                break
+        assert src._swapped and any(s.active for s in src._slots)
+        host_sid = next(iter(src._swapped))
+        b_host = src.export_swapped(host_sid)
+        res_slot = next(i for i, s in enumerate(src._slots)
+                        if s.active)
+        hbm_sid = src._slots[res_slot].seq_id
+        b_hbm = src.export_migration(res_slot)
+        assert src.stats[host_sid]["outcome"] == "migrated"
+        dst = EngineCore(params, CFG, slots=4, pool_pages=4 * PPS,
+                         pages_per_seq=PPS, page_size=PAGE, chunk=4)
+        dst.install_migration(b_host)
+        dst.install_migration(b_hbm)
+        while dst.has_work():
+            dst.service_round()
+        np.testing.assert_array_equal(base[host_sid],
+                                      dst.finished[host_sid])
+        np.testing.assert_array_equal(base[hbm_sid],
+                                      dst.finished[hbm_sid])
+
+    def test_export_swapped_rejects_unknown_and_resident(self, params,
+                                                         reqs):
+        mgr = ResidencyManager(host_blocks=8 * PPS)
+        eng = _engine(params, 2, mgr)
+        sid = eng.submit(reqs[0][0], reqs[0][1])
+        with pytest.raises(ValueError, match="not swapped out"):
+            eng.export_swapped(sid)
+        plain = _engine(params, 2)
+        with pytest.raises(ValueError, match="not swapped out"):
+            plain.export_swapped(0)
+
+
+class TestResidencyScheduling:
+    def test_draft_engines_refuse_residency(self, params):
+        from hpc_patterns_tpu.models.transformer import init_params as ip
+
+        dcfg = TransformerConfig(vocab=64, d_model=16, n_heads=2,
+                                 n_layers=1, d_ff=32, max_seq=128,
+                                 dtype="float32", decode_attn="gather")
+        with pytest.raises(ValueError, match="do not page"):
+            ContinuousBatcher(
+                params, CFG, slots=2, pool_pages=2 * PPS,
+                pages_per_seq=PPS, page_size=PAGE,
+                draft_params=ip(jax.random.PRNGKey(1), dcfg),
+                draft_cfg=dcfg,
+                residency=ResidencyManager(host_blocks=4))
+
+    def test_duplicate_seq_id_rejected_while_swapped(self, params,
+                                                     reqs):
+        mgr = ResidencyManager(host_blocks=8 * PPS,
+                               policy=ColdAfterNPolicy(1))
+        eng = EngineCore(params, CFG, slots=3, pool_pages=2 * PPS,
+                         pages_per_seq=PPS, page_size=PAGE, chunk=4,
+                         residency=mgr)
+        for i in range(3):
+            eng.submit(reqs[i][0], reqs[i][1], seq_id=i)
+        for _ in range(20):
+            eng.service_round()
+            if eng._swapped:
+                break
+        assert eng._swapped
+        sid = next(iter(eng._swapped))
+        with pytest.raises(ValueError, match="already queued"):
+            eng.submit(reqs[0][0], reqs[0][1], seq_id=sid)
+
+    def test_windows_gauges_and_overlap_measured(self, params, reqs,
+                                                 base):
+        # the observability contract lands WITH the subsystem: the
+        # flight recorder shows mem.prefetch/mem.evict device windows,
+        # the registry carries the mem.* gauges, and the manager's
+        # overlap fraction is a real measurement in [0, 1]
+        rec = tracelib.configure(enabled=True)
+        metricslib.configure(enabled=True, mirror_traces=False)
+        try:
+            mgr = ResidencyManager(host_blocks=5 * PPS,
+                                   policy=ColdAfterNPolicy(2))
+            eng = _engine(params, 2, mgr)
+            ids = [eng.submit(p, b) for p, b in reqs]
+            got = eng.run()
+            for i, s in enumerate(ids):
+                np.testing.assert_array_equal(base[i], got[s])
+            wins = [ev for ev in rec.events
+                    if ev[0] == "X" and ev[1] == "device"]
+            names = {ev[2] for ev in wins}
+            assert "mem.prefetch" in names and "mem.evict" in names
+            assert "serve.chunk" in names
+            # prefetch windows carry the payload size
+            pf = [ev for ev in wins if ev[2] == "mem.prefetch"]
+            assert all(ev[6]["bytes"] > 0 for ev in pf)
+            reg = metricslib.get_metrics()
+            assert reg.gauge("mem.prefetch_bytes").last > 0
+            assert reg.gauge("mem.hbm_pages").n > 0
+            frac = mgr.prefetch_overlap_frac
+            assert frac is not None and 0.0 <= frac <= 1.0
+        finally:
+            tracelib.configure(enabled=False)
+            metricslib.configure(enabled=False)
+
+    def test_slow_host_transfer_widens_prefetch_window_and_goodput_gates(
+            self, params, reqs, base):
+        # the chaos satellite: a seeded slow_host_transfer delay must
+        # (1) actually fire at the host_transfer site, (2) show up as
+        # a WIDENED mem.prefetch window — the delay sits inside the
+        # window it claims to — and (3) leave the SLO rollup usable
+        # (goodput still computed, never above raw tok/s)
+        delay_s = 0.08
+        targets = slolib.targets_from_classes([
+            type("C", (), {"priority": 0, "ttft_slo_s": 30.0,
+                           "tpot_slo_s": 5.0})()])
+        rec = tracelib.configure(enabled=True)
+        chaoslib.configure(f"slow_host_transfer:delay_ms="
+                           f"{int(delay_s * 1e3)}")
+        try:
+            mgr = ResidencyManager(host_blocks=5 * PPS,
+                                   policy=ColdAfterNPolicy(2))
+            eng = _engine(params, 2, mgr, slo=targets)
+            ids = [eng.submit(p, b) for p, b in reqs]
+            got = eng.run()
+            for i, s in enumerate(ids):
+                np.testing.assert_array_equal(base[i], got[s])
+            fired = [e for e in chaoslib.injections()
+                     if e["site"] == "host_transfer"]
+            assert fired and all(e["kind"] == "slow_host_transfer"
+                                 for e in fired)
+            pf = [ev for ev in rec.events
+                  if ev[0] == "X" and ev[1] == "device"
+                  and ev[2] == "mem.prefetch"]
+            assert pf and max(ev[5] for ev in pf) >= delay_s
+            tot = eng.last_slo["total"]
+            assert 0.0 <= tot["goodput_tok_s"] <= tot["tok_s"] + 1e-9
+        finally:
+            chaoslib.reset()
+            tracelib.configure(enabled=False)
+            metricslib.configure(enabled=False)
+
+    def test_balance_sizes_eviction_to_the_highwater_constraint(
+            self, params, reqs, base):
+        # a fresh urgent head blocked by admit_highwater (not by raw
+        # pages) must still trigger paging sized to the BINDING
+        # constraint — otherwise the head queues behind a cap that
+        # eviction was supposed to lift (regression pin for the
+        # round-11 review finding)
+        mgr = ResidencyManager(host_blocks=8 * PPS,
+                               policy=PriorityAwarePolicy())
+        eng = _engine(params, 4, mgr, slots=4, admit_highwater=0.5)
+        sids = [eng.submit(p, b, priority=1) for p, b in reqs[:2]]
+        eng.run(max_rounds=2)
+        hi = eng.submit(reqs[3][0], reqs[3][1], priority=0)
+        got = eng.run()
+        for i, s in enumerate(sids):
+            np.testing.assert_array_equal(base[i], got[s],
+                                          err_msg=f"seq {i}")
+        np.testing.assert_array_equal(base[3], got[hi])
+        # the cap (0.5 * 4 rows = 2 resident rows) blocked the head on
+        # highwater while raw pages were plentiful: only the
+        # highwater-aware shortfall evicts here
+        assert mgr.swap_outs > 0
+
+    def test_slot_bound_urgent_head_pages_out_a_resident(
+            self, params, reqs, base):
+        # the SLOT is the binding constraint (pages ample): the
+        # balance pass must still page a less-urgent resident out —
+        # one victim frees a whole slot — or the urgent head waits
+        # behind pages it cannot use (regression pin)
+        mgr = ResidencyManager(host_blocks=8 * PPS,
+                               policy=PriorityAwarePolicy())
+        eng = _engine(params, 4, mgr, slots=2)
+        sids = [eng.submit(p, b, priority=1) for p, b in reqs[:2]]
+        eng.run(max_rounds=2)
+        hi = eng.submit(reqs[3][0], reqs[3][1], priority=0)
+        got = eng.run()
+        for i, s in enumerate(sids):
+            np.testing.assert_array_equal(base[i], got[s],
+                                          err_msg=f"seq {i}")
+        np.testing.assert_array_equal(base[3], got[hi])
+        assert mgr.swap_outs > 0
+
+    def test_prefetch_reservation_blocks_admission_theft(self, params,
+                                                         reqs):
+        # a staged pull's pages/slot are spoken for: _admissible must
+        # refuse to hand them to a fresh admission mid-flight
+        mgr = ResidencyManager(host_blocks=8 * PPS)
+        eng = _engine(params, 2, mgr, slots=2)
+        eng.submit(reqs[0][0], reqs[0][1], seq_id=0)
+        for _ in range(3):
+            eng.service_round()
+        # one row active: a second same-size request would admit
+        assert eng._admissible(PPS, fresh=True)
+        # fabricate a staged pull occupying PPS pages + one slot
+        eng._prefetching.append(
+            (type("B", (), {"n_pages": PPS, "seq_id": 99})(), None,
+             (0.0, 0, 0, 0.0, {})))
+        try:
+            assert eng._reserved_prefetch_pages() == PPS
+            assert not eng._admissible(PPS, fresh=True)
+            assert not eng.migration_admissible(PPS)
+        finally:
+            eng._prefetching.clear()
+
+    def test_highwater_counts_reserved_prefetch_pages_as_used(
+            self, params, reqs):
+        # a staged pull WILL occupy its reserved pages at install: the
+        # fresh-admission high-water math must count them as used, or
+        # an admission squeaking under the mark breaches the headroom
+        # once the swap-in seats (regression pin)
+        mgr = ResidencyManager(host_blocks=8 * PPS)
+        eng = _engine(params, 3, mgr, slots=3,
+                      admit_highwater=2 * PPS / (3 * PPS))
+        eng.submit(reqs[0][0], reqs[0][1], seq_id=0)
+        for _ in range(2):
+            eng.service_round()
+        # one row resident; without a reservation a same-size fresh
+        # request fits under the 2-row mark
+        assert eng._admissible(PPS, fresh=True)
+        eng._prefetching.append(
+            (type("B", (), {"n_pages": PPS, "seq_id": 99})(), None,
+             (0.0, 0, 0, 0.0, {})))
+        try:
+            # raw pages and slots still suffice — only the high-water
+            # accounting of the reserved pages can refuse this
+            assert not eng._admissible(PPS, fresh=True)
+            assert eng._admissible(PPS, fresh=False)
+        finally:
+            eng._prefetching.clear()
