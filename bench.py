@@ -213,6 +213,37 @@ def _offload_detail() -> dict:
     }
 
 
+def _shared_prefix_detail() -> dict:
+    """Prefix-sharing headline keys (round 12), captured in the same
+    measurement child as the overlap headline:
+
+    - ``shared_goodput_tok_s``: SLO-attained tok/s of a shared-prefix
+      open-loop stream (template pool + conversation-tree turns)
+      through the sharing-aware arena (``prefix_cache=True`` — radix
+      match at admission, refcounted read-only page mapping, tail-only
+      prefill), token-identical to a private-pages engine before the
+      number exists;
+    - ``prefill_skip_frac``: the fraction of submitted prompt tokens
+      whose prefill the radix match skipped (asserted > 0.3 on the
+      template mix inside the run).
+
+    Runs ``bench_serving.run_shared``'s smoke shape. Returns {} on
+    failure — the gate's coverage-loss warning is the tripwire."""
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    import bench_serving
+
+    r = bench_serving.run_shared(**bench_serving.shared_smoke_config(),
+                                 quiet=True)
+    return {
+        "shared_goodput_tok_s": round(r["shared_goodput_tok_s"], 1),
+        "prefill_skip_frac": round(r["prefill_skip_frac"], 4),
+        "prefix_hits": r["prefix_hits"],
+    }
+
+
 def _unavailable_line(err: BaseException) -> str:
     """Degenerate-capture verdict line for a backend that won't even
     initialize (value 0.0, never a pass, the error preserved)."""
@@ -539,6 +570,16 @@ def main() -> int:
         offload_detail = {"offload_error":
                           f"{type(err).__name__}: {err}"}
 
+    # the prefix-sharing row (round 12): sharing-arena goodput on a
+    # template/conversation-tree stream + the measured prefill-skip
+    # fraction (bench_serving.run_shared smoke — token-identical to
+    # private pages before either number exists)
+    try:
+        shared_detail = _shared_prefix_detail()
+    except Exception as err:  # noqa: BLE001 — never sink the headline
+        shared_detail = {"shared_prefix_error":
+                         f"{type(err).__name__}: {err}"}
+
     # any clamped-to-zero component means the run measured nothing usable
     degenerate = min(t_overlap, t_serial, t_dma, t_comp) <= 0
     if degenerate:
@@ -572,6 +613,7 @@ def main() -> int:
                     **fused_detail,
                     **plane_detail,
                     **offload_detail,
+                    **shared_detail,
                     # the five raw (serial, overlap) pairs, measurement
                     # order — the distribution behind the median
                     "pairs_us": [

@@ -3,7 +3,19 @@
 Usage: python benchmarks/bench_serving.py [--n=N] [--slots=S] [--chunk=K]
          [--mix=0|1] [--buckets=auto|none|16,32,...] [--overlap=0|1]
          [--temp=T] [--topk=K] [--smoke] [--scenario] [--plane]
-         [--offload]
+         [--offload] [--shared]
+
+``--shared``: the PREFIX-SHARING row (round 12) — one shared-prefix
+open-loop stream (template pool + conversation-tree turns,
+``harness/loadgen.make_shared_prefix_schedule``) through a
+private-pages engine and the sharing-aware arena
+(``prefix_cache=True``: radix match at admission, matched pages
+mapped read-only + refcounted, tail-only prefill). Token-identical
+to private pages (oracle before any number), ``prefill_skip_frac``
+asserted > 0.3 on the template mix, and the headline keys
+``shared_goodput_tok_s`` / ``prefill_skip_frac`` are captured into
+``bench.py``'s detail and gated by ``harness/regress.py``
+(docs/prefix_cache.md).
 
 ``--offload``: the TIERED-MEMORY row (round 11) — the same stream
 through an all-HBM engine and an engine whose HBM pool is capped well
@@ -72,6 +84,7 @@ sub-batch's whole scan in one dispatch — the comparison is honest
 serving reality for both.
 """
 
+import dataclasses
 import os
 import sys
 import time
@@ -677,6 +690,168 @@ def run_offload(*, cfg, params, n, slots, chunk, page_size, prompt_len,
     return result
 
 
+def shared_smoke_config():
+    """The CI prefix-sharing shape (tier-1 via
+    tests/test_bench_serving.py): the smoke model on a template-pool +
+    conversation-tree stream (2 templates × per-request tails, a
+    quarter of arrivals extending an earlier prompt), small enough for
+    seconds on the CPU mesh, shared enough that the matched span is
+    well past the 0.3 skip-fraction floor the row asserts."""
+    base = smoke_config()
+    return dict(cfg=base["cfg"], params=base["params"], n=16, slots=4,
+                chunk=8, page_size=16, n_templates=2, template_len=32,
+                tail_lens=(4, 8, 12), budgets=(16, 32),
+                tree_frac=0.25, rate_rps=200.0, seed=12)
+
+
+def shared_full_config(on_tpu: bool):
+    """The re-grounding shape (reground_r5.sh step 4e): the scenario
+    model on a heavier template mix — on chip the first real-HBM
+    number for the dedup'd arena. The decode route is pinned to
+    "gather": prefix sharing mirrors the einsum prefill path, and the
+    engine refuses flash configs whose page-multiple rungs would send
+    monolithic prefills through the Pallas kernel instead (the
+    constructor guard) — decode_attn is a dispatch knob, so the
+    scenario params are reused as-is."""
+    base = scenario_full_config(on_tpu)
+    cfg = dataclasses.replace(base["cfg"], decode_attn="gather")
+    return dict(cfg=cfg, params=base["params"],
+                n=48 if on_tpu else 24, slots=8 if on_tpu else 4,
+                chunk=16, page_size=256 if on_tpu else 16,
+                n_templates=3, template_len=512 if on_tpu else 32,
+                tail_lens=(16, 32, 64) if on_tpu else (4, 8, 12),
+                budgets=(64, 128) if on_tpu else (16, 32),
+                tree_frac=0.25, rate_rps=64.0, seed=12)
+
+
+def run_shared(*, cfg, params, n, slots, chunk, page_size, n_templates,
+               template_len, tail_lens, budgets, tree_frac, rate_rps,
+               seed=12, quiet=False):
+    """The prefix-sharing row (round 12): ONE shared-prefix open-loop
+    stream (harness/loadgen.make_shared_prefix_schedule — template
+    pool + conversation-tree turns) served by (a) a PRIVATE-pages
+    engine (every request prefills its full prompt) and (b) the
+    SHARING-AWARE arena (``prefix_cache=True``: radix match at
+    admission, matched pages mapped read-only, tail-only prefill).
+    The ORACLE runs before any number: both engines token-identical
+    to standalone ``paged_generate`` per request — sharing must be
+    invisible in the tokens. Reports ``shared_goodput_tok_s``
+    (SLO-attained tok/s of the sharing engine) and
+    ``prefill_skip_frac`` (fraction of submitted prompt tokens whose
+    prefill the radix match skipped — asserted > 0.3 on the template
+    mix), the two keys ``bench.py`` captures and ``harness/regress.py``
+    gates (docs/prefix_cache.md)."""
+    schedule = loadgen.make_shared_prefix_schedule(
+        n, rate_rps=rate_rps, classes=SCENARIO_CLASSES,
+        n_templates=n_templates, template_len=template_len,
+        tail_lens=tail_lens, budgets=budgets, tree_frac=tree_frac,
+        seed=seed)
+    out = print if not quiet else (lambda *a, **k: None)
+    prompts = {r.index: loadgen.materialize_prompt(schedule, r.index,
+                                                   cfg.vocab)
+               for r in schedule.requests}
+    targets = slo.targets_from_classes(SCENARIO_CLASSES)
+    # an ALIGNED ladder (multiples of the page size, which the sharing
+    # engine requires aligned to decode.PREFIX_ALIGN) fit to the
+    # stream: sharing is rung-keyed, so rungs double as sharing scopes
+    lengths = [p.size for p in prompts.values()]
+    buckets = tuple(sorted({-(-int(L) // page_size) * page_size
+                            for L in lengths}))
+    pages_per_seq = max(
+        ContinuousBatcher.pages_needed(
+            len(prompts[r.index]), r.max_new, page_size,
+            padded_len=pad_to_bucket(buckets, len(prompts[r.index])))
+        for r in schedule.requests)
+    pool_pages = slots * pages_per_seq
+    total_tokens = sum(r.max_new for r in schedule.requests)
+    arrivals = [
+        (r.t_arrival_s, dict(prompt=prompts[r.index],
+                             max_new=r.max_new, seq_id=r.index,
+                             priority=r.priority,
+                             deadline_s=r.deadline_s))
+        for r in schedule.requests
+    ]
+
+    def run_one(share: bool):
+        eng = ContinuousBatcher(
+            params, cfg, slots=slots, pool_pages=pool_pages,
+            pages_per_seq=pages_per_seq, page_size=page_size,
+            chunk=chunk, prompt_buckets=buckets, slo=targets,
+            prefix_cache=share)
+        got = eng.run(arrivals=list(arrivals))
+        return got, eng
+
+    # warmup + best-of-reps: open-loop pacing means admission grouping
+    # (and with it the (matched, rung) tail-prefill jit variants) can
+    # differ run to run, so one warmup cannot guarantee the timed leg
+    # compiles nothing — min-of-reps (the harness timing discipline)
+    # keeps a stray in-leg XLA compile out of the GATED goodput number;
+    # the (t, outputs, engine) triple stays from the same rep so the
+    # SLO math is consistent with the wall time it divides by
+    def best_of(share: bool, reps: int = 2):
+        best = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            got, eng = run_one(share)
+            dt = time.perf_counter() - t0
+            if best is None or dt < best[0]:
+                best = (dt, got, eng)
+        return best
+
+    run_one(False)
+    run_one(True)
+    t_priv, priv_out, priv_eng = best_of(False)
+    t_shr, shr_out, shr_eng = best_of(True)
+
+    # oracle before any number is believed: sharing is invisible in
+    # the tokens — both engines equal standalone paged decode
+    for r in schedule.requests:
+        want = np.asarray(paged_generate(
+            params, jnp.asarray(prompts[r.index])[None], cfg,
+            r.max_new, page_size=page_size))[0]
+        np.testing.assert_array_equal(priv_out[r.index], want,
+                                      err_msg=f"private seq {r.index}")
+        np.testing.assert_array_equal(shr_out[r.index], want,
+                                      err_msg=f"shared seq {r.index}")
+    skip = shr_eng.prefill_skip_frac
+    assert skip > 0.3, (
+        f"prefill_skip_frac {skip:.3f} <= 0.3 on the template mix — "
+        "the radix match is not finding the shared prefixes")
+    assert shr_eng._prefix.hits > 0, "no prefix-cache hit fired"
+
+    tot_priv = priv_eng.last_slo["total"]
+    tot_shr = shr_eng.last_slo["total"]
+    result = {
+        "t_private": t_priv, "t_shared": t_shr, "tokens": total_tokens,
+        "tokens_per_s_private": total_tokens / t_priv,
+        "tokens_per_s_shared": total_tokens / t_shr,
+        "private_goodput_tok_s": tot_priv["goodput_tok_s"]
+        * priv_eng._serve_s / t_priv if t_priv > 0 else 0.0,
+        "shared_goodput_tok_s": tot_shr["goodput_tok_s"]
+        * shr_eng._serve_s / t_shr if t_shr > 0 else 0.0,
+        "prefill_skip_frac": skip,
+        "prefix_hits": shr_eng._prefix.hits,
+        "prefix_misses": shr_eng._prefix.misses,
+        "ladder": buckets, "pool_pages": pool_pages,
+        "bubble_frac": shr_eng.last_bubble_frac,
+        "schedule": schedule.spec,
+    }
+    out(f"shared-prefix: n={n} slots={slots} chunk={chunk} "
+        f"templates={n_templates}x{template_len} tree={tree_frac:.0%} "
+        f"pool={pool_pages}p tokens={total_tokens}")
+    out(f"  private : {t_priv:.3f}s  "
+        f"{result['tokens_per_s_private']:,.1f} tok/s  "
+        f"goodput {result['private_goodput_tok_s']:,.1f}")
+    out(f"  shared  : {t_shr:.3f}s  "
+        f"{result['tokens_per_s_shared']:,.1f} tok/s  "
+        f"goodput {result['shared_goodput_tok_s']:,.1f}  "
+        f"skip {skip:.1%}  hits {shr_eng._prefix.hits}/"
+        f"{shr_eng._prefix.hits + shr_eng._prefix.misses}")
+    out(f"  prefill skipped {skip:.1%} of prompt tokens "
+        "(token-identical to private pages, oracle-exact)")
+    return result
+
+
 def plane_smoke_config():
     """The CI plane shape (tier-1 via tests/test_bench_serving.py): a
     seeded open-loop two-class stream through (a) one engine, (b) a
@@ -874,6 +1049,13 @@ def run_plane(*, cfg, params, n, slots, chunk, page_size, prompt_len,
 
 
 def main():
+    if arg("shared", False, bool):
+        if arg("smoke", False, bool):
+            run_shared(**shared_smoke_config())
+        else:
+            run_shared(**shared_full_config(
+                jax.default_backend() == "tpu"))
+        return
     if arg("offload", False, bool):
         if arg("smoke", False, bool):
             run_offload(**offload_smoke_config())

@@ -100,6 +100,22 @@ run "serving plane 2-replica + 1p/1d" python benchmarks/bench_serving.py --plane
 #     measurement of the tier (the CPU smoke's host tier is a copy).
 run "serving tiered HBM/host offload" python benchmarks/bench_serving.py --offload
 
+# 4e. PREFIX-SHARING row (round 12): one shared-prefix open-loop
+#     stream (template pool + conversation-tree turns) through a
+#     private-pages engine and the sharing-aware arena
+#     (prefix_cache=True — radix match at admission, refcounted
+#     read-only page mapping, tail-only prefill). Token-identical to
+#     private pages (oracle before any number, greedy; the sampled
+#     oracle is tier-1), prefill_skip_frac asserted > 0.3 on the mix;
+#     headline keys shared_goodput_tok_s / prefill_skip_frac are
+#     captured by bench.py and gated by harness/regress.py. On chip
+#     this is the first real-HBM capacity number for the dedup'd
+#     arena AND the bitwise-parity check of the tail prefill on the
+#     TPU toolchain (docs/prefix_cache.md — the parity contract is
+#     backend-empirical; the in-run oracle fails loudly if the chip
+#     compiler breaks it).
+run "serving shared-prefix arena" python benchmarks/bench_serving.py --shared
+
 # 5. aligned speculative pair + gamma sweep + batched impls (item 4, 7)
 run "make draft pair" python benchmarks/make_draft_pair.py --out=benchmarks/pair_r5
 run "speculative aligned sweep" python benchmarks/bench_speculative.py --pair=benchmarks/pair_r5 --batched=8

@@ -105,6 +105,23 @@ DEFAULT_DISPATCH_CRITICAL = frozenset({
     # was hoisted
     "_detach_row",
     "_attach_row",
+    # the round-12 prefix-sharing paths: the radix admission match,
+    # the shared-page map/incref, the tail prefill, the decref release
+    # funnel, and the cache reclaim all run inside the admission window
+    # (with or behind an in-flight decode chunk) — they are HOST trie/
+    # list work by design, and a stray device readback there (e.g.
+    # reading cursors to "check" a match) stalls exactly the prefill
+    # the cache exists to skip
+    "_prefix_match",
+    "_memo_match",
+    "_request_need",
+    "_insert_prefix",
+    "_alloc_pages",
+    "_incref_pages",
+    "_decref_pages",
+    "_reclaim_cache_pages",
+    "_row_swappable",
+    "_row_freeable_pages",
 })
 
 # rule names are kebab-case identifiers; anything after the last name

@@ -290,6 +290,10 @@ SERVING_POISON_TARGETS: dict[str, tuple[int, ...]] = {
     # pool is donated — an aliased host view of it would be the exact
     # PR 2 bug class resurfacing on the migration path
     "_install_pages": (0,),
+    # the prefix-sharing tail prefill (round 12): donates the pool like
+    # _prefill_one — an aliased view of a SHARED page would corrupt
+    # every reader at once, so the poison harness must cover it
+    "_tail_prefill_one": (3,),
 }
 
 

@@ -7,7 +7,11 @@ module used to re-derive), and one manager that owns WHERE each block
 of serving KV / training optimizer state lives right now
 (``residency.py`` — per-block tier, pin state, last-touch round,
 pluggable eviction policies, and the overlapped prefetch/evict
-transfer pipeline measured through the flight recorder).
+transfer pipeline measured through the flight recorder) — plus the
+radix prefix index that lets the serving arena SHARE pages across
+requests with common prompt prefixes (``prefix_cache.py``, round 12:
+page-aligned rung-keyed nodes, longest-prefix match at admission,
+refcounted page ownership staying with the arena).
 
 Consumers:
 
@@ -25,6 +29,7 @@ Consumers:
   memory-kind probes here (one probe, one answer per process).
 """
 
+from hpc_patterns_tpu.memory.prefix_cache import RadixPrefixCache
 from hpc_patterns_tpu.memory.kinds import (
     kind_sharding,
     memory_kind_placement_works,
@@ -48,6 +53,7 @@ __all__ = [
     "EvictionPolicy",
     "LRUPolicy",
     "PriorityAwarePolicy",
+    "RadixPrefixCache",
     "ResidencyManager",
     "kind_sharding",
     "memory_kind_placement_works",

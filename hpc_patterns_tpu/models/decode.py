@@ -769,6 +769,156 @@ def paged_prefill(params, prompt, cfg: TransformerConfig, cache,
     return logits, out
 
 
+#: SIMD row-alignment quantum for bitwise prefill parity (see
+#: :func:`paged_tail_prefill`): XLA:CPU GEMMs reproduce a row's dot
+#: products bitwise across DIFFERENT total row counts only when both
+#: counts are multiples of this (measured: 3-row and 1-row tails
+#: diverged in ULPs from the monolithic prefill's remainder-loop rows;
+#: every multiple-of-8 pairing tested matched exactly). The sharing
+#: engine enforces rung/page alignment to it at construction.
+PREFIX_ALIGN = 8
+
+
+def paged_tail_prefill(params, tail, cfg: TransformerConfig, cache,
+                       page_size: int, n_prefix_pages: int, mesh=None,
+                       last_pos=None):
+    """Prefill ONLY the tail of a prompt whose first ``n_prefix_pages``
+    pages of K/V already sit in the pool (the prefix-sharing arena's
+    admission path, models/serving.py): positions ``[M, M + c)`` with
+    ``M = n_prefix_pages * page_size`` are computed and scattered into
+    the pages ``table[:, n_prefix:]``; the prefix pages are GATHERED as
+    attention context and never written. Returns ``(logits, cache)``
+    like :func:`paged_prefill`, with ``last_pos`` TAIL-RELATIVE (the
+    true last token's offset into ``tail``).
+
+    BITWISE PARITY CONTRACT (the prefix-cache oracle rides on it): the
+    written tail pages and the returned logits are bit-identical to a
+    monolithic :func:`paged_prefill` of the full ``M + c`` prompt,
+    provided (a) the prefix pages hold bytes a SAME-LENGTH monolithic
+    prefill wrote (rung-keyed sharing — prefix K/V is bitwise
+    suffix-independent under causal masking, but NOT length-independent:
+    prefill(32) and prefill(40) disagree in ULPs on shared rows), (b)
+    ``M`` and ``c`` are multiples of :data:`PREFIX_ALIGN` (SIMD-stable
+    GEMM row counts), and (c) the monolithic side took the einsum
+    attention route (``full_attention``), which this function mirrors
+    term for term — same grouped-score/grouped-pv einsums, same mask
+    constant, same softmax axis length ``M + c``.
+
+    int8 KV pools are refused: the monolithic prefill attends to the
+    EXACT K/V and quantizes only for storage, so a tail computed from
+    dequantized prefix pages could not be bit-equal."""
+    if cfg.kv_cache_dtype == "int8":
+        raise ValueError(
+            "paged_tail_prefill: int8 KV pools cannot share prefixes "
+            "bitwise — the monolithic prefill attends to exact K/V and "
+            "quantizes only for storage; a tail computed from "
+            "dequantized pages would diverge in ULPs")
+    from hpc_patterns_tpu.parallel.ring_attention import (
+        _NEG_INF,
+        _grouped_pv,
+        _grouped_scores,
+    )
+
+    B, c = tail.shape
+    if B != 1:
+        raise ValueError(
+            f"paged_tail_prefill is single-row (got B={B}): the "
+            "prefix context gathers through table[0], so rows with "
+            "different chains would all attend row 0's pages — batch "
+            "callers must map per row")
+    P = page_size  # shadows the PartitionSpec alias in this scope
+    M = n_prefix_pages * P
+    if M % PREFIX_ALIGN or c % PREFIX_ALIGN:
+        raise ValueError(
+            f"paged_tail_prefill needs prefix length {M} and tail "
+            f"length {c} aligned to {PREFIX_ALIGN} rows (bitwise GEMM "
+            "row stability); pad the rung/page geometry")
+    table = cache["table"]
+    n_tail = -(-c // P)
+    if n_prefix_pages + n_tail > table.shape[1]:
+        raise ValueError(
+            f"tail needs pages {n_prefix_pages}..{n_prefix_pages + n_tail}"
+            f"; table has {table.shape[1]}")
+    dt = jnp.dtype(cfg.dtype)
+    pidx = table[0, :n_prefix_pages]
+
+    def gather_ctx(pool):
+        # prefix pages -> (1, M, Hkv, D), the s-major "bskd" layout the
+        # grouped-score einsum consumes (pure layout moves, bit-neutral)
+        return jnp.einsum("phsd->pshd", pool[pidx]).reshape(
+            1, M, cfg.kv_heads, cfg.head_dim)
+
+    pk = jnp.stack([gather_ctx(cache["k"][l])
+                    for l in range(cfg.n_layers)])
+    pv = jnp.stack([gather_ctx(cache["v"][l])
+                    for l in range(cfg.n_layers)])
+
+    x = params["embed"].astype(dt)[tail]
+    pos = M + jnp.arange(c, dtype=jnp.int32)
+    if cfg.pos_embed == "learned":
+        x = x + params["pos_embed"].astype(dt)[pos]
+    scale = 1.0 / (cfg.head_dim ** 0.5)
+
+    def body(h, layer):
+        lp, pkl, pvl = layer
+        hn = _rmsnorm(h, lp["ln1_scale"])
+        q, k, v = project_qkv(hn, lp, cfg)
+        if cfg.pos_embed == "rope":
+            q = apply_rope(q, pos, cfg)
+            k = apply_rope(k, pos, cfg)
+        # context axis = M + c, the SAME softmax reduction length the
+        # monolithic prefill used — the mask's exact zeros are the only
+        # difference, and only at positions both sides zero out
+        k_ctx = jnp.concatenate([pkl.astype(dt), k], axis=1)
+        v_ctx = jnp.concatenate([pvl.astype(dt), v], axis=1)
+        s = _grouped_scores(q, k_ctx, scale)
+        t_idx = lax.broadcasted_iota(jnp.int32, s.shape, 2) + M
+        s_idx = lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        s = jnp.where(s_idx <= t_idx, s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhtd->bthd", _grouped_pv(p, v_ctx)).astype(
+            q.dtype)
+        o = jnp.dot(o.reshape(B, c, cfg.d_model), lp["wo"].astype(dt))
+        h = _mlp(h + o.astype(dt), lp, cfg)
+        kc = jnp.einsum("bthd->bhtd", k)
+        vc = jnp.einsum("bthd->bhtd", v)
+        return h, (kc.astype(dt), vc.astype(dt))
+
+    x, (ks, vs) = lax.scan(body, x, (params["layers"], pk, pv))
+    x = _rmsnorm(x, params["ln_f_scale"])
+    if last_pos is None:
+        x_last = x[:, -1]
+    else:
+        lp_ = jnp.broadcast_to(jnp.asarray(last_pos, jnp.int32), (B,))
+        x_last = jnp.take_along_axis(x, lp_[:, None, None], axis=1)[:, 0]
+    logits = jnp.dot(x_last, params["lm_head"].astype(dt)).astype(
+        jnp.float32)
+
+    # scatter the tail pages exactly as paged_prefill does: pad the
+    # tail K/V to the page boundary with zeros (the monolithic path's
+    # jnp.pad bytes), page-blocked through the table
+    t_pad = n_tail * P
+    idx = table[:, n_prefix_pages:n_prefix_pages + n_tail]
+    out = {"table": table}
+    for name, lin in (("k", ks), ("v", vs)):
+        pool = list(cache[name])
+        for l in range(cfg.n_layers):
+            linl = lin[l]
+            if t_pad > c:
+                linl = jnp.pad(
+                    linl, [(0, 0), (0, 0), (0, t_pad - c), (0, 0)])
+            pages = jnp.einsum(
+                "bhpsd->bphsd",
+                linl.reshape(B, cfg.kv_heads, n_tail, P, cfg.head_dim))
+            pool[l] = pool[l].at[idx].set(pages.astype(pool[l].dtype))
+        out[name] = tuple(pool)
+    if mesh is not None and _tp_size(mesh, cfg) > 1:
+        out = {k_: (v_ if k_ == "table"
+                    else _tp_pin_cache(v_, mesh, cfg))
+               for k_, v_ in out.items()}
+    return logits, out
+
+
 # tables already verified as identity layout, keyed by id() (jax arrays
 # compare elementwise, so set membership is unusable); WeakValue so a
 # collected table's id can never alias a new object

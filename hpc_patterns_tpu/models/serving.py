@@ -119,14 +119,33 @@ Tiered-memory shape (round 11) — the HBM arena as a cache:
   ``free_pages == 0`` — context length and batch become a policy
   knob (docs/memory.md).
 
+Prefix-sharing shape (round 12) — the sharing-aware arena:
+
+- ``EngineCore(prefix_cache=True)`` puts a radix prefix index
+  (:class:`hpc_patterns_tpu.memory.RadixPrefixCache`) over the paged
+  pool with REFCOUNTED page ownership: admission longest-prefix-
+  matches the prompt against every chain already resident at its
+  bucket rung, maps the matched pages read-only into the new row's
+  table, and prefills ONLY the tail — the hottest KV bytes (shared
+  system prompts, few-shot templates, conversation trees) live ONCE
+  in the arena instead of N times, and TTFT skips the matched span's
+  compute (``serve.prefill_skip_frac``). Copy-on-write is resolved AT
+  ADMISSION: the boundary page (the first the row may write) is
+  always private by construction, and interior shared pages are never
+  rewritten — decode writes start at the prompt's own tail
+  (docs/prefix_cache.md has the full COW rule and the rung-keyed
+  bitwise-parity story).
+
 Correctness contract (oracle-tested): every admitted sequence's
 emitted tokens are exactly ``paged_generate``'s for the same prompt,
 budget, and (when sampling) per-request key, regardless of what was
 scheduled around it — including sequences preempted and resumed along
 the way, sequences prefilled on one engine and decoded on another
 (the serving-plane migration oracle, tests/test_serving_plane.py),
-and sequences paged through the host tier and back
-(tests/test_residency_serving.py).
+sequences paged through the host tier and back
+(tests/test_residency_serving.py), and sequences served through
+shared prefix pages (tests/test_prefix_cache.py — greedy AND
+sampled, under preemption and migration).
 
 Reference lineage: the benchmark-IS-the-test discipline
 (aurora.mpich.miniapps/src/CMakeLists.txt:39-50) — the engine's
@@ -151,12 +170,15 @@ from hpc_patterns_tpu.harness import chaos as chaoslib
 from hpc_patterns_tpu.harness import metrics as metricslib
 from hpc_patterns_tpu.harness import slo as slolib
 from hpc_patterns_tpu.harness import trace as tracelib
+from hpc_patterns_tpu.memory.prefix_cache import RadixPrefixCache
 from hpc_patterns_tpu.models.decode import (
+    PREFIX_ALIGN,
     _pick,
     _topk_mask,
     init_paged_cache,
     paged_decode_step,
     paged_prefill,
+    paged_tail_prefill,
 )
 from hpc_patterns_tpu.models.transformer import TransformerConfig
 
@@ -336,6 +358,17 @@ class MigrationBundle:
     page_size: int
     pages_payload: dict
     seq: int = -1            # plane-assigned migration sequence number
+    #: the admission rung (bucket-padded length) the row prefilled at —
+    #: the KEY a prefix-sharing destination resolves against: prefix
+    #: K/V bytes are rung-stamped (docs/prefix_cache.md), so only a
+    #: same-rung cached chain is bit-identical to this payload. 0 =
+    #: unknown (pre-round-12 bundles; destinations then materialize)
+    rung: int = 0
+    #: leading tokens whose pages hold PURE-PROMPT K/V (page-aligned,
+    #: = (prompt_len // page_size) * page_size): the span a destination
+    #: with a warm prefix cache may resolve to its own shared pages
+    #: instead of installing the payload — byte-exact either way
+    prefix_len: int = 0
 
 
 @dataclass
@@ -355,6 +388,8 @@ class _Slot:
     deadline_s: float | None = None
     temp_override: float | None = None
     prefix: list = field(default_factory=list)  # pre-preemption tokens
+    padded_len: int = 0      # the admission rung this row prefilled at
+    shared_pages: int = 0    # leading table entries mapped SHARED
 
 
 @partial(jax.jit,
@@ -480,6 +515,26 @@ def _prefill_one(params, prompt, last_pos, cache_one, *, cfg, page_size,
                          mesh=mesh, last_pos=last_pos)
 
 
+@partial(jax.jit,
+         static_argnames=("cfg", "page_size", "n_prefix_pages", "mesh"),
+         donate_argnums=(3,))
+def _tail_prefill_one(params, tail, last_rel, cache_one, *, cfg,
+                      page_size, n_prefix_pages, mesh):
+    """One-row TAIL prefill through the shared pool — the sharing-aware
+    admission's compute half (:func:`~hpc_patterns_tpu.models.decode.
+    paged_tail_prefill`): the row's first ``n_prefix_pages`` table
+    entries point at SHARED pages whose K/V a same-rung admission
+    already wrote, so only the tail positions are computed and only
+    the tail pages written. ``last_rel`` (traced) is the true last
+    token's offset into the tail. ``cache_one`` is donated like
+    :func:`_prefill_one`'s — the pool IS the capacity lever. Compiles
+    per (matched page count, padded tail length) — bounded by
+    pages_per_seq × the ladder size (see ``tail_prefill_cache_size``)."""
+    return paged_tail_prefill(params, tail, cfg, cache_one, page_size,
+                              n_prefix_pages, mesh=mesh,
+                              last_pos=last_rel)
+
+
 def prefill_cache_size() -> int:
     """Compiled admission-prefill variants in this process (the jit
     cache of :func:`_prefill_one`) — THE compile-count observable the
@@ -492,6 +547,15 @@ def prefill_cache_size() -> int:
     the ladder-bound assertions gate on this number and a silently
     missing probe would read as the passing value 0."""
     return tracelib.jit_cache_size(_prefill_one, strict=True)
+
+
+def tail_prefill_cache_size() -> int:
+    """Compiled TAIL-prefill variants (:func:`_tail_prefill_one`) in
+    this process — the sharing engine's compile-count observable: one
+    entry per distinct (matched page count, padded tail length,
+    config), bounded by pages_per_seq × ladder size. Strict for the
+    same reason as :func:`prefill_cache_size`."""
+    return tracelib.jit_cache_size(_tail_prefill_one, strict=True)
 
 
 @partial(jax.jit, static_argnames=("eos_id", "greedy", "top_k"),
@@ -606,6 +670,23 @@ class EngineCore:
     constrained engine stays token-identical to an all-HBM one
     (docs/memory.md; draft-assisted engines refuse it — the draft
     cache's row state would have to tier too).
+
+    ``prefix_cache``: the SHARING-AWARE arena (round 12,
+    docs/prefix_cache.md) — a radix prefix index over admitted
+    prompts plus refcounted page ownership. Admission longest-prefix-
+    matches the prompt at its bucket rung, maps the matched pages
+    READ-ONLY into the row's table, and prefills ONLY the tail
+    (:func:`_tail_prefill_one`); every release path decrefs instead
+    of freeing. Token-identical to a private-pages engine, greedy AND
+    sampled — the match is RUNG-KEYED because prefix K/V bytes depend
+    on the prefill's row count, and the tail prefill mirrors the
+    monolithic einsum prefill bit for bit (the parity contract in
+    :func:`~hpc_patterns_tpu.models.decode.paged_tail_prefill`).
+    Requires an aligned bucket ladder; refuses int8 KV and draft
+    engines. Composes with preemption/shed (decref, re-match on
+    resume), migration (bundles carry prefix refs a warm destination
+    resolves — or it materializes), and residency (shared pages are
+    pinned while a second reader is resident).
     """
 
     def __init__(self, params, cfg: TransformerConfig, *, slots: int,
@@ -618,7 +699,7 @@ class EngineCore:
                  seed: int = 0, preempt: bool = False,
                  admit_highwater: float = 1.0,
                  slo: dict[int, slolib.SLOTarget] | None = None,
-                 residency=None):
+                 residency=None, prefix_cache: bool = False):
         if cfg.n_experts:
             # paged serving is dense-model territory so far
             raise ValueError("continuous batching: dense models only")
@@ -644,6 +725,43 @@ class EngineCore:
         if not 0.0 < admit_highwater <= 1.0:
             raise ValueError(
                 f"admit_highwater must be in (0, 1], got {admit_highwater}")
+        if prefix_cache:
+            # the sharing-aware arena's byte-exactness preconditions
+            # (docs/prefix_cache.md): rung-keyed chains need a ladder;
+            # SIMD-stable GEMM row counts need aligned rungs and pages;
+            # the tail prefill mirrors the EINSUM attention route and
+            # attends to exact (not re-quantized) prefix K/V
+            if draft_params is not None:
+                raise ValueError(
+                    "prefix sharing does not compose with draft-"
+                    "assisted serving: the draft cache's pages would "
+                    "need their own refcounted sharing tier")
+            if cfg.kv_cache_dtype == "int8":
+                raise ValueError(
+                    "prefix sharing needs exact KV pages: the "
+                    "monolithic prefill attends to unquantized K/V, so "
+                    "a tail computed from dequantized int8 pages could "
+                    "not be bit-identical to it")
+            if prompt_buckets is None:
+                raise ValueError(
+                    "prefix sharing is RUNG-KEYED (prefix K/V bytes "
+                    "depend on the prefill row count): pass "
+                    "prompt_buckets so admissions land on shared rungs")
+            if page_size % PREFIX_ALIGN or any(
+                    r % PREFIX_ALIGN for r in prompt_buckets):
+                raise ValueError(
+                    f"prefix sharing needs page_size {page_size} and "
+                    f"every rung {prompt_buckets} aligned to "
+                    f"{PREFIX_ALIGN} (bitwise GEMM row stability — "
+                    "models/decode.PREFIX_ALIGN)")
+            if cfg.decode_attn == "flash" and any(
+                    r % 128 == 0 for r in prompt_buckets):
+                raise ValueError(
+                    "prefix sharing mirrors the einsum prefill route; "
+                    "a flash-attn config with 128-multiple rungs would "
+                    "send monolithic prefills through the Pallas "
+                    "kernel instead — use off-multiple rungs or "
+                    "decode_attn='gather'")
         self.prompt_buckets = prompt_buckets
         self.overlap = bool(overlap)
         self.preempt = bool(preempt)
@@ -685,6 +803,17 @@ class EngineCore:
             )
         self.free_pages = list(range(pool_pages))
         self.pool_pages = pool_pages  # arena size (trash page excluded)
+        # the sharing-aware arena (round 12): a radix prefix index over
+        # admitted prompts plus per-page refcounts — a page is owned by
+        # every row whose table maps it AND by the cache chain that
+        # indexes it; release paths DECREF (never free) and the page
+        # returns to free_pages only at refcount 0 (docs/prefix_cache.md)
+        self._prefix = RadixPrefixCache(page_size) if prefix_cache \
+            else None
+        self._page_refs: dict[int, int] = {}
+        self._match_memo: tuple | None = None
+        self._prefill_skip_tokens = 0
+        self._prefill_total_tokens = 0
         self._table = table  # host mirror
         self.pos = jnp.zeros((slots,), jnp.int32)
         self.limit = jnp.zeros((slots,), jnp.int32)
@@ -764,6 +893,171 @@ class EngineCore:
             prompt_len, max_new, self.page_size,
             gamma=self.gamma if self.draft_params is not None else None,
             padded_len=self._bucket_len(prompt_len))
+
+    # -- the sharing-aware arena (refcounted pages + radix index) ----------
+
+    def _alloc_pages(self, n: int) -> list[int]:
+        """Take ``n`` pages from the free list at refcount 1 (host-list
+        bookkeeping only). The caller checked capacity."""
+        pages = [self.free_pages.pop() for _ in range(n)]
+        if self._prefix is not None:
+            for p in pages:
+                self._page_refs[p] = 1
+        return pages
+
+    def _incref_pages(self, pages) -> None:
+        for p in pages:
+            self._page_refs[p] += 1
+
+    def _decref_pages(self, pages) -> None:
+        """THE release path: drop one reference per page, freeing only
+        at zero — completion, preemption, shed, migration-out, swap-out
+        and cache eviction all funnel here, so a page another row (or
+        the prefix index) still maps can never be handed out twice.
+        Plain engines (no cache) keep the original free-list append."""
+        if self._prefix is None:
+            self.free_pages.extend(pages)
+            return
+        for p in pages:
+            r = self._page_refs[p] - 1
+            if r:
+                self._page_refs[p] = r
+            else:
+                del self._page_refs[p]
+                self.free_pages.append(p)
+
+    def _prefix_match(self, prompt) -> list[int]:
+        """Longest-cached-prefix page ids for ``prompt`` at ITS rung —
+        the admission-match decision (a host trie walk; no device op
+        anywhere near it). Capped at ``(T-1) // page_size`` pages so
+        the tail always keeps the last true token: the first-token
+        logits must be COMPUTED over the tail, never looked up. PURE
+        peek: no LRU touch (a queued request that never admits must
+        not keep its chain hot — an admission stamps its chain via
+        ``_insert_prefix``) and no hit/miss accounting (that moves
+        only when a match becomes an admission, ``count_match`` in
+        :meth:`_admit`)."""
+        if self._prefix is None:
+            return []
+        T = int(prompt.size)
+        return self._prefix.match(
+            prompt, self._bucket_len(T),
+            max_pages=(T - 1) // self.page_size, touch=False)
+
+    def _memo_match(self, req: Request) -> list[int]:
+        """``_prefix_match`` with a ONE-round, one-entry memo: the
+        queue head is sized up to three times per round (the
+        preemption policy, the residency balance, and the admission
+        pass) — each a full-prompt tobytes + trie walk on the
+        dispatch-critical path. The memo is keyed by request identity
+        and cleared at ``service_round`` entry; within a round the
+        head's chain cannot be invalidated between uses (every
+        reclaim in the round keeps the head's own chain, preemption
+        and swap-out decref without touching the trie, and inserts
+        only add nodes)."""
+        memo = self._match_memo
+        if memo is not None and memo[0] is req:
+            return memo[1]
+        chain = self._prefix_match(req.prompt)
+        self._match_memo = (req, chain)
+        return chain
+
+    def _request_need(self, req: Request) -> int:
+        """PRIVATE pages this request needs right now: the full sizing
+        rule minus whatever a prefix match would map shared — the
+        number admissibility, preemption, and residency demand all
+        charge (the capacity win is exactly this subtraction)."""
+        need = self._pages_for(req.prompt.size, req.max_new)
+        if self._prefix is not None:
+            need -= len(self._memo_match(req))
+        return need
+
+    def _insert_prefix(self, prompt, rung: int, pages) -> None:
+        """Publish an admission's full-prompt pages into the radix
+        index (host trie insert): pages ``[0, T // page_size)`` hold
+        pure-prompt K/V computed at ``rung``, bitwise what any
+        same-rung admission would prefill, so future prompts sharing
+        the prefix map them instead of re-prefilling. Newly indexed
+        pages take the cache's own arena reference."""
+        if self._prefix is None:
+            return
+        n_full = int(prompt.size) // self.page_size
+        if n_full:
+            self._incref_pages(
+                self._prefix.insert(prompt, rung, pages[:n_full]))
+
+    def _reclaim_cache_pages(self, need: int, fresh: bool,
+                             keep=()) -> int:
+        """Free LRU cache-only pages (refcount 1 — no row maps them)
+        until a ``need``-page request could admit: the raw free count
+        and, for fresh admissions, the high-water mark (cached pages
+        count as used until reclaimed). ``keep``: the requesting
+        prompt's OWN matched chain — evicting it would free pages only
+        to grow the same request's private need by exactly as many
+        (the ``need`` the caller computed assumed the match), a
+        self-defeating reclaim. Partial progress kept — the victims()
+        philosophy. Host bookkeeping only."""
+        if self._prefix is None:
+            return 0
+        reserved = self._reserved_prefetch_pages()
+        shortfall = need - (len(self.free_pages) - reserved)
+        if fresh:
+            used = self.pool_pages - len(self.free_pages) + reserved
+            hw_cap = self.admit_highwater * self.pool_pages
+            shortfall = max(shortfall, math.ceil(used + need - hw_cap))
+        if shortfall <= 0:
+            return 0
+        kept = set(keep)
+        freed = self._prefix.evict(
+            shortfall,
+            lambda p: p not in kept
+            and self._page_refs.get(p, 0) == 1)
+        self._decref_pages(freed)
+        return len(freed)
+
+    def _row_swappable(self, slot: int) -> bool:
+        """May the residency manager page this row out? NOT while
+        another row maps any of its pages (pin-while-shared: net of
+        the cache's own reference, refcount >= 2 means a second reader
+        would be left pointing at pages whose bytes are mid-flight).
+        Cache-only references don't block — those pages simply STAY
+        resident and shareable while the row's private pages move.
+        Runs once per active slot per round (the pin loop), so
+        membership goes through the O(1) ``has_page`` probe rather
+        than materializing the cache's page set."""
+        if self._prefix is None:
+            return True
+        for p in self._slots[slot].pages:
+            if (self._page_refs.get(p, 0)
+                    - (1 if self._prefix.has_page(p) else 0)) >= 2:
+                return False
+        return True
+
+    def _row_freeable_pages(self, slot: int) -> int:
+        """Pages an eviction of this row would ACTUALLY free (refcount
+        1) — the preemption feasibility math must not count shared
+        pages it cannot reclaim."""
+        if self._prefix is None:
+            return len(self._slots[slot].pages)
+        return sum(1 for p in self._slots[slot].pages
+                   if self._page_refs.get(p, 0) == 1)
+
+    @property
+    def prefill_skip_frac(self) -> float:
+        """Fraction of submitted prompt tokens whose prefill was
+        SKIPPED via a prefix match — the headline capacity/TTFT
+        observable (``serve.prefill_skip_frac``; measured and gated by
+        ``bench_serving --shared`` / ``harness/regress.py``)."""
+        if not self._prefill_total_tokens:
+            return 0.0
+        return self._prefill_skip_tokens / self._prefill_total_tokens
+
+    def release_prefix_cache(self) -> None:
+        """Drop every cached chain and return cache-only pages to the
+        arena (rows keep their own references) — engine teardown and
+        the tests' arena-drain helper."""
+        if self._prefix is not None:
+            self._decref_pages(self._prefix.clear())
 
     def request_key(self, seq_id: int) -> jax.Array:
         """The per-request PRNG key a default (key=None) submit gets:
@@ -913,11 +1207,19 @@ class EngineCore:
         ``admit_highwater``: past the mark they back off and stay
         queued (headroom for resumes); resumes bypass it. One shed
         scan and one order sort per ROUND (the admission window is the
-        measured bubble; bookkeeping must not inflate it). Admissions
-        only consume slots/pages, so a request skipped earlier in the
-        pass cannot become admissible later in it — the single sorted
-        walk decides exactly what a per-admission re-sort would.
-        Returns the number admitted."""
+        measured bubble; bookkeeping must not inflate it). In a
+        private-pages engine admissions only consume slots/pages, so
+        a request skipped earlier in the pass cannot become
+        admissible later in it and the single sorted walk decides
+        exactly what a per-admission re-sort would. With the sharing
+        arena that is one-round approximate: a later candidate's
+        cache reclaim frees pages, and each admission publishes
+        chains that can shrink an earlier-skipped request's private
+        need — such a request waits for the next round's pass (it
+        keeps its place in the admission order, so nothing starves;
+        re-walking the queue per admission would put the trie work
+        back in the admission window). Returns the number
+        admitted."""
         self._shed_expired()
         order = [self._queue[qi] for qi in self._queue_order()]
         admitted = 0
@@ -927,29 +1229,59 @@ class EngineCore:
                 None)
             if free_slot is None:
                 break
-            need = self._pages_for(req.prompt.size, req.max_new)
+            fresh = req.resume_prefix is None
+            # PRIVATE pages only: a prefix match maps the rest shared
+            # (the sharing arena's capacity win); cache-only pages are
+            # reclaimed LRU first when the request would not fit —
+            # never the request's own matched chain
+            chain = self._memo_match(req)
+            need = (self._pages_for(req.prompt.size, req.max_new)
+                    - len(chain))
+            if self._prefix is not None:
+                self._reclaim_cache_pages(need, fresh, keep=chain)
             # ONE admissibility definition (_admissible): the policy
             # _maybe_preempt predicts with must be the one applied here
-            if not self._admissible(need,
-                                    fresh=req.resume_prefix is None):
+            if not self._admissible(need, fresh=fresh):
                 continue
             # identity-keyed removal BEFORE _admit (whose telemetry
             # reads the queue depth): Request is a value dataclass
             # holding ndarrays, so list.remove/__eq__ would be both
             # ambiguous and wrong here
             self._queue = [r for r in self._queue if r is not req]
-            self._admit(free_slot, req, need, overlapped)
+            self._admit(free_slot, req, overlapped, chain=chain)
             admitted += 1
         return admitted
 
-    def _admit(self, slot: int, req: Request, need: int,
-               overlapped: bool):
+    def _admit(self, slot: int, req: Request, overlapped: bool,
+               chain: list[int] | None = None):
         """Dispatch-only admission: every device op (table upload,
         prefill, first-token pick, cursor seeding) enqueues without a
         host readback, so an in-flight decode chunk is never stalled.
         The first token's readback is deferred to
-        :meth:`_resolve_pending` at the loop's next sync point."""
-        pages = [self.free_pages.pop() for _ in range(need)]
+        :meth:`_resolve_pending` at the loop's next sync point.
+
+        Sharing-aware (``prefix_cache=True``): the longest cached
+        prefix chain at this prompt's rung maps READ-ONLY into the
+        row's leading table entries (incref, no bytes move, no
+        compute), private pages are allocated only for the rest, and
+        the prefill computes ONLY the tail (:func:`_tail_prefill_one`
+        — bit-identical to the monolithic prefill by the rung-keyed
+        parity contract). ``chain`` is the matched chain the caller's
+        admissibility math already walked (``_try_admit`` sized
+        ``need`` and ran the reclaim against it — re-matching here
+        would both repeat the trie walk in the admission window and
+        let the two walks drift); the hit/miss observables are folded
+        in once, here, where the match actually becomes an admission.
+        The match/map decisions are host trie walks; nothing here
+        reads a device value."""
+        if chain is None:
+            chain = self._prefix_match(req.prompt)
+        m = len(chain)
+        if self._prefix is not None:
+            self._prefix.count_match(m)
+        need = self._pages_for(req.prompt.size, req.max_new)
+        self._incref_pages(chain)
+        pages = chain + self._alloc_pages(need - m)
         if self.residency is not None:
             self.residency.register_group(
                 req.seq_id, need, need * self._page_nbytes,
@@ -975,18 +1307,44 @@ class EngineCore:
         # _prefill_one donates its table — an alias would delete the
         # engine's live table with it
         one["table"] = jnp.asarray(self._table[slot:slot + 1])
-        with metricslib.span("serve.prefill", prompt_len=T,
-                             padded_len=padded), \
-                tracelib.compile_watch("serving._prefill_one",
-                                       _prefill_one, padded_len=padded):
-            logits, out = _prefill_one(
-                self.params, jnp.asarray(prompt)[None, :],
-                jnp.int32(T - 1), one,
-                cfg=self.cfg, page_size=self.page_size, mesh=self.mesh,
-            )
+        M = m * self.page_size
+        if m:
+            # tail-only prefill: positions [M, padded) computed against
+            # the mapped prefix pages; the matched span's compute AND
+            # page writes are skipped — the TTFT lever the skip-frac
+            # gauge measures
+            tail = prompt[M:]
+            with metricslib.span("serve.prefill", prompt_len=T,
+                                 padded_len=padded, matched=M), \
+                    tracelib.compile_watch("serving._tail_prefill_one",
+                                           _tail_prefill_one,
+                                           padded_len=padded, matched=M):
+                logits, out = _tail_prefill_one(
+                    self.params, jnp.asarray(tail)[None, :],
+                    jnp.int32(T - 1 - M), one,
+                    cfg=self.cfg, page_size=self.page_size,
+                    n_prefix_pages=m, mesh=self.mesh,
+                )
+        else:
+            with metricslib.span("serve.prefill", prompt_len=T,
+                                 padded_len=padded), \
+                    tracelib.compile_watch("serving._prefill_one",
+                                           _prefill_one,
+                                           padded_len=padded):
+                logits, out = _prefill_one(
+                    self.params, jnp.asarray(prompt)[None, :],
+                    jnp.int32(T - 1), one,
+                    cfg=self.cfg, page_size=self.page_size,
+                    mesh=self.mesh,
+                )
         for k, v in out.items():
             if k != "table":
                 self.cache[k] = v
+        # publish this admission's full-prompt pages (matched chain +
+        # newly prefilled) so the NEXT same-rung prompt shares them
+        self._insert_prefix(req.prompt, padded, pages)
+        self._prefill_total_tokens += T
+        self._prefill_skip_tokens += M
         if self.draft_params is not None:
             self.dcache["table"] = jnp.asarray(self._table)
             done = dict(self.dcache)
@@ -1025,6 +1383,8 @@ class EngineCore:
         st.temp_override = req.temperature
         st.prefix = ([] if req.resume_prefix is None
                      else [int(t) for t in req.resume_prefix])
+        st.padded_len = padded
+        st.shared_pages = m
         rec = tracelib.active()
         if rec is not None:
             # all admission device work (table upload, prefill, first-
@@ -1044,14 +1404,18 @@ class EngineCore:
                    budget=req.max_new, overlapped=overlapped,
                    free_pages=len(self.free_pages),
                    queued=len(self._queue), priority=req.priority,
-                   resumed=req.resume_prefix is not None)
-        m = metricslib.get_metrics()
-        if m.enabled:
-            m.gauge("serve.queue_depth").set(len(self._queue))
-            m.gauge("serve.free_pages").set(len(self.free_pages))
-            m.counter("serve.admitted").inc()
+                   resumed=req.resume_prefix is not None,
+                   matched_tokens=M, shared_pages=m)
+        mx = metricslib.get_metrics()
+        if mx.enabled:
+            mx.gauge("serve.queue_depth").set(len(self._queue))
+            mx.gauge("serve.free_pages").set(len(self.free_pages))
+            mx.counter("serve.admitted").inc()
             if overlapped:
-                m.counter("serve.admit_overlapped").inc()
+                mx.counter("serve.admit_overlapped").inc()
+            if m:
+                mx.counter("serve.prefix_matched_pages").inc(m)
+                mx.counter("serve.prefill_skip_tokens").inc(M)
 
     def _resolve_pending(self):
         """Host bookkeeping deferred from :meth:`_admit`: read back the
@@ -1103,9 +1467,11 @@ class EngineCore:
         the shared tail of completion AND eviction. The table upload is
         dispatch-only; pos/limit zeroing freezes the row out of future
         chunks (stale keys/temps in an inactive row are never
-        consumed)."""
+        consumed). Pages DECREF, never free: a page the prefix index
+        or another row still maps stays allocated (the sharing arena's
+        one release rule)."""
         st = self._slots[slot]
-        self.free_pages.extend(st.pages)
+        self._decref_pages(st.pages)
         self._table[slot] = self.trash
         self.cache["table"] = jnp.asarray(self._table)
         if self.draft_params is not None:
@@ -1144,8 +1510,10 @@ class EngineCore:
                     (now - rec_s["t_first"]) / (len(st.out) - 1))
             m.counter("serve.finished").inc()
             m.counter("serve.tokens").inc(len(st.out))
+            # shared pages don't free with the row — count only what
+            # the release will actually return to the arena
             m.gauge("serve.free_pages").set(
-                len(self.free_pages) + len(st.pages))
+                len(self.free_pages) + self._row_freeable_pages(slot))
         self._release_slot(slot)
 
     # -- preemption --------------------------------------------------------
@@ -1214,8 +1582,15 @@ class EngineCore:
             return
         order = self._queue_order()
         req = self._queue[order[0]]
-        need = self._pages_for(req.prompt.size, req.max_new)
+        # private pages only — the head's match maps the rest shared
+        chain = self._memo_match(req)
+        need = (self._pages_for(req.prompt.size, req.max_new)
+                - len(chain))
         fresh = req.resume_prefix is None
+        if self._prefix is not None:
+            # cache-only pages are strictly cheaper to free than a
+            # victim's eviction-and-resume round trip: reclaim first
+            self._reclaim_cache_pages(need, fresh, keep=chain)
         if self._admissible(need, fresh):
             return  # ordinary admission will take it this round
         victims = [
@@ -1234,7 +1609,8 @@ class EngineCore:
         # mark and re-admits the same round, and the next round evicts
         # it again: an evict/re-prefill thrash loop that collapses
         # goodput while the head stays stuck regardless
-        freeable = sum(len(self._slots[v].pages) for v in victims)
+        # (refcount-aware: a victim's SHARED pages don't free with it)
+        freeable = sum(self._row_freeable_pages(v) for v in victims)
         if need > len(self.free_pages) + freeable:
             return
         if fresh:
@@ -1292,7 +1668,7 @@ class EngineCore:
         if m.enabled:
             m.counter("serve.preempted").inc()
             m.gauge("serve.free_pages").set(
-                len(self.free_pages) + len(st.pages))
+                len(self.free_pages) + self._row_freeable_pages(slot))
         self._residency_release(st.seq_id)
         self._release_slot(slot)
         self._queue.append(req)
@@ -1427,13 +1803,24 @@ class EngineCore:
         decides whether that is a deadlock), "active"}``."""
         if chaos_index is not None and chaoslib.active() is not None:
             chaoslib.maybe_inject("engine_round", chaos_index)
+        # fresh round, fresh head-match memo (_memo_match): the memo's
+        # validity argument is scoped to one round's mutations
+        self._match_memo = None
         if self.preempt:
             self._maybe_preempt()
         if self.residency is not None:
             self.residency.begin_round()
-            for s in self._slots:
+            for si, s in enumerate(self._slots):
                 if s.active:
                     self.residency.touch_group(s.seq_id)
+                    if self._prefix is not None:
+                        # pin-while-shared: a row whose pages another
+                        # row maps (refcount >= 2 net of the cache's
+                        # own reference) must not page to host while
+                        # the reader is resident — the manager's
+                        # victim selection skips pinned groups
+                        self.residency.pin_group(
+                            s.seq_id, not self._row_swappable(si))
             # pulls for swapped rows dispatch BEFORE the decode chunk:
             # the host->HBM copies fly while the chunk computes, and
             # the install lands behind it at the pre_collect position
@@ -1593,6 +1980,14 @@ class EngineCore:
             preemptions=int((rec_s or {}).get("preemptions") or 0),
             n_pages=len(st.pages), page_size=self.page_size,
             pages_payload=payload,
+            # prefix-resolution metadata: the leading full-prompt pages
+            # hold pure-prompt K/V computed at this rung — a sharing
+            # destination with the same chain cached maps its own pages
+            # for that span instead of installing (byte-exact either
+            # way, docs/prefix_cache.md)
+            rung=int(st.padded_len),
+            prefix_len=((st.prompt_len // self.page_size)
+                        * self.page_size if st.padded_len else 0),
         )
         self._release_slot(slot)
         return bundle
@@ -1702,23 +2097,41 @@ class EngineCore:
         host-tier row returning to HBM). Admissibility is the
         CALLER's to have checked. Returns the slot."""
         slot = next(i for i, s in enumerate(self._slots) if not s.active)
-        pages = [self.free_pages.pop() for _ in range(bundle.n_pages)]
         # jaxlint: disable=host-sync-in-dispatch — host-list packing of
         # the wire bundle's prompt, not a device readback (the same
         # contract as _preempt's resume-Request packing)
         prompt = np.asarray(bundle.prompt, np.int32)
+        # prefix resolution (sharing destinations): the bundle names
+        # the page-aligned span of pure-prompt K/V and the rung it was
+        # computed at — when this engine's radix index has that exact
+        # chain, the span maps to the CACHED pages (incref; bitwise
+        # the same bytes, same-rung determinism) and only the rest of
+        # the payload installs. A cold cache materializes everything:
+        # byte-exact either way.
+        resolved: list[int] = []
+        if self._prefix is not None and bundle.rung \
+                and bundle.prefix_len:
+            resolved = self._prefix.match(
+                prompt[:bundle.prefix_len], bundle.rung,
+                max_pages=bundle.prefix_len // self.page_size)
+        m = len(resolved)
+        self._incref_pages(resolved)
+        pages = resolved + self._alloc_pages(bundle.n_pages - m)
         row = np.full((self.pages_per_seq,), self.trash, np.int32)
         row[:bundle.n_pages] = pages
         self._table[slot] = row
         self.cache["table"] = jnp.asarray(self._table)
-        idx = jnp.asarray(pages, dtype=jnp.int32)
-        for name, pools in list(self.cache.items()):
-            if name == "table":
-                continue
-            payload = bundle.pages_payload[name]
-            self.cache[name] = tuple(
-                _install_pages(pool, idx, jnp.asarray(pl))
-                for pool, pl in zip(pools, payload))
+        if m < bundle.n_pages:
+            idx = jnp.asarray(pages[m:], dtype=jnp.int32)
+            for name, pools in list(self.cache.items()):
+                if name == "table":
+                    continue
+                payload = bundle.pages_payload[name]
+                self.cache[name] = tuple(
+                    _install_pages(
+                        pool, idx,
+                        jnp.asarray(pl)[m:] if m else jnp.asarray(pl))
+                    for pool, pl in zip(pools, payload))
         self.pos = self.pos.at[slot].set(jnp.int32(bundle.pos))
         self.limit = self.limit.at[slot].set(jnp.int32(bundle.limit))
         self.tokens = self.tokens.at[slot].set(jnp.int32(bundle.token))
@@ -1739,6 +2152,12 @@ class EngineCore:
         st.priority = bundle.priority
         st.deadline_s = bundle.deadline_s
         st.temp_override = bundle.temp_override
+        st.padded_len = int(bundle.rung)
+        st.shared_pages = m
+        if bundle.rung:
+            # warm this engine's index with the installed chain: the
+            # next same-rung prompt sharing the prefix maps it here
+            self._insert_prefix(prompt, int(bundle.rung), pages)
         self.stats[bundle.seq_id] = {
             "priority": bundle.priority, "t_submit": bundle.t_submit,
             "t_first": bundle.t_first, "t_finish": None,
@@ -1876,7 +2295,14 @@ class EngineCore:
         a policy knob instead of a refusal."""
         r = self.residency
         avail = len(self.free_pages) - self._reserved_prefetch_pages()
-        sizes = {g.group: g.n_blocks for g in r.groups("hbm")}
+        # pages a victim would ACTUALLY free: shared pages stay with
+        # their other readers / the prefix index, so the planning
+        # credit uses the refcount-aware count where a slot exists
+        slot_of = {s.seq_id: i for i, s in enumerate(self._slots)
+                   if s.active}
+        sizes = {g.group: (self._row_freeable_pages(slot_of[g.group])
+                           if g.group in slot_of else g.n_blocks)
+                 for g in r.groups("hbm")}
         victims: list = []
 
         def planned_avail():
@@ -1899,7 +2325,7 @@ class EngineCore:
         # manager, so there is no evict/pull-back thrash loop
         if self._queue:
             req = self._queue[self._queue_order()[0]]
-            need = self._pages_for(req.prompt.size, req.max_new)
+            need = self._request_need(req)
             fresh = req.resume_prefix is None
             if not self._admissible(need, fresh=fresh):
                 # size the eviction to the BINDING constraint of the
@@ -2063,6 +2489,9 @@ class ContinuousBatcher(EngineCore):
         if m.enabled:
             m.gauge("serve.admit_bubble_frac").set(self.last_bubble_frac)
             m.gauge("serve.prefill_compiles").set(prefill_cache_size())
+            if self._prefix is not None:
+                m.gauge("serve.prefill_skip_frac").set(
+                    self.prefill_skip_frac)
         if self.slo is not None:
             # goodput (SLO-attained tok/s) lands NEXT TO raw tok/s —
             # the whole point of declaring targets; the base is the

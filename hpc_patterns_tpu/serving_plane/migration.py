@@ -101,6 +101,14 @@ def bundle_to_wire(bundle: MigrationBundle) -> dict:
             for name, arrs in bundle.pages_payload.items()
         },
         "seq": int(bundle.seq),
+        # prefix-resolution metadata (round 12): the page-aligned
+        # pure-prompt span and the rung its bytes were computed at — a
+        # sharing destination resolves the span against its own radix
+        # index instead of installing those payload pages (the payload
+        # still carries them: a cold cache materializes, byte-exact
+        # either way — docs/prefix_cache.md)
+        "rung": int(bundle.rung),
+        "prefix_len": int(bundle.prefix_len),
     }
 
 
@@ -128,4 +136,6 @@ def bundle_from_wire(wire: dict) -> MigrationBundle:
             for name, arrs in wire["payload"].items()
         },
         seq=int(wire.get("seq", -1)),
+        rung=int(wire.get("rung", 0)),
+        prefix_len=int(wire.get("prefix_len", 0)),
     )

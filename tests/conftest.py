@@ -140,10 +140,14 @@ def _poison_donated_serving(request):
     CPU executables don't honor donations; cache-loaded ones do).
 
     Always on for tests/test_serving.py (the engine's oracle suite is
-    exactly where an aliasing regression would otherwise hide);
+    exactly where an aliasing regression would otherwise hide) and
+    tests/test_prefix_cache.py (a shared page aliased into a donated
+    pool would corrupt EVERY reader at once — the highest-stakes
+    surface for this bug class);
     ``HPC_PATTERNS_POISON_DONATED=1`` extends it to the whole suite."""
     if not (os.environ.get("HPC_PATTERNS_POISON_DONATED") == "1"
-            or request.node.module.__name__ == "test_serving"):
+            or request.node.module.__name__ in ("test_serving",
+                                                "test_prefix_cache")):
         yield
         return
     from hpc_patterns_tpu.analysis.runtime import install_serving_poison

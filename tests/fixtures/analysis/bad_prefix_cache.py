@@ -1,0 +1,49 @@
+"""Known-bad: prefix-sharing admission hazards, minimized.
+
+The round-12 sharing arena's admission path (radix match -> map shared
+pages -> tail prefill -> decref releases) is HOST trie/list work that
+runs inside the admission window, with or behind an in-flight decode
+chunk — so the hazard class is a device readback smuggled into those
+paths (``DEFAULT_DISPATCH_CRITICAL`` names them): a sync there stalls
+exactly the prefill the cache exists to skip, and the bubble rollup
+then blames admission for latency the match caused.
+
+Lines carrying ``EXPECT: <rule>`` markers are the golden findings
+tests/test_analysis.py asserts, line-exact.
+"""
+
+import numpy as np
+
+import jax
+
+
+def _prefix_match(engine, prompt):
+    # "verifying" the cached chain against live cursors forces a
+    # readback of state the in-flight chunk is still writing — the
+    # match is a HOST trie walk over tokens, never a device question
+    pos_now = np.asarray(engine.pos)  # EXPECT: host-sync-in-dispatch
+    chain = engine._prefix.match(prompt, engine._bucket_len(prompt.size))
+    return chain if pos_now[0] >= 0 else []
+
+
+def _insert_prefix(engine, prompt, rung, pages):
+    # blocking on the tail prefill before publishing the chain stalls
+    # the chunk the prefill was dispatched behind; insertion needs only
+    # the PAGE IDS, which are host bookkeeping — the bytes can land
+    # whenever the device gets there
+    jax.block_until_ready(engine.cache["k"])  # EXPECT: host-sync-in-dispatch
+    engine._prefix.insert(prompt, rung, pages)
+
+
+def _decref_pages(engine, pages):
+    # the release funnel is pure refcount arithmetic; reading the pool
+    # back to "check the page is quiescent" serializes every
+    # completion behind the device queue
+    _ = np.array(jax.device_get(engine.cache["k"][0]))  # EXPECT: host-sync-in-dispatch
+    for p in pages:
+        r = engine._page_refs[p] - 1
+        if r:
+            engine._page_refs[p] = r
+        else:
+            del engine._page_refs[p]
+            engine.free_pages.append(p)

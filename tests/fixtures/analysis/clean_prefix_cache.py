@@ -1,0 +1,37 @@
+"""Known-clean: the prefix-sharing admission discipline.
+
+The radix match is a host trie walk over the request's OWN numpy
+tokens, shared-page mapping is refcount arithmetic, the tail prefill
+is dispatch-only (its first-token readback defers to the loop's next
+sync point), and releases decref host lists — no device value is ever
+consulted on the admission path.
+"""
+
+
+def _prefix_match(engine, prompt):
+    # host trie walk over host tokens: the longest cached chain at
+    # this prompt's rung, no device op anywhere near it
+    return engine._prefix.match(
+        prompt, engine._bucket_len(prompt.size),
+        max_pages=(prompt.size - 1) // engine.page_size)
+
+
+def _insert_prefix(engine, prompt, rung, pages):
+    # publish the page IDS; the prefill's device writes land behind
+    # the in-flight chunk on their own schedule
+    n_full = prompt.size // engine.page_size
+    if n_full:
+        engine._incref_pages(
+            engine._prefix.insert(prompt, rung, pages[:n_full]))
+
+
+def _decref_pages(engine, pages):
+    # the one release rule: refcount arithmetic on host lists, a page
+    # returns to the free list only at zero
+    for p in pages:
+        r = engine._page_refs[p] - 1
+        if r:
+            engine._page_refs[p] = r
+        else:
+            del engine._page_refs[p]
+            engine.free_pages.append(p)

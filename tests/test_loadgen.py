@@ -87,6 +87,107 @@ class TestShapes:
             assert r.max_new in (4, 8, 16)
 
 
+def _shared(process="poisson", n=64, seed=0, **kw):
+    base = dict(rate_rps=50.0, classes=CLASSES, n_templates=3,
+                template_len=16, tail_lens=(3, 5, 8),
+                budgets=(4, 8), process=process, seed=seed)
+    base.update(kw)
+    return loadgen.make_shared_prefix_schedule(n, **base)
+
+
+class TestSharedPrefix:
+    def test_deterministic_and_json_round_trips(self):
+        assert _shared() == _shared()
+        assert _shared(seed=1) != _shared(seed=2)
+        s = _shared("bursty", tree_frac=0.3, burst_factor=4.0)
+        back = loadgen.Schedule.from_json(s.to_json())
+        assert back == s
+        # the sharing structure survives the wire exactly
+        assert [(r.template, r.parent) for r in back.requests] \
+            == [(r.template, r.parent) for r in s.requests]
+        assert s.spec["kind"] == "shared_prefix"
+        assert s.spec["burst_factor"] == 4.0
+
+    def test_template_mix_follows_weights(self):
+        s = _shared(n=512, seed=3, template_weights=(6.0, 1.0, 1.0))
+        tmpl = [r.template for r in s.requests]
+        assert all(t >= 0 and r.parent < 0
+                   for t, r in zip(tmpl, s.requests))  # no tree turns
+        # the hot template carries ~6/8 of traffic
+        assert tmpl.count(0) / 512 == pytest.approx(0.75, abs=0.08)
+        for r in s.requests:
+            assert r.prompt_len - 16 in (3, 5, 8)
+            assert r.max_new in (4, 8)
+
+    def test_per_template_lengths(self):
+        s = _shared(n=128, seed=4, template_len=(8, 16, 32))
+        lens = (8, 16, 32)
+        for r in s.requests:
+            assert r.prompt_len - lens[r.template] in (3, 5, 8)
+
+    def test_tree_turns_extend_earlier_prompts(self):
+        s = _shared(n=256, seed=5, tree_frac=0.5)
+        turns = [r for r in s.requests if r.parent >= 0]
+        # ~half the stream is follow-up turns (the first never is)
+        assert len(turns) / 256 == pytest.approx(0.5, abs=0.1)
+        for r in turns:
+            assert r.template == -1 and r.parent < r.index
+            parent = s.requests[r.parent]
+            assert r.prompt_len - parent.prompt_len in (3, 5, 8)
+
+    def test_dispersion_rides_the_arrival_process(self):
+        # shared-prefix structure reuses the named process untouched:
+        # the bursty variant keeps its index-of-dispersion signature
+        def dispersion(sched):
+            t = np.array([r.t_arrival_s for r in sched.requests])
+            counts, _ = np.histogram(t, bins=max(4, int(t[-1] / 0.1)))
+            return counts.var() / max(counts.mean(), 1e-9)
+
+        poisson = dispersion(_shared("poisson", n=512, seed=6))
+        bursty = dispersion(_shared("bursty", n=512, seed=6,
+                                    burst_factor=16.0))
+        assert bursty > 2.0 * poisson
+
+    def test_materialize_prompt_shares_prefix_bytes(self):
+        s = _shared(n=64, seed=7, tree_frac=0.4)
+        prompts = [loadgen.materialize_prompt(s, i, vocab=256)
+                   for i in range(s.n)]
+        again = [loadgen.materialize_prompt(s, i, vocab=256)
+                 for i in range(s.n)]
+        by_tmpl: dict[int, list[int]] = {}
+        for i, r in enumerate(s.requests):
+            assert len(prompts[i]) == r.prompt_len
+            np.testing.assert_array_equal(prompts[i], again[i])
+            if r.parent >= 0:
+                # a tree turn extends its parent's prompt bit-exactly
+                np.testing.assert_array_equal(
+                    prompts[i][:len(prompts[r.parent])],
+                    prompts[r.parent])
+            else:
+                by_tmpl.setdefault(r.template, []).append(i)
+        for idxs in by_tmpl.values():
+            first = prompts[idxs[0]][:16]
+            for i in idxs[1:]:
+                # same template -> the SAME 16 leading tokens...
+                np.testing.assert_array_equal(prompts[i][:16], first)
+        # ...and tails diverge between requests on one template
+        hot = max(by_tmpl.values(), key=len)
+        assert any(not np.array_equal(prompts[i], prompts[j])
+                   for i in hot for j in hot if i != j)
+
+    def test_guards(self):
+        with pytest.raises(ValueError, match="n_templates"):
+            _shared(n_templates=0)
+        with pytest.raises(ValueError, match="tree_frac"):
+            _shared(tree_frac=1.5)
+        with pytest.raises(ValueError, match="template_len"):
+            _shared(template_len=(8, 16))  # 2 lengths, 3 templates
+        with pytest.raises(ValueError, match="template_weights"):
+            _shared(template_weights=(1.0,))
+        with pytest.raises(ValueError, match="unknown process"):
+            _shared("weekly")
+
+
 class TestStaged:
     def test_staged_schedule_is_literal(self):
         inter, batch = CLASSES

@@ -1,0 +1,441 @@
+"""Prefix-sharing KV arena (models/serving.py ``prefix_cache=True`` +
+memory/prefix_cache.py): a sharing engine must be TOKEN-IDENTICAL to a
+private-pages engine — greedy AND sampled — no matter where the
+prompts diverge (page boundary vs mid-page), what evicted whom along
+the way (preemption decrefs, never frees), or which engine finished
+the row (migration bundles carry prefix refs a warm destination
+resolves, or it materializes). The bitwise story behind the oracle
+(rung-keyed chains, PREFIX_ALIGN row stability, the einsum-mirror tail
+prefill) lives in docs/prefix_cache.md; this file pins its observable
+consequences. The module runs under the donation-poison harness
+(conftest) like test_serving.py — a zero-copy view of a donated pool
+fails loudly here."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hpc_patterns_tpu.memory.prefix_cache import RadixPrefixCache
+from hpc_patterns_tpu.models import TransformerConfig, init_params
+from hpc_patterns_tpu.models.decode import paged_generate
+from hpc_patterns_tpu.models.serving import (
+    ContinuousBatcher,
+    tail_prefill_cache_size,
+)
+from hpc_patterns_tpu.serving_plane.migration import (
+    bundle_from_wire,
+    bundle_to_wire,
+)
+
+BASE = dict(vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq=64, dtype="float32")
+BUCKETS = (16, 24, 32)
+
+
+def _setup(**over):
+    cfg = TransformerConfig(**{**BASE, **over})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _standalone(params, cfg, prompt, max_new, **kw):
+    return np.asarray(paged_generate(
+        params, jnp.asarray(prompt, jnp.int32)[None, :], cfg, max_new,
+        page_size=8, **kw))[0]
+
+
+def _engine(params, cfg, share=True, **over):
+    kw = dict(slots=2, pool_pages=12, pages_per_seq=4, page_size=8,
+              chunk=2, prompt_buckets=BUCKETS, prefix_cache=share)
+    kw.update(over)
+    return ContinuousBatcher(params, cfg, **kw)
+
+
+def _template_requests(cfg, template, n, seed=0, tails=(3, 5, 8)):
+    rng = np.random.RandomState(seed)
+    reqs = []
+    for _ in range(n):
+        tail = rng.randint(0, cfg.vocab,
+                           size=int(rng.choice(tails))).astype(np.int32)
+        reqs.append((np.concatenate([template, tail]),
+                     int(rng.choice([3, 5]))))
+    return reqs
+
+
+class TestRadixPrefixCache:
+    """Host-only unit behavior of the index itself."""
+
+    def test_match_insert_roundtrip_and_rung_scoping(self):
+        c = RadixPrefixCache(4)
+        toks = np.arange(12, dtype=np.int32)
+        assert c.insert(toks, 16, [7, 3, 9]) == [7, 3, 9]
+        assert c.match(toks, 16) == [7, 3, 9]
+        # a shorter shared prefix matches its chain prefix
+        assert c.match(np.concatenate([toks[:8], toks[:4]]), 16) == [7, 3]
+        # rung-keyed: the SAME tokens at another rung are a miss
+        assert c.match(toks, 32) == []
+        # max_pages caps the walk
+        assert c.match(toks, 16, max_pages=1) == [7]
+
+    def test_insert_keeps_first_writer(self):
+        c = RadixPrefixCache(4)
+        toks = np.arange(8, dtype=np.int32)
+        assert c.insert(toks, 16, [1, 2]) == [1, 2]
+        # a duplicate insert (same-pass double admission) returns no
+        # new pages: the second writer's private pages stay private
+        assert c.insert(toks, 16, [5, 6]) == []
+        assert c.match(toks, 16) == [1, 2]
+
+    def test_evict_lru_leaves_only(self):
+        c = RadixPrefixCache(4)
+        a = np.arange(12, dtype=np.int32)
+        b = np.concatenate([a[:4], np.arange(50, 54, dtype=np.int32)])
+        c.insert(a, 16, [1, 2, 3])
+        c.insert(b, 16, [1, 9])
+        c.match(b, 16)  # touch b's chain; a's tip (3) is now LRU
+        freed = c.evict(1, lambda p: True)
+        assert freed == [3]
+        # interior node 1 has children — never offered while they live
+        freed = c.evict(10, lambda p: True)
+        assert set(freed) == {2, 9, 1}
+        assert len(c) == 0
+
+    def test_evict_respects_refcounts(self):
+        c = RadixPrefixCache(4)
+        c.insert(np.arange(8, dtype=np.int32), 16, [1, 2])
+        # page 2 is "mapped by a row" (refcount 2): never evicted
+        freed = c.evict(5, lambda p: p != 2)
+        assert freed == []
+        assert c.has_page(2)
+
+    def test_release_pages_deepest_first_stops_at_children(self):
+        c = RadixPrefixCache(4)
+        a = np.arange(12, dtype=np.int32)
+        b = np.concatenate([a[:8], np.arange(60, 64, dtype=np.int32)])
+        c.insert(a, 16, [1, 2, 3])
+        c.insert(b, 16, [1, 2, 7])
+        # releasing a's pages drops leaf 3; 1 and 2 anchor b's chain
+        assert c.release_pages([1, 2, 3]) == [3]
+        assert c.match(b, 16) == [1, 2, 7]
+
+    def test_clear_returns_everything(self):
+        c = RadixPrefixCache(4)
+        c.insert(np.arange(12, dtype=np.int32), 16, [4, 5, 6])
+        assert c.clear() == [4, 5, 6]
+        assert c.match(np.arange(12, dtype=np.int32), 16) == []
+
+
+class TestSharingOracle:
+    """The tentpole oracle: sharing is invisible in the tokens."""
+
+    @pytest.mark.parametrize("temp", [0.0, 0.8])
+    def test_shared_equals_private_and_standalone(self, temp):
+        cfg, params = _setup()
+        rng = np.random.RandomState(1)
+        template = rng.randint(0, cfg.vocab, size=16).astype(np.int32)
+        reqs = _template_requests(cfg, template, 6, seed=2)
+        reqs.append((template.copy(), 4))  # full-identical prompt
+        skw = dict(temperature=temp, top_k=0 if temp == 0 else 8)
+        before = tail_prefill_cache_size()
+        priv = _engine(params, cfg, share=False, **skw)
+        ids_p = [priv.submit(p, b) for p, b in reqs]
+        got_p = priv.run()
+        shr = _engine(params, cfg, **skw)
+        ids_s = [shr.submit(p, b) for p, b in reqs]
+        got_s = shr.run()
+        for i, (p, b) in enumerate(reqs):
+            gen_kw = {} if temp == 0 else dict(
+                temperature=temp, top_k=8,
+                key=shr.request_key(ids_s[i]))
+            want = _standalone(params, cfg, p, b, **gen_kw)
+            np.testing.assert_array_equal(got_p[ids_p[i]], want,
+                                          err_msg=f"private {i}")
+            np.testing.assert_array_equal(got_s[ids_s[i]], want,
+                                          err_msg=f"shared {i}")
+        assert shr._prefix.hits > 0
+        assert shr.prefill_skip_frac > 0.3
+        # compile bound: one tail variant per (matched pages, rung)
+        assert (tail_prefill_cache_size() - before
+                <= len(BUCKETS) * shr.pages_per_seq)
+        # drained arena: rows released, the index still holds chains —
+        # clearing it returns every page
+        shr.release_prefix_cache()
+        assert sorted(shr.free_pages) == list(range(12))
+        assert sorted(priv.free_pages) == list(range(12))
+
+    def test_divergence_at_page_boundary_vs_mid_page(self):
+        cfg, params = _setup()
+        rng = np.random.RandomState(3)
+        template = rng.randint(0, cfg.vocab, size=16).astype(np.int32)
+        events = []
+        eng = _engine(params, cfg,
+                      emit=lambda **kw: events.append(kw))
+        # all three prompts are 21 tokens -> the SAME rung (24):
+        # sharing is rung-keyed, so the seed must land where the
+        # readers will look
+        seeder = np.concatenate(
+            [template, rng.randint(0, cfg.vocab, size=5).astype(np.int32)])
+        seed = eng.submit(seeder, 3)  # seeds template pages 0..1
+        eng.run()
+        boundary = np.concatenate(  # diverges exactly at token 16
+            [template, rng.randint(0, cfg.vocab, size=5).astype(np.int32)])
+        midpage = np.concatenate(   # diverges at token 12, mid-page
+            [template[:12],
+             rng.randint(0, cfg.vocab, size=9).astype(np.int32)])
+        b = eng.submit(boundary, 4)
+        m = eng.submit(midpage, 4)
+        got = eng.run()
+        admits = {e["seq_id"]: e for e in events
+                  if e["kind"] == "serve_admit"}
+        # boundary divergence: both template pages map shared
+        assert admits[b]["matched_tokens"] == 16
+        # mid-page divergence: only the full page BEFORE the split —
+        # the boundary page is private from admission (COW-at-admission)
+        assert admits[m]["matched_tokens"] == 8
+        for sid, prompt in ((seed, seeder), (b, boundary),
+                            (m, midpage)):
+            np.testing.assert_array_equal(
+                got[sid],
+                _standalone(params, cfg, prompt,
+                            4 if sid != seed else 3))
+
+    def test_match_is_rung_keyed(self):
+        # the SAME 16-token template through prompts on two different
+        # rungs must not share: prefix K/V bytes are rung-stamped
+        cfg, params = _setup()
+        rng = np.random.RandomState(4)
+        template = rng.randint(0, cfg.vocab, size=16).astype(np.int32)
+        events = []
+        eng = _engine(params, cfg,
+                      emit=lambda **kw: events.append(kw))
+        a = eng.submit(  # 21 tokens -> rung 24
+            np.concatenate([template,
+                            rng.randint(0, cfg.vocab, size=5)
+                            .astype(np.int32)]), 3)
+        eng.run()
+        b = eng.submit(  # 29 tokens -> rung 32: no rung-24 chain match
+            np.concatenate([template,
+                            rng.randint(0, cfg.vocab, size=13)
+                            .astype(np.int32)]), 3)
+        got = eng.run()
+        admits = {e["seq_id"]: e for e in events
+                  if e["kind"] == "serve_admit"}
+        assert admits[b]["matched_tokens"] == 0
+        c = eng.submit(  # 23 tokens -> rung 24 again: shares
+            np.concatenate([template,
+                            rng.randint(0, cfg.vocab, size=7)
+                            .astype(np.int32)]), 3)
+        got2 = eng.run()
+        admits = {e["seq_id"]: e for e in events
+                  if e["kind"] == "serve_admit"}
+        assert admits[c]["matched_tokens"] == 16
+        assert len(got[b]) == 3 and len(got2[c]) == 3
+
+    def test_sharing_admits_where_private_pages_cannot(self):
+        # THE capacity claim in one shape: a pool too small for two
+        # private working sets serves both requests when the second
+        # maps the first's pages
+        cfg, params = _setup()
+        rng = np.random.RandomState(5)
+        template = rng.randint(0, cfg.vocab, size=16).astype(np.int32)
+        pA = np.concatenate(
+            [template, rng.randint(0, cfg.vocab, size=3).astype(np.int32)])
+        pB = np.concatenate(
+            [template, rng.randint(0, cfg.vocab, size=3).astype(np.int32)])
+        # each request needs 3 pages privately (19 + 4 <= 24 = 3 pages
+        # on the rung-24 pad); pool of 4: private engines can never
+        # hold both, sharing maps 2 template pages so B needs only 1
+        # private page beside A's 3 (chunk=1 keeps A mid-flight — 2 of
+        # 4 tokens — through B's admission round)
+        kw = dict(slots=2, pool_pages=4, pages_per_seq=3, page_size=8,
+                  chunk=1, prompt_buckets=BUCKETS)
+        shr = ContinuousBatcher(params, cfg, prefix_cache=True, **kw)
+        a = shr.submit(pA, 4)
+        shr.run(max_rounds=1)          # A resident, holding 3 pages
+        b = shr.submit(pB, 4)
+        shr.run(max_rounds=1)
+        assert shr.active_count == 2, (
+            "B should have admitted beside A through the shared pages")
+        got = shr.run()
+        np.testing.assert_array_equal(got[a],
+                                      _standalone(params, cfg, pA, 4))
+        np.testing.assert_array_equal(got[b],
+                                      _standalone(params, cfg, pB, 4))
+
+    def test_reclaim_frees_cache_only_pages_for_admission(self):
+        # a drained engine whose index holds every page must still
+        # admit fresh unrelated work: LRU cache-only pages reclaim
+        cfg, params = _setup()
+        rng = np.random.RandomState(6)
+        eng = _engine(params, cfg, pool_pages=6, pages_per_seq=3)
+        for i in range(3):  # fill the index with disjoint chains
+            p = rng.randint(0, cfg.vocab, size=16).astype(np.int32)
+            eng.submit(p, 3)
+            eng.run()
+        assert len(eng.free_pages) < 6  # the index holds pages
+        fresh = rng.randint(0, cfg.vocab, size=20).astype(np.int32)
+        sid = eng.submit(fresh, 4)      # needs 3 pages
+        got = eng.run()
+        np.testing.assert_array_equal(
+            got[sid], _standalone(params, cfg, fresh, 4))
+
+    def test_constructor_refuses_unshareable_configs(self):
+        cfg, params = _setup()
+        kw = dict(slots=1, pool_pages=4, pages_per_seq=4, page_size=8,
+                  chunk=2)
+        with pytest.raises(ValueError, match="RUNG-KEYED"):
+            ContinuousBatcher(params, cfg, prefix_cache=True, **kw)
+        with pytest.raises(ValueError, match="aligned"):
+            ContinuousBatcher(params, cfg, prefix_cache=True,
+                              prompt_buckets=(12, 20), **kw)
+        cfg8, params8 = _setup(kv_cache_dtype="int8")
+        with pytest.raises(ValueError, match="int8"):
+            ContinuousBatcher(params8, cfg8, prefix_cache=True,
+                              prompt_buckets=BUCKETS, **kw)
+
+
+class TestCowComposition:
+    """COW under preemption, migration, and residency."""
+
+    @pytest.mark.parametrize("temp", [0.0, 0.8])
+    def test_preempt_resume_of_sharing_row(self, temp):
+        # the victim's prompt pages are in the index (decref on evict,
+        # NOT freed — the chain survives); the resume re-enters through
+        # the ordinary admission and RE-MATCHES the chain at its rung
+        cfg, params = _setup()
+        rng = np.random.RandomState(7)
+        template = rng.randint(0, cfg.vocab, size=8).astype(np.int32)
+        pV = np.concatenate(
+            [template, rng.randint(0, cfg.vocab, size=1).astype(np.int32)])
+        events = []
+        skw = dict(temperature=temp, top_k=0 if temp == 0 else 8)
+        eng = ContinuousBatcher(
+            params, cfg, slots=2, pool_pages=4, pages_per_seq=4,
+            page_size=8, chunk=2, preempt=True, prefix_cache=True,
+            prompt_buckets=BUCKETS,
+            emit=lambda **kw: events.append(kw), **skw)
+        v = eng.submit(pV, 18, priority=1)  # 9 + 18 -> all 4 pages
+        eng.run(max_rounds=3)
+        h = eng.submit(template.copy(), 4, priority=0)  # must evict V
+        got = eng.run()
+        pre = [e for e in events if e["kind"] == "serve_preempt"]
+        assert [e["seq_id"] for e in pre] == [v]
+        gen_kw = ({} if temp == 0 else
+                  {"temperature": temp, "top_k": 8})
+        np.testing.assert_array_equal(
+            got[v], _standalone(
+                params, cfg, pV, 18,
+                **({**gen_kw, "key": eng.request_key(v)} if temp
+                   else {})))
+        np.testing.assert_array_equal(
+            got[h], _standalone(
+                params, cfg, template, 4,
+                **({**gen_kw, "key": eng.request_key(h)} if temp
+                   else {})))
+        # the resumed admission re-matched the surviving chain
+        resumed = [e for e in events
+                   if e["kind"] == "serve_admit" and e["resumed"]]
+        assert resumed and resumed[0]["matched_tokens"] >= 8
+        eng.release_prefix_cache()
+        assert sorted(eng.free_pages) == list(range(4))
+
+    @pytest.mark.parametrize("temp", [0.0, 0.8])
+    def test_migration_materialized_vs_resolved(self, temp):
+        # one exported bundle, two destinations: a COLD cache installs
+        # every payload page; a WARM cache resolves the prefix span to
+        # its own shared pages — byte-exact either way
+        cfg, params = _setup()
+        rng = np.random.RandomState(8)
+        template = rng.randint(0, cfg.vocab, size=16).astype(np.int32)
+        prompt = np.concatenate(
+            [template, rng.randint(0, cfg.vocab, size=5).astype(np.int32)])
+        skw = dict(temperature=temp, top_k=0 if temp == 0 else 8,
+                   seed=0)
+        kw = dict(slots=2, pool_pages=8, pages_per_seq=4, page_size=8,
+                  chunk=2, prompt_buckets=BUCKETS, prefix_cache=True,
+                  **skw)
+        src = ContinuousBatcher(params, cfg, **kw)
+        sid = src.submit(prompt, 6, seq_id=7)  # distinct from the
+        src.service_round(decode=False)        # warm engine's own ids
+        bundle = src.export_migration(src.exportable_slots()[0])
+        assert bundle.rung == 24 and bundle.prefix_len == 16
+        wire = bundle_from_wire(bundle_to_wire(bundle))
+        assert (wire.rung, wire.prefix_len) == (24, 16)
+        want = _standalone(
+            params, cfg, prompt, 6,
+            **({} if temp == 0 else dict(temperature=temp, top_k=8,
+                                         key=src.request_key(sid))))
+
+        cold = ContinuousBatcher(params, cfg, **kw)
+        s_cold = cold.install_migration(wire)
+        assert cold._slots[s_cold].shared_pages == 0  # materialized
+        np.testing.assert_array_equal(cold.run()[sid], want)
+
+        warm = ContinuousBatcher(params, cfg, **kw)
+        w = warm.submit(np.concatenate(  # seeds the rung-24 chain
+            [template, rng.randint(0, cfg.vocab, size=7)
+             .astype(np.int32)]), 3)
+        warm.run()
+        s_warm = warm.install_migration(bundle)
+        assert warm._slots[s_warm].shared_pages == 2  # refs resolved
+        np.testing.assert_array_equal(warm.run()[sid], want)
+        assert len(warm.run()[sid]) == len(want) and w in warm.finished
+
+    def test_pin_while_shared_blocks_residency_paging(self):
+        # refcount >= 2 (net of the index's own reference): the row is
+        # PINNED — the manager must never page it to host while the
+        # second reader is resident; a lone reader is swappable again
+        from hpc_patterns_tpu.memory import (
+            ColdAfterNPolicy,
+            ResidencyManager,
+        )
+
+        cfg, params = _setup()
+        rng = np.random.RandomState(9)
+        template = rng.randint(0, cfg.vocab, size=16).astype(np.int32)
+        pA = np.concatenate(
+            [template, rng.randint(0, cfg.vocab, size=3).astype(np.int32)])
+        pB = np.concatenate(
+            [template, rng.randint(0, cfg.vocab, size=5).astype(np.int32)])
+        mgr = ResidencyManager(host_blocks=16,
+                               policy=ColdAfterNPolicy(1))
+        eng = ContinuousBatcher(
+            params, cfg, slots=2, pool_pages=10, pages_per_seq=4,
+            page_size=8, chunk=2, prompt_buckets=BUCKETS,
+            prefix_cache=True, residency=mgr)
+        a = eng.submit(pA, 8)
+        b = eng.submit(pB, 8)
+        eng.run(max_rounds=2)  # both resident, sharing the template
+        slots = {s.seq_id: i for i, s in enumerate(eng._slots)
+                 if s.active}
+        assert not eng._row_swappable(slots[a])
+        assert not eng._row_swappable(slots[b])
+        assert all(g.pinned for g in mgr.groups("hbm"))
+        got = eng.run()
+        np.testing.assert_array_equal(got[a],
+                                      _standalone(params, cfg, pA, 8))
+        np.testing.assert_array_equal(got[b],
+                                      _standalone(params, cfg, pB, 8))
+        # a lone reader (index ref only beside its own) is swappable
+        c = eng.submit(np.concatenate(
+            [template, rng.randint(0, cfg.vocab, size=4)
+             .astype(np.int32)]), 8)
+        eng.run(max_rounds=1)
+        sc = next(i for i, s in enumerate(eng._slots)
+                  if s.active and s.seq_id == c)
+        assert eng._row_swappable(sc)
+        eng.run()
+
+    def test_poison_covers_tail_prefill(self):
+        # the donation-poison harness (active for this whole module,
+        # conftest) must wrap the new page-install jit: an aliased
+        # shared page would corrupt every reader at once
+        from hpc_patterns_tpu.analysis import runtime
+        from hpc_patterns_tpu.models import serving
+
+        assert runtime.SERVING_POISON_TARGETS["_tail_prefill_one"] \
+            == (3,)
+        assert getattr(serving._tail_prefill_one, "__wrapped__",
+                       None) is not None
