@@ -244,6 +244,39 @@ def _shared_prefix_detail() -> dict:
     }
 
 
+def _quantized_detail() -> dict:
+    """Quantized-decode headline keys (round 13), captured in the same
+    measurement child as the overlap headline:
+
+    - ``quant_goodput_tok_s``: SLO-attained tok/s of an engine serving
+      from an int8 KV pool (one-byte pages + per-row scales), gated
+      only after BOTH oracles pass — token-identical to standalone
+      decode within the precision, and the teacher-forced precision
+      law (greedy top-1 agreement + TV-distance bounds,
+      models/quantization.py) against the baseline precision;
+    - ``kv_pool_bytes_frac``: measured quantized-pool bytes over a
+      bf16 pool at equal residents (~0.53 — the capacity multiplier
+      every tier inherits);
+    - ``quant_bubble_frac``: the quantized engine's admission-bubble
+      fraction (the per-precision bubble % the gate watches).
+
+    Runs ``bench_serving.run_quantized``'s smoke shape. Returns {} on
+    failure — the gate's coverage-loss warning is the tripwire."""
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    import bench_serving
+
+    r = bench_serving.run_quantized(
+        **bench_serving.quantized_smoke_config(), quiet=True)
+    return {
+        "quant_goodput_tok_s": round(r["quant_goodput_tok_s"], 1),
+        "kv_pool_bytes_frac": round(r["kv_pool_bytes_frac"], 4),
+        "quant_bubble_frac": round(r["quant_bubble_frac"], 4),
+    }
+
+
 def _unavailable_line(err: BaseException) -> str:
     """Degenerate-capture verdict line for a backend that won't even
     initialize (value 0.0, never a pass, the error preserved)."""
@@ -580,6 +613,15 @@ def main() -> int:
         shared_detail = {"shared_prefix_error":
                          f"{type(err).__name__}: {err}"}
 
+    # the quantized-decode row (round 13): int8-KV goodput + the
+    # pool-bytes fraction vs bf16 (bench_serving.run_quantized smoke —
+    # both precision oracles pass before either number exists)
+    try:
+        quant_detail = _quantized_detail()
+    except Exception as err:  # noqa: BLE001 — never sink the headline
+        quant_detail = {"quantized_error":
+                        f"{type(err).__name__}: {err}"}
+
     # any clamped-to-zero component means the run measured nothing usable
     degenerate = min(t_overlap, t_serial, t_dma, t_comp) <= 0
     if degenerate:
@@ -614,6 +656,7 @@ def main() -> int:
                     **plane_detail,
                     **offload_detail,
                     **shared_detail,
+                    **quant_detail,
                     # the five raw (serial, overlap) pairs, measurement
                     # order — the distribution behind the median
                     "pairs_us": [

@@ -3,7 +3,23 @@
 Usage: python benchmarks/bench_serving.py [--n=N] [--slots=S] [--chunk=K]
          [--mix=0|1] [--buckets=auto|none|16,32,...] [--overlap=0|1]
          [--temp=T] [--topk=K] [--smoke] [--scenario] [--plane]
-         [--offload] [--shared]
+         [--offload] [--shared] [--quant] [--kv-dtype=f32|bf16|int8|fp8]
+         [--quant-weights]
+
+``--quant`` / ``--kv-dtype``: the QUANTIZED-DECODE row (round 13) —
+the stream served from an int8/fp8 KV pool (one-byte pages + per-row
+scales, ``decode_attn="paged_flash"``-ready), optionally with int8
+per-channel weights (``--quant-weights``). TWO oracles before any
+number: token-identical to standalone decode WITHIN the precision,
+and the teacher-forced precision law (greedy top-1 agreement +
+TV-distance bounds, models/quantization.py) ACROSS precisions.
+Headline keys ``quant_goodput_tok_s`` / ``kv_pool_bytes_frac`` (pool
+bytes vs a bf16 pool at equal residents — int8/fp8 land ~0.53) are
+captured by ``bench.py`` and gated by ``harness/regress.py``.
+``--kv-dtype`` also threads through ``--offload``/``--plane`` so the
+gate sees the compound win (double effective HBM, half the migration
+bytes); ``--shared`` refuses quantized pools loudly (prefix sharing
+needs exact KV pages — docs/quantization.md).
 
 ``--shared``: the PREFIX-SHARING row (round 12) — one shared-prefix
 open-loop stream (template pool + conversation-tree turns,
@@ -852,6 +868,239 @@ def run_shared(*, cfg, params, n, slots, chunk, page_size, n_templates,
     return result
 
 
+def quantized_smoke_config():
+    """The CI quantized-decode shape (tier-1 via
+    tests/test_bench_serving.py): the smoke model served with a
+    quantized KV pool — small enough for seconds on the CPU mesh, big
+    enough that the pool-bytes fraction is geometry-dominated (the
+    scale pools' overhead shows honestly)."""
+    base = smoke_config()
+    return dict(cfg=base["cfg"], params=base["params"], n=8, slots=4,
+                chunk=16, page_size=16, prompt_len=32, max_budget=64,
+                kv_dtype="int8")
+
+
+def quantized_full_config(on_tpu: bool):
+    """The re-grounding shape (reground_r5.sh step 4f): the scenario
+    model with the attention-route RACE on — the quantized stream runs
+    once on the gather route and once on ``paged_flash``
+    (ops/paged_attention.py) at real VMEM limits. The interpret-mode
+    ~10x penalty that forced serving onto the gather route off-TPU is
+    exactly the number the chip race replaces."""
+    base = scenario_full_config(on_tpu)
+    prompt_top = 128 if on_tpu else 32
+    budget_top = 256 if on_tpu else 96
+    return dict(cfg=base["cfg"], params=base["params"],
+                n=24 if on_tpu else 12, slots=8 if on_tpu else 4,
+                chunk=16, page_size=256 if on_tpu else 16,
+                prompt_len=prompt_top, max_budget=budget_top,
+                kv_dtype="int8", race_attn=on_tpu)
+
+
+def run_quantized(*, cfg, params, n, slots, chunk, page_size,
+                  prompt_len, max_budget, kv_dtype="int8",
+                  quant_weights=False, race_attn=False, quiet=False):
+    """The quantized-decode row (round 13): one mixed stream served by
+    (a) the compute-dtype baseline engine and (b) an engine whose KV
+    pool stores ``kv_dtype`` (int8/fp8 one-byte pages + per-row f32
+    scales; ``quant_weights`` additionally runs the int8
+    per-output-channel weight path through every decode matmul,
+    models/quantization.py).
+
+    TWO oracles before any number is believed:
+
+    - **exact within the precision**: the quantized engine's tokens
+      equal standalone ``paged_generate`` under the SAME quantized
+      config — quantization changes the math, never the scheduling;
+    - **the precision law across precisions**
+      (:func:`hpc_patterns_tpu.models.quantization.precision_law`):
+      teacher-forced greedy top-1 agreement and TV-distance bounds vs
+      the baseline precision — token identity cannot hold across
+      precisions, so the law is the contract (docs/quantization.md).
+
+    Reports ``quant_goodput_tok_s`` (SLO-attained tok/s of the
+    quantized engine) and ``kv_pool_bytes_frac`` (quantized pool bytes
+    / a bf16 pool at EQUAL geometry — the capacity headline; int8 and
+    fp8 land ~0.53, i.e. the residency manager's host tier, the
+    migration wire, and the prefix arena's resident count all roughly
+    double), the two keys ``bench.py`` captures and
+    ``harness/regress.py`` gates. ``race_attn``: also time the
+    quantized stream on ``decode_attn="paged_flash"`` vs the gather
+    route (the chip leg; pointless under interpret mode)."""
+    from hpc_patterns_tpu.harness.cli import resolve_kv_cache_dtype
+    from hpc_patterns_tpu.models.quantization import (
+        precision_law,
+        quantize_weights_int8,
+    )
+
+    out = print if not quiet else (lambda *a, **k: None)
+    compute_dt, kv = resolve_kv_cache_dtype(kv_dtype, note=out)
+    if kv == "compute":
+        raise SystemExit(
+            f"--quant needs a quantized --kv-dtype (int8/fp8), got "
+            f"{kv_dtype!r} — the compute-dtype rows are the ordinary "
+            "serving benches")
+    over = {"kv_cache_dtype": kv}
+    if compute_dt:
+        over["dtype"] = compute_dt
+    cfg_q = dataclasses.replace(cfg, **over)
+    params_q = quantize_weights_int8(params) if quant_weights else params
+
+    rng = np.random.RandomState(7)
+    lengths = [prompt_len // 2, (3 * prompt_len) // 4, prompt_len]
+    reqs = []
+    for _ in range(n):
+        t = int(rng.choice(lengths))
+        prompt = rng.randint(0, cfg.vocab, size=t).astype(np.int32)
+        budget = int(rng.choice(
+            [max(1, max_budget // 2), max_budget], p=[0.4, 0.6]))
+        reqs.append((prompt, budget))
+    total_tokens = sum(b for _, b in reqs)
+    buckets = bucket_ladder(prompt_len)
+    targets = slo.targets_from_classes(SCENARIO_CLASSES)
+    pages_per_seq = max(
+        ContinuousBatcher.pages_needed(len(p), b, page_size,
+                                       padded_len=pad_to_bucket(
+                                           buckets, len(p)))
+        for p, b in reqs)
+    pool = slots * pages_per_seq
+
+    # the precision LAW gate first — broken dequant must fail before
+    # any throughput number exists (TV toward 1, agreement toward 1/V)
+    law_prompts = np.stack([
+        rng.randint(0, cfg.vocab, size=prompt_len).astype(np.int32)
+        for _ in range(4)])
+    law = precision_law(params, cfg, params_q, cfg_q, law_prompts,
+                        steps=8)
+    law.check()
+
+    def run_one(c, p):
+        eng = ContinuousBatcher(
+            p, c, slots=slots, pool_pages=pool,
+            pages_per_seq=pages_per_seq, page_size=page_size,
+            chunk=chunk, prompt_buckets=buckets, slo=targets)
+        ids = [eng.submit(pr, b) for pr, b in reqs]
+        got = eng.run()
+        return {i: got[s] for i, s in enumerate(ids)}, eng
+
+    def timed(c, p):
+        run_one(c, p)  # warmup (compiles)
+        t0 = time.perf_counter()
+        got, eng = run_one(c, p)
+        return time.perf_counter() - t0, got, eng
+
+    t_base, base_out, base_eng = timed(cfg, params)
+    t_q, q_out, q_eng = timed(cfg_q, params_q)
+
+    # exact oracle WITHIN the precision: the quantized engine must be
+    # token-identical to standalone quantized decode — and the
+    # baseline to baseline decode — before any number is believed
+    for i, (prompt, b) in enumerate(reqs):
+        want = np.asarray(paged_generate(
+            params, jnp.asarray(prompt)[None], cfg, b,
+            page_size=page_size))[0]
+        np.testing.assert_array_equal(base_out[i], want,
+                                      err_msg=f"baseline seq {i}")
+        want_q = np.asarray(paged_generate(
+            params_q, jnp.asarray(prompt)[None], cfg_q, b,
+            page_size=page_size))[0]
+        np.testing.assert_array_equal(q_out[i], want_q,
+                                      err_msg=f"quantized seq {i}")
+
+    # pool bytes at EQUAL geometry: the quantized pool vs a bf16 pool
+    # (the capacity headline — measured from real allocations, scale
+    # pools included, table excluded on both sides)
+    from hpc_patterns_tpu.models.decode import init_paged_cache
+
+    def pool_bytes(c):
+        cache = init_paged_cache(c, slots, pages_per_seq, page_size,
+                                 pool_pages=pool + 1)
+        return sum(int(arr.nbytes) for name, pools in cache.items()
+                   if name != "table" for arr in pools)
+
+    bf16_cfg = dataclasses.replace(cfg, dtype="bfloat16",
+                                   kv_cache_dtype="compute")
+    q_bytes = pool_bytes(cfg_q)
+    bf16_bytes = pool_bytes(bf16_cfg)
+    bytes_frac = q_bytes / bf16_bytes
+
+    tot_base = base_eng.last_slo["total"]
+    tot_q = q_eng.last_slo["total"]
+    result = {
+        "t_baseline": t_base, "t_quant": t_q, "tokens": total_tokens,
+        "tokens_per_s_baseline": total_tokens / t_base,
+        "tokens_per_s_quant": total_tokens / t_q,
+        "baseline_goodput_tok_s": tot_base["goodput_tok_s"]
+        * base_eng._serve_s / t_base if t_base > 0 else 0.0,
+        "quant_goodput_tok_s": tot_q["goodput_tok_s"]
+        * q_eng._serve_s / t_q if t_q > 0 else 0.0,
+        "kv_pool_bytes_frac": bytes_frac,
+        "kv_pool_bytes": q_bytes, "bf16_pool_bytes": bf16_bytes,
+        "kv_dtype": kv, "quant_weights": bool(quant_weights),
+        "greedy_agreement": law.greedy_agreement,
+        "tv_mean": law.tv_mean, "tv_max": law.tv_max,
+        "baseline_bubble_frac": base_eng.last_bubble_frac,
+        "quant_bubble_frac": q_eng.last_bubble_frac,
+    }
+    out(f"quantized[{kv}{'+w8' if quant_weights else ''}]: n={n} "
+        f"slots={slots} chunk={chunk} pool={pool}p "
+        f"tokens={total_tokens}")
+    out(f"  baseline : {t_base:.3f}s  "
+        f"{result['tokens_per_s_baseline']:,.1f} tok/s  goodput "
+        f"{result['baseline_goodput_tok_s']:,.1f}  bubble "
+        f"{result['baseline_bubble_frac']:.1%}")
+    out(f"  {kv:<9}: {t_q:.3f}s  "
+        f"{result['tokens_per_s_quant']:,.1f} tok/s  goodput "
+        f"{result['quant_goodput_tok_s']:,.1f}  bubble "
+        f"{result['quant_bubble_frac']:.1%}")
+    out(f"  kv pool bytes {q_bytes:,} = {bytes_frac:.3f}x the bf16 "
+        f"pool ({bf16_bytes:,}) at equal residents")
+    out(f"  precision law: greedy agreement "
+        f"{law.greedy_agreement:.3f}, TV mean {law.tv_mean:.4f} / "
+        f"max {law.tv_max:.4f} over {law.steps} teacher-forced steps "
+        "(oracle-exact within the precision)")
+
+    if race_attn:
+        # the kernel race per precision: the SAME quantized stream on
+        # the gather route vs the exact-softmax paged kernel — the
+        # number reground step 4f exists for (interpret mode would
+        # measure the ~10x per-grid-point host cost, not the kernel)
+        cfg_pf = dataclasses.replace(cfg_q, decode_attn="paged_flash")
+        cfg_ga = dataclasses.replace(cfg_q, decode_attn="gather")
+        t_ga, ga_out, _ = timed(cfg_ga, params_q)
+        t_pf, pf_out, _ = timed(cfg_pf, params_q)
+        # the route-parity claim ON THIS BACKEND: the exact-softmax
+        # kernel mirrors the gather math. Interpret mode holds that
+        # BITWISE even for quantized pools (test-pinned), so any token
+        # flip fails loudly; on chip a quantized pool's dequant
+        # multiply order may legally differ by a ULP
+        # (ops/paged_attention.py), so the tolerance tier allows
+        # near-tie argmax flips but pins agreement — a broken kernel
+        # sends agreement toward vocab-random, not 0.999
+        n_tok = n_flip = 0
+        for i in sorted(pf_out):
+            a, b = np.asarray(pf_out[i]), np.asarray(ga_out[i])
+            n_tok += a.size
+            n_flip += int(np.sum(a != b))
+        if jax.default_backend() == "tpu":
+            agreement = 1.0 - n_flip / max(n_tok, 1)
+            assert agreement >= 0.999, (
+                f"route race token agreement {agreement:.4f} < 0.999 "
+                f"({n_flip}/{n_tok} flips) — beyond ULP near-tie "
+                "divergence, the paged_flash kernel is broken here")
+        else:
+            assert n_flip == 0, (
+                f"paged_flash vs gather: {n_flip}/{n_tok} token "
+                "mismatches in interpret mode (the bitwise contract)")
+        result["tokens_per_s_gather"] = total_tokens / t_ga
+        result["tokens_per_s_paged_flash"] = total_tokens / t_pf
+        out(f"  route race [{kv}]: gather "
+            f"{result['tokens_per_s_gather']:,.1f} tok/s vs "
+            f"paged_flash {result['tokens_per_s_paged_flash']:,.1f} "
+            f"tok/s ({t_ga / t_pf:.2f}x)")
+    return result
+
+
 def plane_smoke_config():
     """The CI plane shape (tier-1 via tests/test_bench_serving.py): a
     seeded open-loop two-class stream through (a) one engine, (b) a
@@ -1048,27 +1297,87 @@ def run_plane(*, cfg, params, n, slots, chunk, page_size, prompt_len,
     return result
 
 
+def _apply_kv_dtype(conf, kv_dtype):
+    """Thread a ``--kv-dtype`` value into a serving-bench config dict
+    (the compound rows: --offload/--plane run their whole scenario on
+    the quantized pool, so the gate sees quantization MULTIPLY the
+    other levers — double the effective HBM under residency, half the
+    migration bytes on the plane). Resolution (fp8 degrade included)
+    goes through the ONE shared resolver (harness.cli)."""
+    if not kv_dtype:
+        return conf
+    from hpc_patterns_tpu.harness.cli import resolve_kv_cache_dtype
+
+    compute_dt, kv = resolve_kv_cache_dtype(kv_dtype)
+    over = {"kv_cache_dtype": kv}
+    if compute_dt:
+        over["dtype"] = compute_dt
+    conf = dict(conf)
+    conf["cfg"] = dataclasses.replace(conf["cfg"], **over)
+    return conf
+
+
 def main():
-    if arg("shared", False, bool):
+    kv_dtype = arg("kv-dtype", None, str)
+    if kv_dtype:
+        from hpc_patterns_tpu.harness.cli import KV_DTYPE_CHOICES
+
+        kv_dtype = kv_dtype.strip().lower()
+        if kv_dtype not in KV_DTYPE_CHOICES:
+            # validate BEFORE any mode branches: --shared's quantized
+            # refusal and --quant's resolver must only ever see legal
+            # values, so a typo reads as a typo, not as a precision
+            # policy message or a resolver traceback
+            raise SystemExit(
+                f"--kv-dtype must be one of {KV_DTYPE_CHOICES}, got "
+                f"{kv_dtype!r}")
+    if arg("quant", False, bool):
         if arg("smoke", False, bool):
-            run_shared(**shared_smoke_config())
+            conf = quantized_smoke_config()
         else:
-            run_shared(**shared_full_config(
-                jax.default_backend() == "tpu"))
+            conf = quantized_full_config(jax.default_backend() == "tpu")
+        if kv_dtype:
+            conf["kv_dtype"] = kv_dtype
+        conf["quant_weights"] = arg("quant-weights", False, bool)
+        run_quantized(**conf)
+        return
+    if arg("shared", False, bool):
+        if kv_dtype and kv_dtype not in ("f32", "bf16"):
+            # the documented refusal, surfaced HERE instead of deep in
+            # the engine constructor: prefix sharing needs exact KV
+            # pages (the monolithic prefill attends to unquantized
+            # K/V, so shared dequantized pages break bitwise parity —
+            # models/serving.py, docs/quantization.md)
+            raise SystemExit(
+                f"--shared refuses --kv-dtype {kv_dtype}: prefix "
+                "sharing needs exact KV pages — the monolithic "
+                "prefill attends to unquantized K/V and quantizes "
+                "only for storage, so a tail computed from "
+                "dequantized shared pages could not be bit-identical "
+                "(docs/quantization.md); run --quant for the "
+                "quantized row or --shared at f32/bf16")
+        if arg("smoke", False, bool):
+            run_shared(**_apply_kv_dtype(shared_smoke_config(),
+                                         kv_dtype))
+        else:
+            run_shared(**_apply_kv_dtype(shared_full_config(
+                jax.default_backend() == "tpu"), kv_dtype))
         return
     if arg("offload", False, bool):
         if arg("smoke", False, bool):
-            run_offload(**offload_smoke_config())
+            run_offload(**_apply_kv_dtype(offload_smoke_config(),
+                                          kv_dtype))
         else:
-            run_offload(**offload_full_config(
-                jax.default_backend() == "tpu"))
+            run_offload(**_apply_kv_dtype(offload_full_config(
+                jax.default_backend() == "tpu"), kv_dtype))
         return
     if arg("plane", False, bool):
         if arg("smoke", False, bool):
-            run_plane(**plane_smoke_config())
+            run_plane(**_apply_kv_dtype(plane_smoke_config(),
+                                        kv_dtype))
         else:
-            run_plane(**plane_full_config(
-                jax.default_backend() == "tpu"))
+            run_plane(**_apply_kv_dtype(plane_full_config(
+                jax.default_backend() == "tpu"), kv_dtype))
         return
     if arg("scenario", False, bool):
         if arg("smoke", False, bool):

@@ -116,6 +116,29 @@ run "serving tiered HBM/host offload" python benchmarks/bench_serving.py --offlo
 #     compiler breaks it).
 run "serving shared-prefix arena" python benchmarks/bench_serving.py --shared
 
+# 4f. QUANTIZED-DECODE row (round 13): the stream served from int8 /
+#     fp8 KV pools (one-byte pages + per-row scales) and, per
+#     precision, the attention-route RACE — the quantized full config
+#     runs the same stream on decode_attn="gather" vs "paged_flash"
+#     (ops/paged_attention.py, exact-softmax gather-into-VMEM kernel)
+#     at real VMEM limits. The interpret-mode ~10x per-grid-point
+#     penalty that forced off-TPU serving onto the gather route is
+#     exactly the number this leg replaces with a chip measurement.
+#     Both precision oracles (token-identical within the precision;
+#     teacher-forced greedy-agreement + TV-distance law across
+#     precisions) run before any number prints; headline keys
+#     quant_goodput_tok_s / kv_pool_bytes_frac / quant_bubble_frac
+#     are captured by bench.py and gated by harness/regress.py.
+#     fp8 degrades to int8 with a loud note on backends without
+#     float8_e4m3fn support (dtypes.supports_fp8).
+run "serving quantized kv int8 + route race" python benchmarks/bench_serving.py --quant --kv-dtype=int8
+run "serving quantized kv fp8 + route race" python benchmarks/bench_serving.py --quant --kv-dtype=fp8
+run "serving quantized kv+weights int8" python benchmarks/bench_serving.py --quant --kv-dtype=int8 --quant-weights
+# the compound rows: quantized KV through the residency tier (double
+# effective HBM) and the serving plane (half the migration bytes)
+run "serving tiered offload @ int8 kv" python benchmarks/bench_serving.py --offload --kv-dtype=int8
+run "serving plane @ int8 kv" python benchmarks/bench_serving.py --plane --kv-dtype=int8
+
 # 5. aligned speculative pair + gamma sweep + batched impls (item 4, 7)
 run "make draft pair" python benchmarks/make_draft_pair.py --out=benchmarks/pair_r5
 run "speculative aligned sweep" python benchmarks/bench_speculative.py --pair=benchmarks/pair_r5 --batched=8

@@ -122,6 +122,17 @@ DEFAULT_DISPATCH_CRITICAL = frozenset({
     "_reclaim_cache_pages",
     "_row_swappable",
     "_row_freeable_pages",
+    # the round-13 quantized-decode paths: KV quantize/dequant and the
+    # weight dequant accessor run INSIDE the traced step (pure jnp by
+    # design), and the scale-pool write rides the same dispatch as the
+    # page write — a host readback of a scale anywhere here (e.g.
+    # float(scale.max()) to "sanity-check" a row before the write)
+    # syncs the decode chunk on exactly the bytes quantization exists
+    # to shrink
+    "_quantize_rows",
+    "_dequant",
+    "_scale_write",
+    "matmul_weight",
 })
 
 # rule names are kebab-case identifiers; anything after the last name

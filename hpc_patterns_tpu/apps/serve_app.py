@@ -31,9 +31,11 @@ from hpc_patterns_tpu.apps import common
 from hpc_patterns_tpu.harness import RunLog, Verdict
 from hpc_patterns_tpu.harness import metrics as metricslib
 from hpc_patterns_tpu.harness.cli import (
+    add_kv_dtype_arg,
     add_serving_args,
     base_parser,
     parse_buckets,
+    resolve_kv_cache_dtype,
 )
 from hpc_patterns_tpu.models import TransformerConfig, init_params
 
@@ -68,8 +70,9 @@ def build_parser():
     p.add_argument("--vocab", type=int, default=256)
     p.add_argument("--pos-embed", default="learned",
                    choices=["learned", "rope"])
-    p.add_argument("--kv-cache-dtype", default="compute",
-                   choices=["compute", "int8"])
+    # the shared serving-precision knob; bf16 = the config's default
+    # compute dtype with a scale-free cache (the pre-knob behavior)
+    add_kv_dtype_arg(p, default="bf16")
     p.add_argument("--checkpoint-dir", default=None,
                    help="serve a trained checkpoint (train_app "
                         "--checkpoint-dir); default: fresh init")
@@ -112,6 +115,13 @@ def run(args) -> int:
                   "ignored — pass one or the other")
         log.print("FAILURE")
         return 1
+    if args.draft_pair and args.kv_dtype != "bf16":
+        log.print("ERROR: --draft-pair serves from the pair's own "
+                  "compute-dtype caches (META.json configs); "
+                  f"--kv-dtype {args.kv_dtype} would be silently "
+                  "ignored — drop it or serve without the pair")
+        log.print("FAILURE")
+        return 1
     # off-TPU serving takes the pure-XLA gather route on BOTH branches
     # (the pallas kernels interpret per grid point there)
     attn = "flash" if jax.default_backend() == "tpu" else "gather"
@@ -137,12 +147,15 @@ def run(args) -> int:
             log.print(f"aligned pair from {args.draft_pair} "
                       f"(gamma={args.gamma})")
         else:
+            compute_dt, kv_dt = resolve_kv_cache_dtype(
+                args.kv_dtype, note=log.print)
             cfg = TransformerConfig(
                 vocab=args.vocab, d_model=args.d_model,
                 n_heads=args.n_heads, n_layers=args.n_layers,
                 d_ff=4 * args.d_model, max_seq=need,
                 n_kv_heads=args.n_kv_heads, pos_embed=args.pos_embed,
-                kv_cache_dtype=args.kv_cache_dtype,
+                kv_cache_dtype=kv_dt,
+                **({"dtype": compute_dt} if compute_dt else {}),
                 decode_attn=attn,
             )
     except (ValueError, FileNotFoundError, KeyError) as e:

@@ -59,6 +59,39 @@ def get_traits(dtype) -> DtypeTraits:
     return DtypeTraits(dt, dt.itemsize, True, False, 0.0)
 
 
+_FP8_SUPPORT: bool | None = None
+
+
+def supports_fp8() -> bool:
+    """Does this backend execute the fp8 KV pipeline — store
+    ``float8_e4m3fn``, convert to f32, and matmul the dequantized
+    values? Probed ONCE per process by running the exact op sequence
+    the quantized decode path uses (quantize-cast, dequant-cast, a
+    tiny f32 matmul over the result) on the default backend; any
+    lowering/execution error reads as "no". Callers
+    (``harness.cli.resolve_kv_cache_dtype`` — the serving CLIs'
+    ``--kv-dtype`` resolver) degrade fp8 to int8 WITH A NOTE instead
+    of letting the user hit a deep XLA error mid-serve."""
+    global _FP8_SUPPORT
+    if _FP8_SUPPORT is None:
+        import jax
+        import jax.numpy as jnp
+
+        try:
+            x = jnp.linspace(-1.0, 1.0, 16, dtype=jnp.float32)
+            q = (x * 448.0).astype(jnp.float8_e4m3fn)
+            # jaxlint: disable=recompile-hazard — one-shot probe: the
+            # result is memoized in _FP8_SUPPORT for the process
+            # lifetime, so this jit builds exactly once
+            y = jax.jit(lambda a: jnp.dot(
+                a.astype(jnp.float32).reshape(4, 4),
+                a.astype(jnp.float32).reshape(4, 4)))(q)
+            _FP8_SUPPORT = bool(np.isfinite(np.asarray(y)).all())
+        except Exception:  # noqa: BLE001 — any failure means "no fp8"
+            _FP8_SUPPORT = False
+    return _FP8_SUPPORT
+
+
 def validate_allreduce(result: np.ndarray, expected_scalar, dtype) -> bool:
     """The analytic-oracle check: every element equals the closed-form
     expected value (allreduce-mpi-sycl.cpp:192-204)."""

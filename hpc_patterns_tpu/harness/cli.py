@@ -109,6 +109,64 @@ def add_serving_args(p: argparse.ArgumentParser) -> None:
     )
 
 
+#: the serving precision knob's legal values — ONE definition shared
+#: by serve_app and benchmarks/bench_serving.py (the two surfaces must
+#: not drift on what "--kv-dtype fp8" means)
+KV_DTYPE_CHOICES = ("f32", "bf16", "int8", "fp8")
+
+#: --kv-dtype value -> (compute dtype override or None, kv_cache_dtype)
+_KV_DTYPE_MAP = {
+    "f32": ("float32", "compute"),
+    "bf16": ("bfloat16", "compute"),
+    "int8": (None, "int8"),
+    "fp8": (None, "fp8"),
+}
+
+
+def add_kv_dtype_arg(p: argparse.ArgumentParser,
+                     default: str = "f32") -> None:
+    """The shared ``--kv-dtype`` serving-precision flag (serve_app;
+    bench_serving mirrors it through its own flag parser but resolves
+    through the SAME :func:`resolve_kv_cache_dtype`)."""
+    p.add_argument(
+        "--kv-dtype",
+        default=default,
+        choices=list(KV_DTYPE_CHOICES),
+        help="KV-cache precision: f32/bf16 store the compute dtype "
+             "(scale-free); int8/fp8 store one byte per element with "
+             "per-row dequant scales — half the pool bytes of bf16, a "
+             "quarter of f32, dequantized in the kernel/einsum stream "
+             "(docs/quantization.md). fp8 degrades to int8 with a "
+             "note on backends without float8_e4m3fn support "
+             "(dtypes.supports_fp8)",
+    )
+
+
+def resolve_kv_cache_dtype(spec: str, *, note=print):
+    """Resolve a ``--kv-dtype`` value into ``(compute_dtype_override,
+    kv_cache_dtype)`` — compute override None means "keep the config's
+    dtype". The ONE degrade point: ``fp8`` on a backend that cannot
+    execute the fp8 pipeline becomes ``int8`` with a LOUD note (the
+    alternative is a deep XLA lowering error mid-serve), so every
+    surface that accepts the knob degrades identically."""
+    spec = (spec or "f32").strip().lower()
+    if spec not in _KV_DTYPE_MAP:
+        raise argparse.ArgumentTypeError(
+            f"--kv-dtype must be one of {KV_DTYPE_CHOICES}, got "
+            f"{spec!r}")
+    compute, kv = _KV_DTYPE_MAP[spec]
+    if kv == "fp8":
+        from hpc_patterns_tpu import dtypes
+
+        if not dtypes.supports_fp8():
+            note("NOTE: backend cannot execute float8_e4m3fn "
+                 "(dtypes.supports_fp8 probe failed) — degrading "
+                 "--kv-dtype fp8 to int8 (same pool bytes, integer "
+                 "grid instead of a floating one)")
+            kv = "int8"
+    return compute, kv
+
+
 def parse_buckets(spec: str, max_prompt_len: int):
     """Resolve an ``--prompt-buckets`` value into a ladder tuple or
     None: 'none' disables bucketing, 'auto' builds the default ladder

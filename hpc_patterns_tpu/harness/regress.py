@@ -135,6 +135,17 @@ SPECS: tuple[MetricSpec, ...] = (
     MetricSpec("detail.shared_goodput_tok_s", "higher"),
     MetricSpec("detail.prefill_skip_frac", "higher",
                abs_slack=0.10),
+    # the quantized-decode row (bench_serving --quant, round 13):
+    # quantized goodput is the SLO-attained tok/s of an int8-KV engine
+    # (both precision oracles — exact-within-precision and the
+    # teacher-forced TV/greedy law — pass before the number exists),
+    # and the pool-bytes fraction is the measured quantized-pool bytes
+    # over a bf16 pool at equal residents. The fraction is pure
+    # dtype geometry (~0.53), so its band is tight: a scale-pool
+    # layout change that silently doubles the overhead regresses here.
+    MetricSpec("detail.quant_goodput_tok_s", "higher"),
+    MetricSpec("detail.kv_pool_bytes_frac", "lower", abs_slack=0.02),
+    MetricSpec("detail.quant_bubble_frac", "lower", abs_slack=0.05),
 )
 
 

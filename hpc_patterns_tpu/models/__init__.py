@@ -40,3 +40,7 @@ from hpc_patterns_tpu.models.speculative import (  # noqa: F401
     speculative_generate,
     speculative_generate_batched,
 )
+from hpc_patterns_tpu.models.quantization import (  # noqa: F401
+    precision_law,
+    quantize_weights_int8,
+)

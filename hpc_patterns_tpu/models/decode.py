@@ -55,6 +55,7 @@ from hpc_patterns_tpu.models.transformer import (
     TransformerConfig,
     _rmsnorm,
     apply_rope,
+    matmul_weight,
     project_qkv,
 )
 from hpc_patterns_tpu.parallel.ring_attention import full_attention
@@ -131,13 +132,49 @@ def _flash_route(mesh, cfg: TransformerConfig):
     return use_flash, flash_sharded
 
 
-def _quantize_rows(x):
-    """Per-row symmetric int8 quantization of (..., D) rows: returns
-    (int8 values, f32 scales shaped (...,)) with x ~= q * scale."""
+#: KV storage dtypes carrying per-row dequant scales (the quantized
+#: cache family; "compute" stores the model dtype scale-free)
+KV_QUANTIZED = ("int8", "fp8")
+
+#: float8_e4m3fn's largest finite value — the fp8 analog of int8's 127
+FP8_MAX = 448.0
+
+
+def _kv_quantized(cfg: TransformerConfig) -> bool:
+    return cfg.kv_cache_dtype in KV_QUANTIZED
+
+
+def _kv_storage_dtype(cfg: TransformerConfig):
+    """The dtype KV bytes are STORED in: the compute dtype, int8, or
+    float8_e4m3fn — one byte per element for both quantized forms, so
+    the pool-byte win is identical; fp8 trades int8's uniform grid for
+    a floating one (more headroom inside a row's dynamic range).
+    Backends without fp8 support surface through
+    :func:`hpc_patterns_tpu.dtypes.supports_fp8` — callers (the
+    serving CLIs) degrade to int8 with a note instead of hitting a
+    deep XLA lowering error."""
+    if cfg.kv_cache_dtype == "int8":
+        return jnp.int8
+    if cfg.kv_cache_dtype == "fp8":
+        return jnp.float8_e4m3fn
+    return jnp.dtype(cfg.dtype)
+
+
+def _quantize_rows(x, kv_dtype: str = "int8"):
+    """Per-row symmetric quantization of (..., D) rows: returns
+    (quantized values, f32 scales shaped (...,)) with x ~= q * scale.
+    ``kv_dtype``: "int8" (round-to-nearest onto the +-127 integer
+    grid) or "fp8" (scale the row's amax onto float8_e4m3fn's +-448
+    range and let the float cast do the rounding)."""
     amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
-    scale = jnp.maximum(amax / 127.0, 1e-8)
-    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
-                 -127, 127).astype(jnp.int8)
+    if kv_dtype == "fp8":
+        scale = jnp.maximum(amax / FP8_MAX, 1e-8)
+        q = (x.astype(jnp.float32)
+             / scale[..., None]).astype(jnp.float8_e4m3fn)
+    else:
+        scale = jnp.maximum(amax / 127.0, 1e-8)
+        q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                     -127, 127).astype(jnp.int8)
     return q, scale
 
 
@@ -147,10 +184,11 @@ def _dequant(cache, scale):
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
     """Zeroed KV cache: {"k","v"}: PER-LAYER tuples of (B, kv_heads,
-    max_len, head_dim) in the compute dtype — or int8 when
-    cfg.kv_cache_dtype == "int8", with per-row f32 dequant scales in
-    extra "k_scale"/"v_scale" tuples (B, kv_heads, max_len), halving
-    the cache bytes — (kernel layout: the
+    max_len, head_dim) in the compute dtype — or the one-byte storage
+    dtype when cfg.kv_cache_dtype is quantized ("int8"/"fp8"), with
+    per-row f32 dequant scales in extra "k_scale"/"v_scale" tuples
+    (B, kv_heads, max_len), halving the cache bytes vs bf16 —
+    (kernel layout: the
     sequence axis contiguous per (batch, kv head) row, what
     ops/flash_decode.py streams). Per-layer arrays — not one stacked
     (L, ...) block — so each decode step's dynamic_update_slice aliases
@@ -160,8 +198,7 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
     re-materializes every byte every token — measured 25 ms/token at an
     8k cache where the read cost is ~3 ms). GQA stores kv_heads only —
     the cache is n_heads/kv_heads times smaller than MHA's."""
-    dt = (jnp.int8 if cfg.kv_cache_dtype == "int8"
-          else jnp.dtype(cfg.dtype))
+    dt = _kv_storage_dtype(cfg)
     shape = (batch, cfg.kv_heads, max_len, cfg.head_dim)
     # independent buffers per key AND per layer: sharing one zeros tuple
     # would alias k and v, and a donated jit would then double-donate
@@ -170,7 +207,7 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
     fresh = lambda sh, d: tuple(jnp.zeros(sh, d)
                                 for _ in range(cfg.n_layers))
     cache = {"k": fresh(shape, dt), "v": fresh(shape, dt)}
-    if cfg.kv_cache_dtype == "int8":
+    if _kv_quantized(cfg):
         # per-row dequant scales ride alongside (tiny: D times smaller)
         cache["k_scale"] = fresh(shape[:-1], jnp.float32)
         cache["v_scale"] = fresh(shape[:-1], jnp.float32)
@@ -198,8 +235,8 @@ def _mlp(x, lp, cfg: TransformerConfig):
                              capacity=flat.shape[0],
                              top_k=cfg.n_experts_top_k)
         return x + y.reshape(*lead, D).astype(dt)
-    h = jax.nn.gelu(jnp.dot(h, lp["w1"].astype(dt)))
-    return x + jnp.dot(h, lp["w2"].astype(dt))
+    h = jax.nn.gelu(jnp.dot(h, matmul_weight(lp, "w1", dt)))
+    return x + jnp.dot(h, matmul_weight(lp, "w2", dt))
 
 
 def prefill(params, prompt, cfg: TransformerConfig, max_len: int,
@@ -265,7 +302,8 @@ def prefill(params, prompt, cfg: TransformerConfig, max_len: int,
                 o = flash_attention(q, k, v, causal=True)
         else:
             o = full_attention(q, k, v, causal=True)
-        o = jnp.dot(o.reshape(B, T, cfg.d_model), lp["wo"].astype(dt))
+        o = jnp.dot(o.reshape(B, T, cfg.d_model),
+                    matmul_weight(lp, "wo", dt))
         h = _mlp(h + o.astype(dt), lp, cfg)
         # capture in kernel layout (B, Hkv, T, D), padded to the static
         # cache length — one transpose at prefill, zero per decode step
@@ -281,11 +319,12 @@ def prefill(params, prompt, cfg: TransformerConfig, max_len: int,
     else:
         lp = jnp.broadcast_to(jnp.asarray(last_pos, jnp.int32), (B,))
         x_last = jnp.take_along_axis(x, lp[:, None, None], axis=1)[:, 0]
-    logits = jnp.dot(x_last, params["lm_head"].astype(dt))
+    logits = jnp.dot(x_last, matmul_weight(params, "lm_head", dt))
     L = cfg.n_layers
-    if cfg.kv_cache_dtype == "int8":
-        kq, ksc = zip(*(_quantize_rows(ks[l]) for l in range(L)))
-        vq, vsc = zip(*(_quantize_rows(vs[l]) for l in range(L)))
+    if _kv_quantized(cfg):
+        kvd = cfg.kv_cache_dtype
+        kq, ksc = zip(*(_quantize_rows(ks[l], kvd) for l in range(L)))
+        vq, vsc = zip(*(_quantize_rows(vs[l], kvd) for l in range(L)))
         cache = {
             "k": tuple(kq), "v": tuple(vq),
             "k_scale": tuple(ksc), "v_scale": tuple(vsc),
@@ -340,11 +379,11 @@ def _token_step(params, pos, tokens, cfg: TransformerConfig,
         # the kv_heads-narrow cache read (the saving GQA exists for)
         o, st = attend_update(q, k_new, v_new, layer_states[l])
         o = jnp.dot(o.reshape(B, cfg.d_model).astype(dt),
-                    lp["wo"].astype(dt))
+                    matmul_weight(lp, "wo", dt))
         x = _mlp(x + o, lp, cfg)
         new_states.append(st)
     x = _rmsnorm(x, params["ln_f_scale"])
-    logits = jnp.dot(x, params["lm_head"].astype(dt))
+    logits = jnp.dot(x, matmul_weight(params, "lm_head", dt))
     return logits.astype(jnp.float32), new_states
 
 
@@ -367,13 +406,13 @@ def decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
     use_flash, flash_sharded = _flash_route(mesh, cfg)
 
     Hkv, g, Dh = cfg.kv_heads, cfg.n_heads // cfg.kv_heads, cfg.head_dim
-    int8_cache = cfg.kv_cache_dtype == "int8"
+    quant_cache = _kv_quantized(cfg)
 
     def attend_update(q, k_new, v_new, state):
         k_cache, v_cache, k_scale, v_scale = state
-        if int8_cache:
-            k_q, k_s = _quantize_rows(k_new)
-            v_q, v_s = _quantize_rows(v_new)
+        if quant_cache:
+            k_q, k_s = _quantize_rows(k_new, cfg.kv_cache_dtype)
+            v_q, v_s = _quantize_rows(v_new, cfg.kv_cache_dtype)
             k_cache = lax.dynamic_update_slice(
                 k_cache, k_q[:, :, None], (0, 0, pos, 0)
             )
@@ -407,7 +446,7 @@ def decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
                 args = [q, k_cache, v_cache,
                         jnp.asarray(pos, jnp.int32).reshape(1)]
                 specs = [spec_q, spec_c, spec_c, P()]
-                if int8_cache:
+                if quant_cache:
                     args += [k_scale, v_scale]
                     specs += [spec_q] * 2  # scale rows are 3-D too
 
@@ -434,7 +473,7 @@ def decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
             # rounds its inputs to bf16 on the MXU; true f32 here both
             # matches the flash kernel's f32 math (greedy tokens agree
             # across impls) and is free — the step is cache-read-bound
-            if int8_cache:
+            if quant_cache:
                 kd = _dequant(k_cache, k_scale)
                 vd = _dequant(v_cache, v_scale)
             else:
@@ -454,15 +493,15 @@ def decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
 
     states = [
         (cache["k"][l], cache["v"][l],
-         cache["k_scale"][l] if int8_cache else None,
-         cache["v_scale"][l] if int8_cache else None)
+         cache["k_scale"][l] if quant_cache else None,
+         cache["v_scale"][l] if quant_cache else None)
         for l in range(cfg.n_layers)
     ]
     logits, new_states = _token_step(params, pos, tokens, cfg,
                                      states, attend_update)
     new_cache = {"k": tuple(s[0] for s in new_states),
                  "v": tuple(s[1] for s in new_states)}
-    if int8_cache:
+    if quant_cache:
         new_cache["k_scale"] = tuple(s[2] for s in new_states)
         new_cache["v_scale"] = tuple(s[3] for s in new_states)
     return logits, new_cache
@@ -529,7 +568,7 @@ def extend_step(params, cache, pos, tokens, cfg: TransformerConfig):
                        v_cache.astype(jnp.float32),
                        precision=lax.Precision.HIGHEST)
         o = jnp.dot(o.reshape(B, c, cfg.d_model).astype(dt),
-                    lp["wo"].astype(dt))
+                    matmul_weight(lp, "wo", dt))
         h = _mlp(h + o, lp, cfg)
         return h, (k_cache, v_cache)
 
@@ -540,7 +579,7 @@ def extend_step(params, cache, pos, tokens, cfg: TransformerConfig):
         ks.append(k_l)
         vs.append(v_l)
     x = _rmsnorm(x, params["ln_f_scale"])
-    logits = jnp.dot(x, params["lm_head"].astype(dt))
+    logits = jnp.dot(x, matmul_weight(params, "lm_head", dt))
     return logits.astype(jnp.float32), {"k": tuple(ks), "v": tuple(vs)}
 
 
@@ -665,11 +704,12 @@ def init_paged_cache(cfg: TransformerConfig, batch: int,
     (batch, pages_per_seq) int32 page ids (default: the identity
     layout; any permutation is equally valid — the kernel indirects
     through the table, which is what makes future dynamic allocation
-    policies free). With ``cfg.kv_cache_dtype == "int8"`` the pools are
-    int8 plus per-row f32 scale pools (kernel-lane layout
-    (pool_pages, kv_heads, 1, page_size)) — the two CAPACITY levers
-    stack: int8 halves page bytes, paging frees the
-    allocate-for-longest waste."""
+    policies free). With a quantized ``cfg.kv_cache_dtype`` ("int8" or
+    "fp8") the pools store one byte per element plus per-row f32 scale
+    pools (kernel-lane layout (pool_pages, kv_heads, 1, page_size)) —
+    the two CAPACITY levers stack: quantization halves page bytes vs
+    bf16 (quarters vs f32), paging frees the allocate-for-longest
+    waste (docs/quantization.md)."""
     if pool_pages is None:
         pool_pages = batch * pages_per_seq
     if table is None:
@@ -686,14 +726,14 @@ def init_paged_cache(cfg: TransformerConfig, batch: int,
             )
         table = jnp.arange(batch * pages_per_seq, dtype=jnp.int32)
         table = table.reshape(batch, pages_per_seq)
-    int8 = cfg.kv_cache_dtype == "int8"
-    dt = jnp.int8 if int8 else jnp.dtype(cfg.dtype)
+    quant = _kv_quantized(cfg)
+    dt = _kv_storage_dtype(cfg)
     shape = (pool_pages, cfg.kv_heads, page_size, cfg.head_dim)
     fresh = lambda sh, d: tuple(jnp.zeros(sh, d)
                                 for _ in range(cfg.n_layers))
     cache = {"k": fresh(shape, dt), "v": fresh(shape, dt),
              "table": jnp.asarray(table, jnp.int32)}
-    if int8:
+    if quant:
         sshape = (pool_pages, cfg.kv_heads, 1, page_size)
         cache["k_scale"] = fresh(sshape, jnp.float32)
         cache["v_scale"] = fresh(sshape, jnp.float32)
@@ -749,7 +789,7 @@ def paged_prefill(params, prompt, cfg: TransformerConfig, cache,
             )
             pool[l] = pool[l].at[idx].set(pages.astype(pool[l].dtype))
         out[name] = tuple(pool)
-    if cfg.kv_cache_dtype == "int8":
+    if _kv_quantized(cfg):
         for name in ("k_scale", "v_scale"):
             pool = list(cache[name])
             for l in range(cfg.n_layers):
@@ -804,15 +844,20 @@ def paged_tail_prefill(params, tail, cfg: TransformerConfig, cache,
     term for term — same grouped-score/grouped-pv einsums, same mask
     constant, same softmax axis length ``M + c``.
 
-    int8 KV pools are refused: the monolithic prefill attends to the
-    EXACT K/V and quantizes only for storage, so a tail computed from
-    dequantized prefix pages could not be bit-equal."""
-    if cfg.kv_cache_dtype == "int8":
+    Quantized KV pools (``kv_cache_dtype`` "int8"/"fp8") are refused:
+    the monolithic prefill attends to the EXACT K/V and quantizes only
+    for storage, so a tail computed from dequantized prefix pages
+    could not be bit-equal."""
+    if _kv_quantized(cfg):
         raise ValueError(
-            "paged_tail_prefill: int8 KV pools cannot share prefixes "
-            "bitwise — the monolithic prefill attends to exact K/V and "
-            "quantizes only for storage; a tail computed from "
-            "dequantized pages would diverge in ULPs")
+            f"paged_tail_prefill: kv_cache_dtype="
+            f"{cfg.kv_cache_dtype!r} pools cannot share prefixes "
+            "bitwise — the monolithic prefill attends to exact K/V "
+            "and quantizes only for storage, so a tail computed from "
+            "dequantized shared pages would diverge in ULPs and break "
+            "the parity contract; serve quantized KV with "
+            "prefix_cache=False (or keep sharing on a compute-dtype "
+            "pool) — docs/quantization.md")
     from hpc_patterns_tpu.parallel.ring_attention import (
         _NEG_INF,
         _grouped_pv,
@@ -878,7 +923,8 @@ def paged_tail_prefill(params, tail, cfg: TransformerConfig, cache,
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhtd->bthd", _grouped_pv(p, v_ctx)).astype(
             q.dtype)
-        o = jnp.dot(o.reshape(B, c, cfg.d_model), lp["wo"].astype(dt))
+        o = jnp.dot(o.reshape(B, c, cfg.d_model),
+                    matmul_weight(lp, "wo", dt))
         h = _mlp(h + o.astype(dt), lp, cfg)
         kc = jnp.einsum("bthd->bhtd", k)
         vc = jnp.einsum("bthd->bhtd", v)
@@ -891,8 +937,8 @@ def paged_tail_prefill(params, tail, cfg: TransformerConfig, cache,
     else:
         lp_ = jnp.broadcast_to(jnp.asarray(last_pos, jnp.int32), (B,))
         x_last = jnp.take_along_axis(x, lp_[:, None, None], axis=1)[:, 0]
-    logits = jnp.dot(x_last, params["lm_head"].astype(dt)).astype(
-        jnp.float32)
+    logits = jnp.dot(x_last, matmul_weight(params, "lm_head",
+                                           dt)).astype(jnp.float32)
 
     # scatter the tail pages exactly as paged_prefill does: pad the
     # tail K/V to the page boundary with zeros (the monolithic path's
@@ -986,7 +1032,7 @@ def _paged_attend_gather(q, k_pool, v_pool, ks_pool, vs_pool, table,
     B, pages = table.shape
     Hkv, g, Dh = cfg.kv_heads, cfg.n_heads // cfg.kv_heads, cfg.head_dim
     P = k_pool.shape[2]
-    int8 = ks_pool is not None
+    quant = ks_pool is not None
 
     def view(pool):  # (pool, Hkv, P, D) -> (B, Hkv, pages*P, D)
         gat = pool[table]  # (B, pages, Hkv, P, D)
@@ -998,7 +1044,7 @@ def _paged_attend_gather(q, k_pool, v_pool, ks_pool, vs_pool, table,
         return jnp.einsum("bphs->bhps", gat).reshape(B, Hkv, pages * P)
 
     kd, vd = view(k_pool), view(v_pool)
-    if int8:
+    if quant:
         kd = kd * scale_view(ks_pool)[..., None]
         vd = vd * scale_view(vs_pool)[..., None]
     qg = q.reshape(B, Hkv, g, Dh)
@@ -1027,6 +1073,11 @@ def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
     per row; the cache write scatters per-row offsets).
     ``cfg.decode_attn`` routes the attention like the linear step:
     "flash" (default) streams live pages through the pallas kernel;
+    "paged_flash" gathers the live pages into VMEM through the table
+    and runs the exact-softmax paged kernel
+    (:func:`~hpc_patterns_tpu.ops.paged_attention.
+    paged_attention_decode` — bitwise the gather route's math on
+    compute-dtype pools, in-kernel dequant of int8/fp8);
     "gather" takes :func:`_paged_attend_gather` — the pure-XLA view
     that serving uses off-TPU (a pallas_call interprets per grid point
     there) and that partitions via GSPMD under any tp. ``mesh``:
@@ -1095,24 +1146,46 @@ def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
         page_ids = jnp.take(table, page, axis=1)  # (B,)
     offset = pos % P
 
-    int8 = cfg.kv_cache_dtype == "int8"
+    quant = _kv_quantized(cfg)
     ident = identity_layout and not ragged
     pages = table.shape[1]
     tp = _tp_size(mesh, cfg)
-    use_flash = cfg.decode_attn == "flash"
-    if use_flash and tp > 1 and cfg.kv_heads % tp:
+    # THE paged routing decision (one place, like _flash_route on the
+    # linear path): "flash" streams pages through flash_decode_paged,
+    # "paged_flash" gathers them into VMEM through the table and runs
+    # the exact-softmax kernel (ops/paged_attention.py — bitwise the
+    # gather route's math on compute-dtype pools, in-kernel dequant on
+    # quantized ones), "gather" is the pure-XLA view. Both kernels
+    # shard_map over tp with whole kv-head blocks per rank.
+    kernel_route = cfg.decode_attn if cfg.decode_attn in (
+        "flash", "paged_flash") else None
+    if kernel_route and tp > 1 and cfg.kv_heads % tp:
         raise ValueError(
             f"paged tp serving needs tp {tp} to divide kv_heads "
             f"{cfg.kv_heads} (whole kv-head blocks per rank) — or "
             "decode_attn='gather', which partitions via GSPMD"
         )
-    paged_sharded = use_flash and tp > 1
+    paged_sharded = kernel_route is not None and tp > 1
+    if kernel_route == "paged_flash":
+        from hpc_patterns_tpu.ops.paged_attention import (
+            paged_attention_decode,
+        )
+
+        def kernel_fn(q, kp, vp, tbl, p, ksp, vsp):
+            return paged_attention_decode(
+                q, kp, vp, tbl, p, k_scale_pool=ksp, v_scale_pool=vsp,
+                scale=scale)
+    else:
+        def kernel_fn(q, kp, vp, tbl, p, ksp, vsp):
+            return flash_decode_paged(
+                q, kp, vp, tbl, p, k_scale_pool=ksp, v_scale_pool=vsp,
+                scale=scale, pages_per_step=pages_per_step)
 
     def attend_update(q, k_new, v_new, state):
         k_pool, v_pool, ks_pool, vs_pool = state
-        if int8:
-            k_new, k_s = _quantize_rows(k_new)
-            v_new, v_s = _quantize_rows(v_new)
+        if quant:
+            k_new, k_s = _quantize_rows(k_new, cfg.kv_cache_dtype)
+            v_new, v_s = _quantize_rows(v_new, cfg.kv_cache_dtype)
             ks_pool = _scale_write(ks_pool, page_ids, page, offset, k_s,
                                    pages, ident)
             vs_pool = _scale_write(vs_pool, page_ids, page, offset, v_s,
@@ -1121,7 +1194,7 @@ def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
                              pages, ident)
         v_pool = _pool_write(v_pool, page_ids, page, offset, v_new,
                              pages, ident)
-        if not use_flash:
+        if kernel_route is None:
             o = _paged_attend_gather(q, k_pool, v_pool, ks_pool,
                                      vs_pool, table, pos, cfg, scale)
         elif paged_sharded:
@@ -1137,16 +1210,13 @@ def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
                        else jnp.asarray(pos, jnp.int32).reshape(1))
             args = [q, k_pool, v_pool, table, pos_arr]
             specs = [spec_q, spec_pool, spec_pool, PS(), PS()]
-            if int8:
+            if quant:
                 args += [ks_pool, vs_pool]
                 specs += [spec_pool, spec_pool]
 
             def local_attn(q, kp, vp, tbl, p, ksp=None, vsp=None):
-                return flash_decode_paged(
-                    q, kp, vp, tbl, p if ragged else p[0],
-                    k_scale_pool=ksp, v_scale_pool=vsp, scale=scale,
-                    pages_per_step=pages_per_step,
-                )
+                return kernel_fn(q, kp, vp, tbl,
+                                 p if ragged else p[0], ksp, vsp)
 
             o = shard_map(
                 local_attn, mesh=mesh, in_specs=tuple(specs),
@@ -1154,16 +1224,14 @@ def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
                 check_vma=False,  # pallas_call can't declare vma
             )(*args)
         else:
-            o = flash_decode_paged(q, k_pool, v_pool, table, pos,
-                                   k_scale_pool=ks_pool,
-                                   v_scale_pool=vs_pool, scale=scale,
-                                   pages_per_step=pages_per_step)
+            o = kernel_fn(q, k_pool, v_pool, table, pos, ks_pool,
+                          vs_pool)
         return o, (k_pool, v_pool, ks_pool, vs_pool)
 
     states = [
         (cache["k"][l], cache["v"][l],
-         cache["k_scale"][l] if int8 else None,
-         cache["v_scale"][l] if int8 else None)
+         cache["k_scale"][l] if quant else None,
+         cache["v_scale"][l] if quant else None)
         for l in range(cfg.n_layers)
     ]
     logits, new_states = _token_step(params, pos, tokens, cfg,
@@ -1173,7 +1241,7 @@ def paged_decode_step(params, cache, pos, tokens, cfg: TransformerConfig,
         "v": tuple(s[1] for s in new_states),
         "table": table,
     }
-    if int8:
+    if quant:
         out["k_scale"] = tuple(s[2] for s in new_states)
         out["v_scale"] = tuple(s[3] for s in new_states)
     return logits, out
@@ -1201,7 +1269,7 @@ def paged_extend_step(params, cache, pos, tokens, cfg: TransformerConfig):
     position < pages_per_seq * page_size; concrete ``pos`` is checked,
     traced ``pos`` clamps silently past capacity.
     """
-    int8 = cfg.kv_cache_dtype == "int8"
+    quant = _kv_quantized(cfg)
     dt = jnp.dtype(cfg.dtype)
     B, c = tokens.shape
     if jnp.ndim(pos) != 1 or jnp.shape(pos)[0] != B:
@@ -1248,16 +1316,16 @@ def paged_extend_step(params, cache, pos, tokens, cfg: TransformerConfig):
             k_new = apply_rope(k_new, positions, cfg)
         rows_k = k_new.reshape(B * c, Hkv, Dh)
         rows_v = v_new.reshape(B * c, Hkv, Dh)
-        if int8:
-            rows_k, k_s = _quantize_rows(rows_k)
-            rows_v, v_s = _quantize_rows(rows_v)
+        if quant:
+            rows_k, k_s = _quantize_rows(rows_k, cfg.kv_cache_dtype)
+            rows_v, v_s = _quantize_rows(rows_v, cfg.kv_cache_dtype)
             ks_pool = ks_pool.at[pids, :, 0, off].set(k_s)
             vs_pool = vs_pool.at[pids, :, 0, off].set(v_s)
         k_pool = k_pool.at[pids, :, off, :].set(
             rows_k.astype(k_pool.dtype))
         v_pool = v_pool.at[pids, :, off, :].set(
             rows_v.astype(v_pool.dtype))
-        if int8:
+        if quant:
             kd = (lin_view(k_pool).astype(jnp.float32)
                   * lin_scales(ks_pool)[..., None])
             vd = (lin_view(v_pool).astype(jnp.float32)
@@ -1277,7 +1345,7 @@ def paged_extend_step(params, cache, pos, tokens, cfg: TransformerConfig):
         o = jnp.einsum("bkgcs,bksd->bckgd", p, vd,
                        precision=lax.Precision.HIGHEST)
         o = jnp.dot(o.reshape(B, c, cfg.d_model).astype(dt),
-                    lp["wo"].astype(dt))
+                    matmul_weight(lp, "wo", dt))
         h = _mlp(h + o, lp, cfg)
         return h, (k_pool, v_pool, ks_pool, vs_pool)
 
@@ -1286,18 +1354,18 @@ def paged_extend_step(params, cache, pos, tokens, cfg: TransformerConfig):
         lp = jax.tree.map(lambda a: a[l], params["layers"])
         x, st = body(x, lp, (
             cache["k"][l], cache["v"][l],
-            cache["k_scale"][l] if int8 else None,
-            cache["v_scale"][l] if int8 else None,
+            cache["k_scale"][l] if quant else None,
+            cache["v_scale"][l] if quant else None,
         ))
         states.append(st)
     x = _rmsnorm(x, params["ln_f_scale"])
-    logits = jnp.dot(x, params["lm_head"].astype(dt))
+    logits = jnp.dot(x, matmul_weight(params, "lm_head", dt))
     out = {
         "k": tuple(s[0] for s in states),
         "v": tuple(s[1] for s in states),
         "table": table,
     }
-    if int8:
+    if quant:
         out["k_scale"] = tuple(s[2] for s in states)
         out["v_scale"] = tuple(s[3] for s in states)
     return logits.astype(jnp.float32), out

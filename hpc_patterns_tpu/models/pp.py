@@ -410,6 +410,16 @@ def pp_loss_and_grads(params, tokens, cfg: TransformerConfig, mesh,
     shared across tp. MoE stages reject tp.
     """
     M = microbatches
+    from hpc_patterns_tpu.models.transformer import QUANT_SCALE_SUFFIX
+
+    if any(k.endswith(QUANT_SCALE_SUFFIX)
+           for k in (*params, *params["layers"])):
+        raise ValueError(
+            "pp_loss_and_grads refuses an int8-quantized params tree "
+            "(quantize_weights_int8): the pipeline's stage math spells "
+            "its own matmuls and would apply raw int8 magnitudes — "
+            "quantized weights are a decode-serving artifact "
+            "(transformer.matmul_weight; docs/quantization.md)")
     pp = mesh.shape[axis_pp]
     L = cfg.n_layers
     if L % pp:

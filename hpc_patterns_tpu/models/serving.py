@@ -682,7 +682,7 @@ class EngineCore:
     on the prefill's row count, and the tail prefill mirrors the
     monolithic einsum prefill bit for bit (the parity contract in
     :func:`~hpc_patterns_tpu.models.decode.paged_tail_prefill`).
-    Requires an aligned bucket ladder; refuses int8 KV and draft
+    Requires an aligned bucket ladder; refuses quantized KV and draft
     engines. Composes with preemption/shed (decref, re-match on
     resume), migration (bundles carry prefix refs a warm destination
     resolves — or it materializes), and residency (shared pages are
@@ -736,12 +736,16 @@ class EngineCore:
                     "prefix sharing does not compose with draft-"
                     "assisted serving: the draft cache's pages would "
                     "need their own refcounted sharing tier")
-            if cfg.kv_cache_dtype == "int8":
+            if cfg.kv_cache_dtype != "compute":
                 raise ValueError(
-                    "prefix sharing needs exact KV pages: the "
-                    "monolithic prefill attends to unquantized K/V, so "
-                    "a tail computed from dequantized int8 pages could "
-                    "not be bit-identical to it")
+                    f"prefix sharing needs exact KV pages but "
+                    f"kv_cache_dtype={cfg.kv_cache_dtype!r}: the "
+                    "monolithic prefill attends to unquantized K/V "
+                    "and quantizes only for storage, so a tail "
+                    "computed from dequantized shared pages could not "
+                    "be bit-identical to it — serve quantized KV with "
+                    "prefix_cache=False, or keep sharing on a "
+                    "compute-dtype pool (docs/quantization.md)")
             if prompt_buckets is None:
                 raise ValueError(
                     "prefix sharing is RUNG-KEYED (prefix K/V bytes "

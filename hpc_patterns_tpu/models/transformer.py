@@ -142,12 +142,23 @@ class TransformerConfig:
     # "flash" = the single-query Pallas kernel streaming the live cache
     # prefix (ops/flash_decode.py); "gather" = the XLA einsum+mask path
     # over the full static cache — required for GSPMD-sharded (tp)
-    # serving, where einsums partition but a pallas_call does not
+    # serving, where einsums partition but a pallas_call does not;
+    # "paged_flash" = the paged-pool Pallas kernel
+    # (ops/paged_attention.py): pages gather through the table into
+    # VMEM with a clamped index map (unfilled pages are never fetched)
+    # and the attention mirrors the gather math term for term —
+    # bitwise-equal to "gather" on compute-dtype pools, in-kernel
+    # dequant on int8/fp8 pools. Paged routes only; the linear-cache
+    # paths (prefill, decode_step) treat it as "gather", so prefill
+    # bytes stay identical between the two routes.
     decode_attn: str = "flash"
-    # KV-cache storage dtype for decode: "compute" (the model dtype) or
+    # KV-cache storage dtype for decode: "compute" (the model dtype),
     # "int8" (per-row symmetric quantization — HALF the cache bytes and
     # per-step read traffic on the cache-read-bound decode path;
-    # dequantized in the kernel/einsum stream)
+    # dequantized in the kernel/einsum stream), or "fp8"
+    # (float8_e4m3fn storage with the same per-row scale layout — the
+    # same byte win with ~2 more bits of mantissa headroom; probe
+    # backend support with dtypes.supports_fp8, docs/quantization.md)
     kv_cache_dtype: str = "compute"
     # mesh axis names (data / sequence(context) / tensor / expert)
     axis_dp: str = "dp"
@@ -209,14 +220,15 @@ class TransformerConfig:
                 f"n_experts_top_k {self.n_experts_top_k} outside "
                 f"[1, n_experts={self.n_experts}]"
             )
-        if self.kv_cache_dtype not in ("compute", "int8"):
+        if self.kv_cache_dtype not in ("compute", "int8", "fp8"):
             raise ValueError(
                 f"kv_cache_dtype {self.kv_cache_dtype!r} not in "
-                "('compute', 'int8')"
+                "('compute', 'int8', 'fp8')"
             )
-        if self.decode_attn not in ("flash", "gather"):
+        if self.decode_attn not in ("flash", "gather", "paged_flash"):
             raise ValueError(
-                f"decode_attn {self.decode_attn!r} not in ('flash', 'gather')"
+                f"decode_attn {self.decode_attn!r} not in "
+                "('flash', 'gather', 'paged_flash')"
             )
         if self.mlp_impl not in ("dense", "fused"):
             raise ValueError(
@@ -276,6 +288,92 @@ def init_params(key, cfg: TransformerConfig):
     }
 
 
+#: sibling-key suffix carrying a quantized weight's per-output-channel
+#: dequant scales (see :func:`quantize_weights_int8`). Riding INSIDE
+#: the params tree (not a parallel tree) keeps every existing
+#: per-layer slice (``jax.tree.map(lambda a: a[l], ...)``, the prefill
+#: ``lax.scan``) working unchanged — the scales slice with their
+#: weights.
+QUANT_SCALE_SUFFIX = "_qscale"
+
+
+def _quantize_channels(w):
+    """Per-output-channel symmetric int8 quantization of a matmul
+    weight ``(..., d_in, d_out)``: returns (int8 values, f32 scales
+    shaped ``(..., d_out)``) with ``w ~= q * scale``. Output-channel
+    granularity because the matmul contracts over ``d_in``: every
+    element of an output column shares one scale, so dequant folds
+    into the column (lane) axis of the product stream."""
+    w32 = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=-2)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w32 / scale[..., None, :]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+#: the decode-matmul weights :func:`quantize_weights_int8` covers —
+#: every per-layer GEMM of the decode step (qkv projection, attention
+#: output, MLP up/down) plus the lm_head below
+QUANTIZED_LAYER_WEIGHTS = ("wqkv", "wo", "w1", "w2")
+
+
+def quantize_weights_int8(params):
+    """Opt-in int8 weight quantization for the DECODE matmuls: every
+    2-D GEMM weight of the step (``wqkv``/``wo``/``w1``/``w2`` per
+    layer, plus ``lm_head``) is replaced by int8 values with
+    per-output-channel f32 scales under ``<name>_qscale`` sibling keys
+    — 4x (vs f32 masters) fewer weight bytes per decode step, the
+    second lever next to the quantized KV pools on the
+    data-movement-bound decode path. Norm scales and the embedding
+    table stay full precision (they are gathers/elementwise, not
+    GEMMs). Dequant happens AT USE (:func:`matmul_weight`): the HBM
+    read is int8, the f32 product of the dequant fuses into the matmul
+    stream.
+
+    Token identity CANNOT hold across precision — the law is pinned
+    TV-distance-style by the sampling oracles instead (greedy top-1
+    agreement rate + total-variation bounds, tests/test_quantization.py
+    and ``bench_serving --kv-dtype``; docs/quantization.md)."""
+    if "router" in params["layers"]:
+        raise ValueError(
+            "quantize_weights_int8 covers dense decode layers "
+            f"({QUANTIZED_LAYER_WEIGHTS}); MoE expert weights would "
+            "need per-expert channel scales (and paged serving is "
+            "dense-only anyway)")
+    layers = dict(params["layers"])
+    for name in QUANTIZED_LAYER_WEIGHTS:
+        q, s = _quantize_channels(layers[name])
+        layers[name] = q
+        layers[name + QUANT_SCALE_SUFFIX] = s
+    out = dict(params)
+    out["layers"] = layers
+    q, s = _quantize_channels(params["lm_head"])
+    out["lm_head"] = q
+    out["lm_head" + QUANT_SCALE_SUFFIX] = s
+    return out
+
+
+def matmul_weight(tree, name, dt):
+    """THE dequant-at-use accessor for a (possibly int8-quantized)
+    matmul weight: plain weights cast to the compute dtype exactly as
+    before; quantized weights (a ``<name>_qscale`` sibling present)
+    dequantize per output channel in the einsum stream — the HBM
+    traffic stays int8, the f32 multiply fuses. Shared by the training
+    layer (qkv/wo/mlp/lm_head/loss-head sites) and every decode path so
+    a quantized params tree serves through all of them or none; the
+    pipeline-parallel stage math spells its own matmuls and REFUSES
+    quantized trees instead (pp_loss_and_grads)."""
+    w = tree[name]
+    qs = tree.get(name + QUANT_SCALE_SUFFIX)
+    if qs is None:
+        return w.astype(dt)
+    # scales are per OUTPUT channel (the last weight axis); the
+    # explicit lane broadcast also covers a still-stacked (L, ...) tree
+    return (w.astype(jnp.float32)
+            * qs.astype(jnp.float32)[..., None, :]).astype(dt)
+
+
 def _rmsnorm(x, scale):
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return (x * lax.rsqrt(var + 1e-6).astype(x.dtype)) * scale.astype(x.dtype)
@@ -313,7 +411,7 @@ def project_qkv(h, lp, cfg: TransformerConfig):
     *lead, D = h.shape
     dt = h.dtype
     H, Hkv, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
-    qkv = jnp.dot(h, lp["wqkv"].astype(dt))  # column-parallel
+    qkv = jnp.dot(h, matmul_weight(lp, "wqkv", dt))  # column-parallel
     q, k, v = jnp.split(qkv, [D, D + Hkv * Dh], axis=-1)
     return (
         q.reshape(*lead, H, Dh),
@@ -502,7 +600,7 @@ def _post_attn(x, o, lp, cfg: TransformerConfig, mesh, act_spec):
     be saved by any policy from outside the call)."""
     B, T, D = x.shape
     dt = x.dtype
-    o = jnp.dot(o.reshape(B, T, D), lp["wo"].astype(dt))  # row-parallel
+    o = jnp.dot(o.reshape(B, T, D), matmul_weight(lp, "wo", dt))  # row-parallel
     x = x + o
     if mesh is not None:
         x = lax.with_sharding_constraint(x, act_spec)
@@ -519,8 +617,11 @@ def _mlp_fused(h, lp, cfg: TransformerConfig, mesh):
     from hpc_patterns_tpu.ops.fused_mlp import fused_mlp
 
     dt = h.dtype
-    w1 = lp["w1"].astype(dt)
-    w2 = lp["w2"].astype(dt)
+    # dequant-at-entry for a quantized tree: the kernel wants dense
+    # compute-dtype operands, so the int8-HBM-read win doesn't apply
+    # here — correctness does
+    w1 = matmul_weight(lp, "w1", dt)
+    w2 = matmul_weight(lp, "w2", dt)
     if mesh is None:
         return fused_mlp(h, w1, w2)
     tp = cfg.axis_tp
@@ -560,8 +661,8 @@ def _post_block(x, o, lp, cfg: TransformerConfig, mesh, act_spec,
         aux = jnp.zeros((), jnp.float32)
         st = [jnp.ones((), jnp.float32)] if with_stats else []
     else:
-        h = jax.nn.gelu(jnp.dot(h, lp["w1"].astype(dt)))  # column-parallel
-        h = jnp.dot(h, lp["w2"].astype(dt))  # row-parallel (psum by XLA)
+        h = jax.nn.gelu(jnp.dot(h, matmul_weight(lp, "w1", dt)))  # column-parallel
+        h = jnp.dot(h, matmul_weight(lp, "w2", dt))  # row-parallel (psum by XLA)
         aux = jnp.zeros((), jnp.float32)
         st = [jnp.ones((), jnp.float32)] if with_stats else []
     return (c(x + h, act_spec), aux, *st)
@@ -619,7 +720,7 @@ def forward(params, tokens, cfg: TransformerConfig, mesh=None, *,
     ``return_aux=True`` also returns the summed MoE load-balance loss
     (zeros for dense models)."""
     x, aux = forward_hidden(params, tokens, cfg, mesh)
-    logits = jnp.dot(x, params["lm_head"].astype(x.dtype))
+    logits = jnp.dot(x, matmul_weight(params, "lm_head", x.dtype))
     logits = logits.astype(jnp.float32)
     if return_aux:
         return logits, aux
@@ -815,7 +916,7 @@ def loss_fn(params, tokens, cfg: TransformerConfig, mesh=None):
     if cfg.loss_chunk:
         x, aux = forward_hidden(params, tokens, cfg, mesh)
         loss = chunked_masked_causal_nll(
-            x, params["lm_head"].astype(x.dtype), tokens,
+            x, matmul_weight(params, "lm_head", x.dtype), tokens,
             chunk=cfg.loss_chunk,
         )
     else:

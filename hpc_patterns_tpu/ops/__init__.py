@@ -21,3 +21,6 @@ from hpc_patterns_tpu.ops.flash_attention import (  # noqa: F401
     flash_attention,
     flash_attention_block,
 )
+from hpc_patterns_tpu.ops.paged_attention import (  # noqa: F401
+    paged_attention_decode,
+)

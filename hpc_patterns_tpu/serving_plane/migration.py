@@ -68,9 +68,23 @@ def _arr_to_wire(a) -> dict:
             .decode("ascii")}
 
 
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a wire dtype name, including the extended-precision
+    family (bfloat16, float8_e4m3fn — quantized/bf16 KV pools cross
+    the wire too): plain numpy only knows them once ``ml_dtypes`` has
+    registered its types, and the socket plane's receiver may be a
+    jax-free process that never imported it implicitly."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # registers bfloat16/float8_* with numpy
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 def _arr_from_wire(d) -> np.ndarray:
     return np.frombuffer(
-        base64.b64decode(d["b64"]), dtype=np.dtype(d["dtype"])
+        base64.b64decode(d["b64"]), dtype=_np_dtype(d["dtype"])
     ).reshape(d["shape"]).copy()
 
 

@@ -1,0 +1,40 @@
+"""Known-bad: quantized-decode hazards, minimized.
+
+The round-13 quantization paths (``DEFAULT_DISPATCH_CRITICAL`` names
+them) run INSIDE the traced decode step or on its dispatch edge — the
+hazard class is a host readback over a SCALE: scales are tiny (D times
+smaller than the cache), which makes "just peek at one" look cheap,
+but the peek syncs the whole in-flight chunk on the quantized bytes
+the scale rides with. Lines carrying ``EXPECT: <rule>`` markers are
+the golden findings tests/test_analysis.py asserts, line-exact.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _quantize_rows(x):
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    # "validating" the dynamic range on host mid-dispatch: the
+    # float() forces the whole upstream chunk to resolve
+    peak = float(jnp.max(amax))  # EXPECT: host-sync-in-dispatch
+    scale = jnp.maximum(amax / 127.0, 1e-8 * peak)
+    q = jnp.clip(jnp.round(x / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def _dequant(cache, scale):
+    # a host snapshot of the scale rows — np.asarray on CPU is a
+    # zero-copy view AND a sync; the dequant belongs in the einsum
+    # stream, not on the host
+    s = np.asarray(scale)  # EXPECT: host-sync-in-dispatch
+    return cache.astype(jnp.float32) * jnp.asarray(s)[..., None]
+
+
+def _scale_write(pool, page_ids, offset, rows):
+    # "confirming" the scale landed stalls the chunk the write was
+    # enqueued behind
+    pool = pool.at[page_ids, :, 0, offset].set(rows)
+    jax.block_until_ready(pool)  # EXPECT: host-sync-in-dispatch
+    return pool

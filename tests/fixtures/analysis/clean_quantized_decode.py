@@ -1,0 +1,41 @@
+"""Known-clean: the quantized-decode discipline.
+
+Quantize/dequant stay pure jnp inside the traced step (the scales are
+computed, written, and consumed in the dispatch stream — no host ever
+reads one mid-flight), and the weight dequant accessor is a cast plus
+a fused multiply. The models/decode.py + models/transformer.py shapes,
+minimized.
+"""
+
+import jax.numpy as jnp
+
+
+def _quantize_rows(x):
+    # per-row symmetric quantization, traced end to end: the scale is
+    # a device value from birth to its lane-major pool slot
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def _dequant(cache, scale):
+    # dequant in the einsum stream: elementwise producers fuse, the
+    # HBM read stays one byte per element
+    return cache.astype(jnp.float32) * scale[..., None]
+
+
+def _scale_write(pool, page_ids, offset, rows):
+    # dispatch-only scatter, exactly like the page write it rides with
+    return pool.at[page_ids, :, 0, offset].set(rows)
+
+
+def matmul_weight(tree, name, dt):
+    # dequant-at-use: int8 HBM read, f32 multiply fused into the
+    # matmul stream, no host decision anywhere
+    w = tree[name]
+    qs = tree.get(name + "_qscale")
+    if qs is None:
+        return w.astype(dt)
+    return (w.astype(jnp.float32) * qs.astype(jnp.float32)).astype(dt)

@@ -241,7 +241,7 @@ class TestServeApp:
         code = serve_app.main(
             ["--requests", "4", "--slots", "2", "--budget", "8",
              "--prompt-len", "9", "--eos-id", "3",
-             "--kv-cache-dtype", "int8"]
+             "--kv-dtype", "int8"]
         )
         out = capsys.readouterr().out
         assert code == 0, out

@@ -131,3 +131,29 @@ def test_smoke_shared_row_skips_prefill_and_reports_goodput():
     # the sharing rungs are page-aligned by construction
     assert all(b % 16 == 0 for b in r["ladder"])
     assert 0.0 <= r["bubble_frac"] <= 1.0
+
+
+def test_smoke_quantized_row_reports_goodput_and_pool_bytes():
+    # the QUANTIZED-DECODE gate (round 13): the smoke stream through a
+    # compute-dtype baseline and an int8-KV engine. run_quantized
+    # itself runs BOTH oracles (token-identical to standalone decode
+    # within the precision; the teacher-forced precision law across
+    # precisions) before returning any number — this test pins the
+    # reported shape of the gated keys and the ISSUE's capacity floor.
+    from benchmarks.bench_serving import (
+        quantized_smoke_config,
+        run_quantized,
+    )
+
+    r = run_quantized(**quantized_smoke_config(), quiet=True)
+    assert r["kv_dtype"] == "int8"
+    # the acceptance floor: quantized pool bytes <= 0.55x the bf16
+    # pool at equal residents (measured from real allocations)
+    assert r["kv_pool_bytes_frac"] <= 0.55, r["kv_pool_bytes_frac"]
+    assert 0.0 < r["quant_goodput_tok_s"] \
+        <= r["tokens_per_s_quant"] + 1e-6
+    assert 0.0 < r["baseline_goodput_tok_s"]
+    # the law values the oracle already gated on are reported
+    assert r["greedy_agreement"] >= 0.85
+    assert r["tv_mean"] <= 0.05
+    assert 0.0 <= r["quant_bubble_frac"] <= 1.0

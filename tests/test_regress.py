@@ -241,3 +241,19 @@ class TestGateMechanics:
         f = self._round(tmp_path, 1, 2.0)
         assert regress.main([f, "--tolerance", "1.5"]) == 2
         capsys.readouterr()
+
+
+class TestQuantizedSpecs:
+    def test_quantized_keys_are_gated_and_covered(self):
+        # the round-13 gated keys exist, gate in the right direction,
+        # and — being gated — ride the coverage-loss warning like
+        # every other headline (a capture that silently drops
+        # quant_goodput_tok_s warns instead of reading as green)
+        by_path = {s.path: s for s in regress.SPECS}
+        g = by_path["detail.quant_goodput_tok_s"]
+        assert g.gated and g.direction == "higher"
+        f = by_path["detail.kv_pool_bytes_frac"]
+        assert f.gated and f.direction == "lower"
+        assert f.abs_slack <= 0.05  # dtype geometry: tight band
+        b = by_path["detail.quant_bubble_frac"]
+        assert b.gated and b.direction == "lower"
