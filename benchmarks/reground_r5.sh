@@ -230,9 +230,17 @@ run "regress coverage-loss check (full trajectory)" \
 #    that cost round 6 its cache, and it is cheaper to catch here than
 #    on a chip session. Rules self-register, so the shardlint family
 #    (collective-divergence/-order, unchecked-permutation,
-#    spec-mismatch) gates here with no script change; its runtime half
-#    is the "collective schedules consistent" verdict step 7b's merged
-#    trace now carries.
-run "jaxlint static gate" python -m hpc_patterns_tpu.analysis --ci \
+#    spec-mismatch) AND the pallaslint family (dma-sem-balance,
+#    dma-slot-reuse, collective-id-collision, kernel-dtype-cast,
+#    vmem-budget) gate here with no script change; the runtime halves
+#    are step 7b's "collective schedules consistent" verdict and the
+#    strict-semaphore shim the fused parity battery runs under.
+#    --vmem-report logs the per-kernel VMEM budget table next to the
+#    analysis record — read it BEFORE step 7c's compiled fused legs
+#    (the kernels this round first lowers on real VMEM limits) and
+#    before step 4f's paged_flash race: the paged gather-scratch row
+#    is the grid-streaming decision number.
+run "jaxlint static gate + vmem table" \
+  python -m hpc_patterns_tpu.analysis --ci --vmem-report \
   --log "${LOG%.log}_analysis.jsonl"
 echo "DONE $(date +%H:%M:%S)" | tee -a "$LOG"

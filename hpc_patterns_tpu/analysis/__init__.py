@@ -1,9 +1,14 @@
 """jaxlint: static hazard analysis for the JAX patterns this repo has
 been burned by — donation aliasing, dispatch-path host syncs, per-call
-re-jits, PRNG key reuse, tracer leaks, and (the shardlint family) the
+re-jits, PRNG key reuse, tracer leaks; (the shardlint family) the
 SPMD collective-divergence class: rank-branched collective schedules,
 reordered collective paths, unchecked ppermute pair lists, and
-PartitionSpec/mesh inconsistencies.
+PartitionSpec/mesh inconsistencies; and (the pallaslint family) the
+in-kernel DMA/semaphore/VMEM contract: semaphore-ledger imbalance,
+scratch-slot reuse across live DMAs, collective-id collisions, dtype
+holes, and VMEM budget overflows — the chip-only bug class interpret
+mode cannot see (``pallas_rules.py`` / ``vmem.py``; runtime half:
+``runtime.strict_semaphores``).
 
 Run it over the package (CI mode exits nonzero on any unsuppressed
 finding)::

@@ -56,6 +56,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--list-rules", action="store_true",
         help="print the rule catalog and exit")
+    p.add_argument(
+        "--vmem-report", action="store_true",
+        help="print the per-kernel VMEM budget table (every "
+             "pallas_call, model-dim bindings; analysis/vmem.py)")
     return p
 
 
@@ -100,6 +104,17 @@ def main(argv=None) -> int:
         print(f"jaxlint: baselined {len(report.findings)} finding(s) "
               f"-> {args.write_baseline}")
         return 0
+    vmem_stats = None
+    if args.vmem_report or args.log:
+        # the estimator is cheap (pure ast); computing it whenever a
+        # log is written keeps the kind=analysis record's vmem section
+        # present without a second invocation
+        from hpc_patterns_tpu.analysis import vmem
+
+        estimates = vmem.estimate_paths(paths)
+        vmem_stats = vmem.vmem_summary(estimates)
+        if args.vmem_report:
+            print(vmem.format_vmem_table(estimates, root=_PACKAGE_ROOT))
     for f in report.findings:
         print(f.format())
     counts = report.by_rule()
@@ -126,6 +141,7 @@ def main(argv=None) -> int:
             baselined=len(report.baselined),
             files=report.n_files,
             by_rule=counts,
+            vmem=vmem_stats,
         )
     if args.ci and report.findings:
         return 1

@@ -265,8 +265,10 @@ def register(rule_cls: type[Rule]) -> type[Rule]:
 
 
 def registered_rules() -> dict[str, Rule]:
-    # rules.py self-registers on import; import lazily so core stays
-    # importable without the rule set (the runtime helper's case)
+    # rules.py / pallas_rules.py self-register on import; import
+    # lazily so core stays importable without the rule set (the
+    # runtime helper's case)
+    from hpc_patterns_tpu.analysis import pallas_rules  # noqa: F401
     from hpc_patterns_tpu.analysis import rules  # noqa: F401
 
     return dict(_REGISTRY)

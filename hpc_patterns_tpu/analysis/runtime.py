@@ -1,8 +1,8 @@
-"""Runtime complements to the static rules: donation poisoning and the
-collective schedule verifier.
+"""Runtime complements to the static rules: donation poisoning, the
+collective schedule verifier, and the strict-semaphore interpret shim.
 
-Two helpers live here, each the belt-and-braces RUNTIME check behind a
-static rule family:
+Three helpers live here, each the belt-and-braces RUNTIME check behind
+a static rule family:
 
 **Donation poisoning** (:func:`poison_donated`, behind
 ``donation-alias``). The hazard (round 6's "poisoned cache"): on CPU a
@@ -34,6 +34,19 @@ matched; on mismatch the merge names the first divergent
 persists a tiny per-rank progress file on every record, so a TIMED-OUT
 rank's position is readable post-mortem — a hang reads as "rank 2 is
 at allreduce#17, rank 0 at sendrecv_ring#17" instead of a dead tunnel.
+
+**Strict semaphores** (:func:`strict_semaphores`, behind
+``dma-sem-balance``/``dma-slot-reuse``). The hazard is PR 8's
+chip-only class: interpret mode serializes DMAs and leaves semaphores
+inert, so a double-waited send sem or an undrained DMA passes every
+CPU test and deadlocks on silicon. Under the shim, every
+``make_async_copy``/``make_async_remote_copy`` built while a
+``pallas_call`` kernel body traces is counted — starts and waits per
+semaphore channel, plus per-descriptor wait multiplicity — and the
+ledger must balance exactly at kernel-body exit or the TEST fails
+(:class:`SemaphoreBalanceError`), not the chip session. Wiring:
+``tests/test_fused_comm.py`` installs it module-wide, so the whole
+fused parity battery re-proves the sync protocol on every run.
 
 This module is import-light on purpose (stdlib only; jax is imported
 inside the poison helpers): the schedule verifier must be usable from
@@ -295,6 +308,253 @@ SERVING_POISON_TARGETS: dict[str, tuple[int, ...]] = {
     # every reader at once, so the poison harness must cover it
     "_tail_prefill_one": (3,),
 }
+
+
+# ---------------------------------------------------------------------------
+# strict-semaphore interpret shim
+# ---------------------------------------------------------------------------
+
+
+class SemaphoreBalanceError(AssertionError):
+    """A kernel's DMA semaphore ledger failed to balance: a descriptor
+    waited twice on one channel, or starts != waits at kernel exit.
+    In interpret mode this is invisible (semaphores are inert
+    arithmetic); on chip it is a deadlock or a race."""
+
+
+class _KernelFrame:
+    """Per-kernel-trace DMA accounting."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.remote_starts = 0
+        self.local_starts = 0
+        self.send_waits = 0
+        self.recv_waits = 0
+        self.local_waits = 0
+        # best-effort per-semaphore-slot counts: key -> [starts, waits]
+        self.per_key: dict = {}
+        self.keyed_ok = True
+
+    def key_count(self, key, slot: int, delta: int) -> None:
+        if key is None:
+            self.keyed_ok = False
+            return
+        entry = self.per_key.setdefault(key, [0, 0])
+        entry[slot] += delta
+
+    def check(self) -> None:
+        problems = []
+        if self.remote_starts != self.send_waits:
+            problems.append(
+                f"{self.remote_starts} remote start(s) vs "
+                f"{self.send_waits} send wait(s)")
+        if self.remote_starts != self.recv_waits:
+            problems.append(
+                f"{self.remote_starts} remote start(s) vs "
+                f"{self.recv_waits} recv wait(s)")
+        if self.local_starts != self.local_waits:
+            problems.append(
+                f"{self.local_starts} local start(s) vs "
+                f"{self.local_waits} wait(s)")
+        if self.keyed_ok:
+            for key, (starts, waits) in sorted(self.per_key.items()):
+                if starts != waits:
+                    problems.append(
+                        f"sem {key}: {starts} signal(s), "
+                        f"{waits} wait(s)")
+        if problems:
+            raise SemaphoreBalanceError(
+                f"kernel {self.name!r}: DMA semaphore ledger did not "
+                f"balance at kernel exit — " + "; ".join(problems)
+                + ". Interpret mode hides this (semaphores are "
+                "inert); on chip it deadlocks or races.")
+
+
+def _sem_fingerprint(sem) -> tuple | None:
+    """Best-effort stable identity for a semaphore operand at trace
+    time: (base ref id, transform repr). None when the structure is
+    unrecognizable — the ledger then falls back to channel totals."""
+    try:
+        base = getattr(sem, "ref", sem)
+        transforms = getattr(sem, "transforms", ())
+        return (id(base), str(transforms))
+    except Exception:  # noqa: BLE001 - defensive: jax internals move
+        return None
+
+
+class _CountedDMA:
+    """Proxy over a pallas async-copy descriptor: forwards everything,
+    counts starts/waits, and fails fast on a per-descriptor
+    double-wait (the PR 8 drain bug's exact shape)."""
+
+    def __init__(self, real, frame: _KernelFrame, remote: bool,
+                 send_key, recv_key):
+        self._real = real
+        self._frame = frame
+        self._remote = remote
+        self._send_key = send_key
+        self._recv_key = recv_key
+        self._send_waits = 0
+        self._recv_waits = 0
+
+    def start(self, *args, **kwargs):
+        f = self._frame
+        if self._remote:
+            f.remote_starts += 1
+            f.key_count(self._send_key, 0, 1)
+            f.key_count(self._recv_key, 0, 1)
+        else:
+            f.local_starts += 1
+            f.key_count(self._recv_key, 0, 1)
+        return self._real.start(*args, **kwargs)
+
+    def _count_wait(self, channel: str):
+        f = self._frame
+        if channel == "send":
+            self._send_waits += 1
+            f.send_waits += 1
+            f.key_count(self._send_key, 1, 1)
+            if self._send_waits > 1:
+                raise SemaphoreBalanceError(
+                    f"kernel {f.name!r}: descriptor send semaphore "
+                    f"waited {self._send_waits} times — one signal "
+                    f"per DMA; the second wait deadlocks on chip "
+                    f"(the PR 8 drain double-wait)")
+        else:
+            self._recv_waits += 1
+            if self._remote:
+                f.recv_waits += 1
+            else:
+                f.local_waits += 1
+            f.key_count(self._recv_key, 1, 1)
+            if self._recv_waits > 1:
+                raise SemaphoreBalanceError(
+                    f"kernel {f.name!r}: descriptor recv semaphore "
+                    f"waited {self._recv_waits} times — one signal "
+                    f"per DMA; the second wait deadlocks on chip")
+
+    def wait(self, *args, **kwargs):
+        if self._remote:
+            self._count_wait("send")
+        self._count_wait("recv")
+        return self._real.wait(*args, **kwargs)
+
+    def wait_send(self, *args, **kwargs):
+        self._count_wait("send")
+        return self._real.wait_send(*args, **kwargs)
+
+    def wait_recv(self, *args, **kwargs):
+        self._count_wait("recv")
+        return self._real.wait_recv(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._real, name)
+
+
+class StrictSemaphores:
+    """Context manager installing the strict-semaphore shim (module
+    docstring). ``kernels_checked`` counts kernel traces that carried
+    DMA activity — tests assert it is nonzero so the shim provably
+    engaged (an already-warm trace cache would otherwise skip every
+    kernel body; pair with ``jax.clear_caches()``)."""
+
+    def __init__(self):
+        self.kernels_checked = 0
+        self._frames: list[_KernelFrame] = []
+        self._originals: list[tuple] = []
+
+    # -- patch targets ---------------------------------------------------
+
+    def __enter__(self):
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        shim = self
+
+        real_local = pltpu.make_async_copy
+        real_remote = pltpu.make_async_remote_copy
+        real_call = pl.pallas_call
+
+        def counted_local(*args, **kwargs):
+            real = real_local(*args, **kwargs)
+            frame = shim._frames[-1] if shim._frames else None
+            if frame is None:
+                return real
+            sem = kwargs.get("sem", args[2] if len(args) > 2 else None)
+            return _CountedDMA(real, frame, remote=False,
+                               send_key=None,
+                               recv_key=_sem_fingerprint(sem))
+
+        def counted_remote(*args, **kwargs):
+            real = real_remote(*args, **kwargs)
+            frame = shim._frames[-1] if shim._frames else None
+            if frame is None:
+                return real
+            send = kwargs.get("send_sem",
+                              args[2] if len(args) > 2 else None)
+            recv = kwargs.get("recv_sem",
+                              args[3] if len(args) > 3 else None)
+            return _CountedDMA(real, frame, remote=True,
+                               send_key=_sem_fingerprint(send),
+                               recv_key=_sem_fingerprint(recv))
+
+        def checked_call(kernel, *args, **kwargs):
+            if not callable(kernel):  # pragma: no cover - defensive
+                return real_call(kernel, *args, **kwargs)
+            name = getattr(kernel, "__name__", None) or getattr(
+                getattr(kernel, "func", None), "__name__", "kernel")
+
+            @functools.wraps(kernel if hasattr(kernel, "__name__")
+                             else (lambda: None))
+            def body(*refs, **kw):
+                frame = _KernelFrame(name)
+                shim._frames.append(frame)
+                try:
+                    out = kernel(*refs, **kw)
+                finally:
+                    shim._frames.pop()
+                # balance asserted on the SUCCESS path only: an
+                # exception unwinding through the body must surface
+                # itself, not a secondary ledger complaint
+                if (frame.remote_starts or frame.local_starts
+                        or frame.send_waits or frame.recv_waits
+                        or frame.local_waits):
+                    shim.kernels_checked += 1
+                    frame.check()
+                return out
+
+            return real_call(body, *args, **kwargs)
+
+        self._originals = [
+            (pltpu, "make_async_copy", real_local),
+            (pltpu, "make_async_remote_copy", real_remote),
+            (pl, "pallas_call", real_call),
+        ]
+        pltpu.make_async_copy = counted_local
+        pltpu.make_async_remote_copy = counted_remote
+        pl.pallas_call = checked_call
+        return self
+
+    def __exit__(self, *exc):
+        for obj, attr, original in self._originals:
+            setattr(obj, attr, original)
+        self._originals = []
+        return False
+
+
+def strict_semaphores() -> StrictSemaphores:
+    """The strict-semaphore interpret shim as a context manager::
+
+        with strict_semaphores() as ledger:
+            jax.clear_caches()       # force kernel re-traces
+            run_the_parity_battery()
+        assert ledger.kernels_checked > 0
+
+    Every kernel body traced inside the context has its DMA semaphore
+    ledger balance-checked at exit; imbalance raises
+    :class:`SemaphoreBalanceError` in the TEST, not on the chip."""
+    return StrictSemaphores()
 
 
 def install_serving_poison():

@@ -1,0 +1,879 @@
+"""VMEM budget estimation for every ``pallas_call`` in the tree.
+
+PR 8's third chip-only bug was a VMEM overflow: a kernel whose blocks
+plus scratch exceeded Mosaic's scoped limit, invisible in interpret
+mode and fatal at lowering on the chip. ROADMAP's PR 12 remainder asks
+the same question forward ("the gather-into-VMEM scratch bound —
+pages·P·D of pool dtype — may want grid streaming at long context").
+This module answers it with a NUMBER before a chip session:
+
+- every ``pl.pallas_call`` site is found statically (stdlib ``ast``,
+  never importing the analyzed code — the jaxlint engine's rule);
+- its VMEM working set is summed **symbolically**: BlockSpec block
+  shapes (or whole-operand shapes where no block is given),
+  ``scratch_shapes`` entries, and ``pl.run_scoped`` allocations inside
+  the kernel body — each a polynomial over dimension symbols
+  (``pages·P·D``), times the dtype's byte width;
+- ``--vmem-report`` evaluates the polynomials under
+  :data:`MODEL_DIMS` (the documented chip-serving model shape; unknown
+  symbols fall back to :data:`DEFAULT_DIM` and are listed as ASSUMED)
+  and prints per-kernel byte totals against each kernel's
+  ``vmem_limit_bytes`` (or Mosaic's 16 MB default scoped limit);
+- the ``vmem-budget`` rule (analysis/pallas_rules.py) fires only on
+  totals resolvable from **literals alone** — the report informs, the
+  rule never guesses.
+
+The ``paged_flash`` row reproduces docs/quantization.md's bound: at
+``pages·P = 16384``, ``D = 128``, an int8 pool costs ``2·pages·P·D``
+= 4 MiB of gather scratch (8 MiB bf16) plus the f32 scale rows.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from hpc_patterns_tpu.analysis.core import ModuleInfo
+
+#: Mosaic's default scoped VMEM limit — the budget a kernel that sets
+#: no ``vmem_limit_bytes`` is lowered against.
+DEFAULT_VMEM_LIMIT = 16 * 1024 * 1024
+
+#: model dimension bindings for ``--vmem-report``: the chip-serving
+#: shape the docs quote (docs/quantization.md: S_alloc = pages·P =
+#: 16384, D = 128; comm benchmark shards ~MBs; fused-MLP flagship
+#: blocks 512). A symbol absent here evaluates at
+#: :data:`DEFAULT_DIM` and is listed as ASSUMED in the report row.
+MODEL_DIMS: dict[str, int] = {
+    # ring collectives (comm/fused.py): 8-device axis, ~MB shards
+    # (the module's documented benchmark envelope)
+    "size": 8,
+    "m": 128, "n": 2048, "cn": 256, "n_pad": 2048, "k": 256,
+    # attention/decode (ops/): chip serving shape
+    "B": 8, "H": 16, "Hkv": 2, "g": 8, "D": 128, "d": 128,
+    "P": 128, "pages": 128, "page_size": 128,
+    "S": 16384, "S_alloc": 16384, "n_s": 128, "n_steps": 32, "U": 4,
+    "block_q": 512, "block_k": 1024, "block_s": 512,
+    "Tq": 8192, "Tk": 8192, "Tq_c": 2048,
+    "n_q": 16, "n_q_c": 4, "n_kv": 8, "n_chunks": 4, "group": 8,
+    # fused MLP (ops/fused_mlp.py): flagship rung
+    "bt": 512, "bf": 512, "F": 4096, "N": 8192, "n_f": 8,
+    # on-chip pipeline (concurrency/): bench chunk geometry
+    "num_chunks": 64, "chunk_rows": 512,
+    "rows": 512, "cols": 128,
+}
+
+#: fallback for dimension symbols with no model binding (flagged as
+#: ASSUMED in the report, never silently trusted)
+DEFAULT_DIM = 128
+
+#: fallback byte width for unresolvable dtypes (``x.dtype`` — the
+#: operand's runtime dtype); f32 is the tree's compute default
+DEFAULT_DTYPE_BYTES = 4
+
+_DTYPE_BYTES = {
+    "float64": 8, "int64": 8, "uint64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool_": 1, "bool": 1,
+    "float8_e4m3fn": 1, "float8_e5m2": 1,
+}
+
+
+# ---------------------------------------------------------------------------
+# symbolic quantities: polynomials over dimension symbols
+# ---------------------------------------------------------------------------
+# A quantity is {(sym, sym, ...): coeff} — {(): 6} is the literal 6,
+# {("pages", "P"): 2} is 2·pages·P. Add/Sub/Mul close over the form;
+# anything else (floordiv, calls) becomes one ATOMIC symbol carrying
+# its source text, so it still evaluates under a binding or falls to
+# the assumed default.
+
+Quantity = dict[tuple[str, ...], int]
+
+
+def _q_const(n: int) -> Quantity:
+    return {(): n}
+
+
+def _q_sym(name: str) -> Quantity:
+    return {(name,): 1}
+
+
+def _q_add(a: Quantity, b: Quantity, sign: int = 1) -> Quantity:
+    out = dict(a)
+    for syms, c in b.items():
+        out[syms] = out.get(syms, 0) + sign * c
+        if out[syms] == 0:
+            del out[syms]
+    return out
+
+
+def _q_mul(a: Quantity, b: Quantity) -> Quantity:
+    out: Quantity = {}
+    for sa, ca in a.items():
+        for sb, cb in b.items():
+            syms = tuple(sorted(sa + sb))
+            out[syms] = out.get(syms, 0) + ca * cb
+    return {k: v for k, v in out.items() if v}
+
+
+def q_value(q: Quantity, bindings: dict[str, int],
+            default: int = DEFAULT_DIM) -> tuple[int, set[str]]:
+    """(numeric value, symbols that fell to the assumed default)."""
+    total = 0
+    assumed: set[str] = set()
+    for syms, coeff in q.items():
+        prod = coeff
+        for s in syms:
+            if s in bindings:
+                prod *= bindings[s]
+            else:
+                assumed.add(s)
+                prod *= default
+        total += prod
+    return total, assumed
+
+
+def q_exact(q: Quantity) -> int | None:
+    """The literal value, or None if any symbol survives."""
+    if all(not syms for syms in q):
+        return q.get((), 0)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# shared kernel-body discovery (pallas_rules.py imports these)
+# ---------------------------------------------------------------------------
+
+
+def scope_defs(mod: ModuleInfo, node: ast.AST) -> dict[str, ast.AST]:
+    """Function definitions visible from ``node`` (enclosing scopes,
+    innermost wins), by name."""
+    out: dict[str, ast.AST] = {}
+    chain = []
+    cur: ast.AST | None = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Module)):
+            chain.append(cur)
+        cur = mod.parents.get(cur)
+    for scope in reversed(chain):
+        for stmt in scope.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out[stmt.name] = stmt
+    return out
+
+
+def resolve_kernel_arg(mod: ModuleInfo, expr: ast.AST, site: ast.AST,
+                       depth: int = 0) -> list[ast.FunctionDef]:
+    """FunctionDefs a ``pallas_call`` first argument can name: a local
+    def, ``functools.partial(def, ...)``, or a kernel-factory call
+    whose returns are followed."""
+    if depth > 4:
+        return []
+    defs = scope_defs(mod, site)
+    if isinstance(expr, ast.Name):
+        fn = defs.get(expr.id)
+        return [fn] if isinstance(fn, ast.FunctionDef) else []
+    if isinstance(expr, ast.IfExp):
+        return (resolve_kernel_arg(mod, expr.body, site, depth + 1)
+                + resolve_kernel_arg(mod, expr.orelse, site, depth + 1))
+    if not isinstance(expr, ast.Call):
+        return []
+    name = mod.resolve(expr.func) or ""
+    if name == "functools.partial" and expr.args:
+        return resolve_kernel_arg(mod, expr.args[0], site, depth + 1)
+    if isinstance(expr.func, ast.Name):
+        factory = defs.get(expr.func.id)
+        if isinstance(factory, ast.FunctionDef):
+            out: list[ast.FunctionDef] = []
+            for node in ast.walk(factory):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    out.extend(resolve_kernel_arg(
+                        mod, node.value, node, depth + 1))
+            return out
+    return []
+
+
+def _kernel_label(mod: ModuleInfo, call: ast.Call) -> str:
+    """Human name for one pallas_call: the kernel function if
+    resolvable, else the enclosing function."""
+    fns = resolve_kernel_arg(mod, call.args[0], call) if call.args else []
+    if fns:
+        name = fns[0].name
+        if name not in ("kernel", "_", "body"):
+            return name
+    cur = mod.parents.get(call)
+    while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        cur = mod.parents.get(cur)
+    host = cur.name if cur is not None else "<module>"
+    if fns and fns[0].name in ("kernel", "_", "body"):
+        return f"{host}.{fns[0].name}"
+    return host
+
+
+# ---------------------------------------------------------------------------
+# the estimator
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Component:
+    """One VMEM contributor: a BlockSpec block, a whole-array operand,
+    or a scratch allocation."""
+
+    label: str              # "in[2]", "out[0]", "scratch[1]", "scoped"
+    quantity: Quantity      # element count (polynomial)
+    dtype_bytes: int | None  # None = unresolvable (model default)
+    dtype_src: str = ""     # what the dtype expression said
+
+
+@dataclass
+class KernelEstimate:
+    """Everything ``--vmem-report`` prints for one pallas_call."""
+
+    kernel: str
+    path: str
+    line: int
+    node: ast.AST
+    components: list[Component] = field(default_factory=list)
+    n_sems: int = 0
+    limit_bytes: int = DEFAULT_VMEM_LIMIT
+    limit_default: bool = True
+
+    @property
+    def exact_bytes(self) -> int | None:
+        """A sound LOWER bound: the byte sum over components whose
+        shape and dtype are both literal-resolvable, None when no
+        component is. If this subset alone exceeds the limit the
+        kernel is over regardless of the symbolic rest — the only
+        judgement the vmem-budget rule makes (model-dim totals are
+        the report's, never the gate's)."""
+        total = None
+        for c in self.components:
+            if c.dtype_bytes is None:
+                continue
+            n = q_exact(c.quantity)
+            if n is None:
+                continue
+            total = (total or 0) + n * c.dtype_bytes
+        return total
+
+    def model_bytes(self, bindings: dict[str, int] | None = None,
+                    default_dim: int = DEFAULT_DIM,
+                    dtype_default: int = DEFAULT_DTYPE_BYTES,
+                    ) -> tuple[int, set[str]]:
+        """(bytes under model bindings, assumed symbols). Components
+        with unresolvable dtypes use ``dtype_default`` and contribute
+        their dtype source to the assumed set."""
+        bindings = MODEL_DIMS if bindings is None else bindings
+        total = 0
+        assumed: set[str] = set()
+        for c in self.components:
+            width = c.dtype_bytes
+            if width is None:
+                width = dtype_default
+                assumed.add(c.dtype_src or "dtype?")
+            n, syms = q_value(c.quantity, bindings, default_dim)
+            assumed |= syms
+            total += n * width
+        return total, assumed
+
+
+def _own_statements(scope: ast.AST) -> list[ast.AST]:
+    """Statements belonging to ``scope`` itself, in source order:
+    recurses into compound statements (if/for/with/try) but NOT into
+    nested function/class bodies — another function's local
+    ``n = 8192`` must never resolve this kernel's runtime ``n``."""
+    out: list[ast.AST] = []
+
+    def rec(stmts) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            out.append(stmt)
+            for field_name in ("body", "orelse", "finalbody"):
+                rec(getattr(stmt, field_name, []))
+            for h in getattr(stmt, "handlers", []):
+                rec(h.body)
+
+    rec(getattr(scope, "body", []))
+    return out
+
+
+class _Resolver:
+    """Name resolution for shape/dtype expressions: simple assignments
+    in the enclosing function chain plus module-level constants.
+    Scope-correct: only each scope's OWN statements contribute, and a
+    function's parameters shadow any outer binding (a parameter is
+    runtime data — it must stay a symbol)."""
+
+    def __init__(self, mod: ModuleInfo, site: ast.AST):
+        self.mod = mod
+        self.table: dict[str, ast.AST] = {}
+        # outermost first so inner assignments win
+        scopes: list[ast.AST] = [mod.tree]
+        cur = mod.parents.get(site)
+        chain = []
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                chain.append(cur)
+            cur = self.mod.parents.get(cur)
+        scopes.extend(reversed(chain))
+        for scope in scopes:
+            if isinstance(scope, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                args = scope.args
+                for p in (args.posonlyargs + args.args
+                          + args.kwonlyargs
+                          + ([args.vararg] if args.vararg else [])
+                          + ([args.kwarg] if args.kwarg else [])):
+                    self.table.pop(p.arg, None)
+            for node in _own_statements(scope):
+                if isinstance(node, ast.Assign) and len(
+                        node.targets) == 1 and isinstance(
+                            node.targets[0], ast.Name):
+                    self.table[node.targets[0].id] = node.value
+
+    def assignments_to(self, name: str, site: ast.AST
+                       ) -> list[tuple[str, ast.AST]]:
+        """All (kind, value) assignments to ``name`` in the function
+        enclosing ``site`` (own statements only — nested defs are
+        separate scopes), in source order — kind 'set' (=) or 'add'
+        (+=). Spec lists are built incrementally; the estimate takes
+        the union (the quantized branch's extra scratch counts: the
+        budget question is the worst variant)."""
+        fn = self.mod.parents.get(site)
+        while fn is not None and not isinstance(
+                fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = self.mod.parents.get(fn)
+        if fn is None:
+            return []
+        out = []
+        for node in _own_statements(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == name:
+                out.append(("set", node.value))
+            elif isinstance(node, ast.AugAssign) and isinstance(
+                    node.target, ast.Name) and node.target.id == name \
+                    and isinstance(node.op, ast.Add):
+                out.append(("add", node.value))
+        return out
+
+    # -- quantities ------------------------------------------------------
+
+    def quantity(self, node: ast.AST, depth: int = 0) -> Quantity:
+        if depth > 12:
+            return _q_sym(_srctext(node))
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, int):
+                return _q_const(node.value)
+            if node.value is None:
+                # BlockSpec None dims: the grid axis the block drops
+                return _q_const(1)
+            return _q_sym(_srctext(node))
+        if isinstance(node, ast.Name):
+            tgt = self.table.get(node.id)
+            if tgt is not None and not self._self_referential(
+                    node.id, tgt):
+                q = self.quantity(tgt, depth + 1)
+                # a resolution that degenerated to the expression's
+                # own text is no better than the name itself
+                if q != _q_sym(_srctext(tgt)):
+                    return q
+            return _q_sym(node.id)
+        if isinstance(node, ast.BinOp):
+            left = self.quantity(node.left, depth + 1)
+            right = self.quantity(node.right, depth + 1)
+            if isinstance(node.op, ast.Add):
+                return _q_add(left, right)
+            if isinstance(node.op, ast.Sub):
+                return _q_add(left, right, -1)
+            if isinstance(node.op, ast.Mult):
+                return _q_mul(left, right)
+            if isinstance(node.op, (ast.FloorDiv, ast.Div)):
+                le, re_ = q_exact(left), q_exact(right)
+                if le is not None and re_ not in (None, 0):
+                    return _q_const(int(le // re_))
+            if isinstance(node.op, ast.Pow):
+                le, re_ = q_exact(left), q_exact(right)
+                if le is not None and re_ is not None and 0 <= re_ <= 8:
+                    return _q_const(le ** re_)
+            return _q_sym(_srctext(node))
+        if isinstance(node, ast.UnaryOp) and isinstance(
+                node.op, ast.USub):
+            inner = self.quantity(node.operand, depth + 1)
+            return _q_mul(inner, _q_const(-1))
+        return _q_sym(_srctext(node))
+
+    def _self_referential(self, name: str, expr: ast.AST) -> bool:
+        return any(isinstance(n, ast.Name) and n.id == name
+                   for n in ast.walk(expr))
+
+    # -- dtypes ----------------------------------------------------------
+
+    def dtype_bytes(self, node: ast.AST | None
+                    ) -> tuple[int | None, str]:
+        if node is None:
+            return None, "dtype?"
+        src = _srctext(node)
+        name = self.mod.resolve(node)
+        if name is None and isinstance(node, ast.Name):
+            tgt = self.table.get(node.id)
+            if tgt is not None:
+                return self.dtype_bytes(tgt)
+        if name:
+            base = name.rsplit(".", 1)[-1]
+            if base in _DTYPE_BYTES:
+                return _DTYPE_BYTES[base], src
+            if isinstance(node, ast.Name):
+                tgt = self.table.get(node.id)
+                if tgt is not None and _srctext(tgt) != src:
+                    return self.dtype_bytes(tgt)
+        return None, src
+
+    # -- shapes of operand expressions ----------------------------------
+
+    def shape_quantity(self, node: ast.AST, depth: int = 0
+                       ) -> Quantity | None:
+        """Element count of an operand expression, when its shape is
+        statically visible (a reshape/zeros/full with resolvable
+        dims); None otherwise."""
+        if depth > 6:
+            return None
+        if isinstance(node, ast.Name):
+            tgt = self.table.get(node.id)
+            if tgt is not None and not self._self_referential(
+                    node.id, tgt):
+                return self.shape_quantity(tgt, depth + 1)
+            return None
+        if not isinstance(node, ast.Call):
+            return None
+        fname = (self.mod.resolve(node.func) or "").rsplit(".", 1)[-1]
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "reshape":
+            dims = node.args
+            if len(dims) == 1 and isinstance(dims[0],
+                                             (ast.Tuple, ast.List)):
+                dims = dims[0].elts
+            return self._dims_quantity(dims)
+        if fname in ("zeros", "ones", "full", "empty",
+                     "broadcast_to") and node.args:
+            shp = node.args[0] if fname != "broadcast_to" else (
+                node.args[1] if len(node.args) > 1 else None)
+            if isinstance(shp, (ast.Tuple, ast.List)):
+                return self._dims_quantity(shp.elts)
+        return None
+
+    def _dims_quantity(self, dims) -> Quantity | None:
+        total = _q_const(1)
+        for d in dims:
+            q = self.quantity(d)
+            # -1 in a reshape is an inferred dim: unknowable here
+            if q_exact(q) == -1:
+                return None
+            total = _q_mul(total, q)
+        return total
+
+
+def _srctext(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on 3.9+
+        return type(node).__name__
+
+
+# -- pallas_call dissection -------------------------------------------------
+
+
+def _call_kwargs(call: ast.Call) -> dict[str, ast.AST]:
+    return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+
+
+def _spec_entries(mod: ModuleInfo, res: _Resolver, node: ast.AST | None,
+                  site: ast.AST, depth: int = 0,
+                  seen: frozenset[str] = frozenset()
+                  ) -> list[tuple[ast.AST, Quantity]]:
+    """Flatten a specs expression into (spec-call, count) entries:
+    literal lists, ``[spec] * n`` repeats, list comprehensions over
+    ``range(U)``, and names built incrementally with ``= / +=``
+    (``seen`` breaks self-referential rebuilds like
+    ``out_specs = [out_specs, …]``)."""
+    if node is None or depth > 8:
+        return []
+    if isinstance(node, (ast.List, ast.Tuple)):
+        out = []
+        for e in node.elts:
+            out.extend(_spec_entries(mod, res, e, site, depth + 1, seen))
+        return out
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+        inner = _spec_entries(mod, res, node.left, site, depth + 1, seen)
+        count = res.quantity(node.right)
+        return [(spec, _q_mul(q, count)) for spec, q in inner]
+    if isinstance(node, ast.ListComp) and len(node.generators) == 1 \
+            and not node.generators[0].ifs:
+        it = node.generators[0].iter
+        count: Quantity = _q_sym(_srctext(it))
+        if isinstance(it, ast.Call) and (
+                mod.resolve(it.func) or "") == "range" and len(
+                    it.args) == 1:
+            count = res.quantity(it.args[0])
+        inner = _spec_entries(mod, res, node.elt, site, depth + 1, seen)
+        return [(spec, _q_mul(q, count)) for spec, q in inner]
+    if isinstance(node, ast.Name):
+        if node.id in seen:
+            return []
+        seen = seen | {node.id}
+        parts = res.assignments_to(node.id, site)
+        if parts:
+            out = []
+            for kind, value in parts:
+                out.extend(_spec_entries(mod, res, value, site,
+                                         depth + 1, seen))
+            return out
+        tgt = res.table.get(node.id)
+        if tgt is not None:
+            return _spec_entries(mod, res, tgt, site, depth + 1, seen)
+        return []
+    if isinstance(node, ast.Call):
+        return [(node, _q_const(1))]
+    if isinstance(node, ast.IfExp):
+        # worst-case branch: the union covers both
+        return (_spec_entries(mod, res, node.body, site, depth + 1, seen)
+                + _spec_entries(mod, res, node.orelse, site, depth + 1,
+                                seen))
+    return []
+
+
+def _resolve_spec_call(mod: ModuleInfo, res: _Resolver, call: ast.Call,
+                       depth: int = 0) -> tuple[ast.AST | None,
+                                                str, list[ast.AST]]:
+    """(block-shape expr | None, memory-space name, args) of one
+    BlockSpec-ish call, seeing through ``functools.partial`` aliases
+    (``row = functools.partial(pl.BlockSpec, memory_space=VMEM)``)."""
+    if depth > 4 or not isinstance(call, ast.Call):
+        return None, "", []
+    fname = (mod.resolve(call.func) or "").rsplit(".", 1)[-1]
+    kwargs = _call_kwargs(call)
+    space = ""
+    if "memory_space" in kwargs:
+        space = (mod.resolve(kwargs["memory_space"])
+                 or _srctext(kwargs["memory_space"]))
+    if fname == "BlockSpec":
+        shape = call.args[0] if call.args else None
+        return shape, space, list(call.args)
+    if isinstance(call.func, ast.Name):
+        tgt = res.table.get(call.func.id)
+        if isinstance(tgt, ast.Call):
+            t_name = (mod.resolve(tgt.func) or "").rsplit(".", 1)[-1]
+            if t_name == "partial" and tgt.args:
+                inner_kwargs = _call_kwargs(tgt)
+                inner_space = ""
+                if "memory_space" in inner_kwargs:
+                    inner_space = (mod.resolve(
+                        inner_kwargs["memory_space"])
+                        or _srctext(inner_kwargs["memory_space"]))
+                base = (mod.resolve(tgt.args[0]) or "").rsplit(
+                    ".", 1)[-1]
+                if base == "BlockSpec":
+                    shape = call.args[0] if call.args else None
+                    return shape, space or inner_space, list(call.args)
+    return None, space, list(call.args)
+
+
+def _block_quantity(res: _Resolver, shape: ast.AST) -> Quantity:
+    if isinstance(shape, (ast.Tuple, ast.List)):
+        return res._dims_quantity(shape.elts) or _q_sym(_srctext(shape))
+    return _q_sym(_srctext(shape))
+
+
+def _scratch_components(mod: ModuleInfo, res: _Resolver,
+                        entries: list[tuple[ast.AST, Quantity]],
+                        label: str) -> tuple[list[Component], int]:
+    comps: list[Component] = []
+    n_sems = 0
+    for i, (call, count) in enumerate(entries):
+        if not isinstance(call, ast.Call):
+            continue
+        name = mod.resolve(call.func) or _srctext(call.func)
+        base = name.rsplit(".", 1)[-1]
+        if "SemaphoreType" in name or base in ("DMA", "REGULAR",
+                                               "BARRIER"):
+            n_sems += 1
+            continue
+        if base in ("VMEM", "SMEM", "ANY"):
+            if base != "VMEM":
+                continue
+            shape = call.args[0] if call.args else None
+            dtype = call.args[1] if len(call.args) > 1 else None
+            q = (_block_quantity(res, shape) if shape is not None
+                 else _q_sym(_srctext(call)))
+            width, dsrc = res.dtype_bytes(dtype)
+            comps.append(Component(
+                label=f"{label}[{i}]", quantity=_q_mul(q, count),
+                dtype_bytes=width, dtype_src=dsrc))
+    return comps, n_sems
+
+
+def _out_shape_entries(node: ast.AST | None, res: _Resolver,
+                       mod: ModuleInfo, site: ast.AST,
+                       seen: frozenset[str] = frozenset()
+                       ) -> list[ast.Call]:
+    """ShapeDtypeStruct calls of an out_shape expression. ``seen``
+    breaks self-referential rebuilds (``out_shape = [out_shape, …]``,
+    the fused-MLP save-a pattern)."""
+    if node is None:
+        return []
+    if isinstance(node, ast.Name):
+        if node.id in seen:
+            return []
+        seen = seen | {node.id}
+        parts = res.assignments_to(node.id, site)
+        out: list[ast.Call] = []
+        for _, value in parts:
+            out.extend(_out_shape_entries(value, res, mod, site, seen))
+        if out:
+            return out
+        tgt = res.table.get(node.id)
+        return _out_shape_entries(tgt, res, mod, site, seen) \
+            if tgt is not None else []
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            out.extend(_out_shape_entries(e, res, mod, site, seen))
+        return out
+    if isinstance(node, ast.Call):
+        base = (mod.resolve(node.func) or "").rsplit(".", 1)[-1]
+        if base in ("ShapeDtypeStruct", "_sds"):
+            return [node]
+    return []
+
+
+def estimate_call(mod: ModuleInfo, call: ast.Call) -> KernelEstimate:
+    """The VMEM estimate for one ``pallas_call`` site."""
+    res = _Resolver(mod, call)
+    kwargs = _call_kwargs(call)
+    grid_spec = kwargs.get("grid_spec")
+    if isinstance(grid_spec, ast.Call):
+        inner = _call_kwargs(grid_spec)
+        for key in ("in_specs", "out_specs", "scratch_shapes", "grid"):
+            if key in inner and key not in kwargs:
+                kwargs[key] = inner[key]
+
+    est = KernelEstimate(
+        kernel=_kernel_label(mod, call), path=mod.path,
+        line=call.lineno, node=call)
+
+    # blocks: in_specs + out_specs with explicit shapes; whole-array
+    # VMEM specs fall back to the operand/out_shape element counts
+    out_shapes = _out_shape_entries(kwargs.get("out_shape"), res, mod,
+                                    call)
+    operands = _operand_exprs(mod, call)
+    for label, key, fallback in (("in", "in_specs", operands),
+                                 ("out", "out_specs", out_shapes)):
+        entries = _spec_entries(mod, res, kwargs.get(key), call)
+        # positional cursor into the operand list: a ``[spec] * n``
+        # repeat covers n OPERANDS, so a whole-array entry must expand
+        # to one component per covered operand (x AND w, not x twice)
+        cursor = 0
+        for i, (spec, count) in enumerate(entries):
+            k = q_exact(count)
+            width = k if isinstance(k, int) and k > 0 else 1
+            shape, space, _ = _resolve_spec_call(mod, res, spec)
+            space_base = (space or "").rsplit(".", 1)[-1]
+            if space_base in ("SMEM", "ANY"):
+                cursor += width
+                continue
+            if shape is not None:
+                q = _block_quantity(res, shape)
+                est.components.append(Component(
+                    label=f"{label}[{i}]", quantity=_q_mul(q, count),
+                    dtype_bytes=None, dtype_src=f"{label}[{i}].dtype"))
+                cursor += width
+                continue
+            # whole-array residency: the operand / out_shape size per
+            # covered position
+            for j in range(width):
+                pos = cursor + j
+                fb = fallback[pos] if pos < len(fallback) else None
+                comp = _whole_array_component(mod, res, fb,
+                                              f"{label}[{pos}]")
+                if comp is not None:
+                    est.components.append(comp)
+            cursor += width
+    if not kwargs.get("out_specs") and out_shapes:
+        for i, sds in enumerate(out_shapes):
+            comp = _whole_array_component(mod, res, sds, f"out[{i}]")
+            if comp is not None:
+                est.components.append(comp)
+
+    scratch = _spec_entries(mod, res, kwargs.get("scratch_shapes"), call)
+    comps, n_sems = _scratch_components(mod, res, scratch, "scratch")
+    est.components.extend(comps)
+    est.n_sems += n_sems
+
+    # run_scoped allocations inside the kernel body (the pipeline
+    # kernels allocate their double-buffers there, not in the call)
+    for fn in (resolve_kernel_arg(mod, call.args[0], call)
+               if call.args else []):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and (
+                    mod.resolve(node.func) or "").rsplit(".", 1)[-1] \
+                    == "run_scoped":
+                # allocations ride as keywords (the tree's style) OR
+                # positionally after the body — both count
+                alloc_exprs = [kw.value for kw in node.keywords
+                               if kw.arg] + list(node.args[1:])
+                scoped = [(e, _q_const(1)) for e in alloc_exprs
+                          if isinstance(e, ast.Call)]
+                comps, n_sems = _scratch_components(
+                    mod, _Resolver(mod, node), scoped, "scoped")
+                est.components.extend(comps)
+                est.n_sems += n_sems
+
+    # the limit this kernel lowers against
+    params = kwargs.get("compiler_params")
+    if isinstance(params, ast.Call):
+        limit = _call_kwargs(params).get("vmem_limit_bytes")
+        if limit is not None:
+            val = q_exact(res.quantity(limit))
+            if val is not None:
+                est.limit_bytes = val
+                est.limit_default = False
+    return est
+
+
+def _operand_exprs(mod: ModuleInfo, call: ast.Call) -> list[ast.AST]:
+    """The operand expressions of ``pl.pallas_call(...)(*operands)`` —
+    the parent Call's arguments, when the site is called directly."""
+    parent = mod.parents.get(call)
+    if isinstance(parent, ast.Call) and parent.func is call:
+        out: list[ast.AST] = []
+        for a in parent.args:
+            if isinstance(a, ast.Starred):
+                inner = a.value
+                if isinstance(inner, ast.Name):
+                    res = _Resolver(mod, call)
+                    parts = res.assignments_to(inner.id, call)
+                    for _, value in parts:
+                        if isinstance(value, (ast.List, ast.Tuple)):
+                            out.extend(value.elts)
+                continue
+            out.append(a)
+        return out
+    return []
+
+
+def _whole_array_component(mod: ModuleInfo, res: _Resolver,
+                           expr: ast.AST | None,
+                           label: str) -> Component | None:
+    if expr is None:
+        return Component(label=label,
+                         quantity=_q_sym(f"{label}.elems"),
+                         dtype_bytes=None, dtype_src=f"{label}.dtype")
+    if isinstance(expr, ast.Call):
+        base = (mod.resolve(expr.func) or "").rsplit(".", 1)[-1]
+        if base in ("ShapeDtypeStruct", "_sds") and expr.args:
+            shape = expr.args[0]
+            dtype = expr.args[1] if len(expr.args) > 1 else None
+            q = (res._dims_quantity(shape.elts)
+                 if isinstance(shape, (ast.Tuple, ast.List)) else None)
+            width, dsrc = res.dtype_bytes(dtype)
+            return Component(
+                label=label,
+                quantity=q if q is not None else _q_sym(_srctext(shape)),
+                dtype_bytes=width, dtype_src=dsrc)
+    q = res.shape_quantity(expr)
+    if q is None:
+        q = _q_sym(f"elems({_srctext(expr)})")
+    return Component(label=label, quantity=q, dtype_bytes=None,
+                     dtype_src=f"{_srctext(expr)}.dtype")
+
+
+def estimate_module(mod: ModuleInfo) -> list[KernelEstimate]:
+    """One estimate per ``pallas_call`` in the module, source order."""
+    out = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and (
+                mod.resolve(node.func) or "").rsplit(".", 1)[-1] \
+                == "pallas_call":
+            out.append(estimate_call(mod, node))
+    return sorted(out, key=lambda e: e.line)
+
+
+def estimate_paths(paths) -> list[KernelEstimate]:
+    """Estimates across files/dirs (the ``--vmem-report`` driver)."""
+    from hpc_patterns_tpu.analysis.core import iter_python_files
+
+    out: list[KernelEstimate] = []
+    for f in iter_python_files(paths):
+        try:
+            mod = ModuleInfo.parse(f)
+        except SyntaxError:
+            continue
+        out.extend(estimate_module(mod))
+    return out
+
+
+def format_vmem_table(estimates: list[KernelEstimate],
+                      bindings: dict[str, int] | None = None,
+                      root: str | Path | None = None) -> str:
+    """The ``--vmem-report`` table: per-kernel byte totals under the
+    model dims, against each kernel's limit, ASSUMED symbols named."""
+    lines = [
+        f"{'kernel':<28} {'site':<34} {'vmem bytes':>12} "
+        f"{'limit':>10} {'frac':>6}  notes",
+    ]
+    for est in estimates:
+        total, assumed = est.model_bytes(bindings)
+        path = est.path
+        if root is not None:
+            try:
+                path = str(Path(est.path).relative_to(root))
+            except ValueError:
+                pass
+        site = f"{path}:{est.line}"
+        frac = total / est.limit_bytes if est.limit_bytes else 0.0
+        notes = []
+        if est.limit_default:
+            notes.append("default-limit")
+        if est.n_sems:
+            notes.append(f"{est.n_sems} sem(s)")
+        if assumed:
+            shown = sorted(assumed)[:4]
+            more = len(assumed) - len(shown)
+            notes.append("ASSUMED " + ",".join(shown)
+                         + (f" +{more}" if more > 0 else ""))
+        flag = " OVER" if total > est.limit_bytes else ""
+        lines.append(
+            f"{est.kernel[:28]:<28} {site[-34:]:<34} {total:>12,} "
+            f"{est.limit_bytes // (1024 * 1024):>8}MB {frac:>6.2f}"
+            f"{flag}  {'; '.join(notes)}")
+    if not estimates:
+        lines.append("(no pallas_call sites found)")
+    return "\n".join(lines)
+
+
+def vmem_summary(estimates: list[KernelEstimate]) -> dict:
+    """JSON-able rollup for the ``kind=analysis`` RunLog record."""
+    rows = []
+    n_over = 0
+    for est in estimates:
+        total, assumed = est.model_bytes()
+        over = total > est.limit_bytes
+        n_over += bool(over)
+        rows.append({
+            "kernel": est.kernel,
+            "line": est.line,
+            "bytes": total,
+            "limit": est.limit_bytes,
+            "over": over,
+            "assumed": sorted(assumed),
+        })
+    return {"kernels": len(estimates), "over_limit": n_over,
+            "rows": rows}

@@ -46,9 +46,14 @@ Execution modes:
   dataflow — schedules, chunk indices, combines, epilogues — runs and
   is oracle-checked on the 8-device CPU mesh. Semaphores are inert
   arithmetic there, so the *synchronization protocol* (slot lifetimes,
-  send-reuse waits) is exercised only on chip — the documented reground
-  step. jax's discharge rule supports a single named mesh axis only;
-  the Communicator enforces that at routing time.
+  send-reuse waits, the drain discipline) is proven off-chip by the
+  pallaslint semaphore ledger (``analysis/pallas_rules.py``, review
+  time) and the strict-semaphore shim the parity battery runs under
+  (``analysis/runtime.strict_semaphores``, trace time); what stays
+  hardware-empirical is Mosaic's lowering and real DMA rates — the
+  documented reground step. jax's discharge rule supports a single
+  named mesh axis only; the Communicator enforces that at routing
+  time.
 - **compiled** (TPU): the same kernel lowered by Mosaic; neighbor ids
   ride ``DeviceIdType.LOGICAL`` scalars (mesh position == logical id on
   the 1-D meshes this layer binds).
@@ -72,6 +77,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from hpc_patterns_tpu.comm import ring
 from hpc_patterns_tpu.ops.tiling import (
+    collective_id as _registered_collective_id,
     default_interpret,
     tpu_compiler_params,
 )
@@ -165,7 +171,7 @@ def _remote_copy(src, dst, send_sem, recv_sem, device_id):
 
 
 def fused_permute(x, axis: str, perm, *, interpret: bool | None = None,
-                  collective_id: int = 0):
+                  collective_id: int | None = None):
     """``lax.ppermute`` with the transfer issued by the device: rank
     ``s`` DMAs its shard straight into rank ``d``'s buffer for every
     ``(s, d)`` in ``perm``. The pair list passes
@@ -174,7 +180,12 @@ def fused_permute(x, axis: str, perm, *, interpret: bool | None = None,
     on exactly one incoming copy). ``collective_id``: kernels that may
     run CONCURRENTLY on chip (e.g. the K and V shifts of one
     ring-attention step) must carry distinct ids — same-id collective
-    kernels share barrier state."""
+    kernels share barrier state. Pass an id from
+    :func:`ops.tiling.collective_id` (never a hand-picked integer —
+    pallaslint flags magic ids); None takes this kernel's registered
+    default."""
+    if collective_id is None:
+        collective_id = _registered_collective_id("comm.fused.permute")
     size = ring.axis_size(axis)
     perm = [(int(s), int(d)) for s, d in perm]
     ring.check_permutation(perm, size)
@@ -214,7 +225,7 @@ def fused_permute(x, axis: str, perm, *, interpret: bool | None = None,
 
 def fused_ring_shift(x, axis: str, shift: int = 1, *,
                      interpret: bool | None = None,
-                     collective_id: int = 0):
+                     collective_id: int | None = None):
     """Device-initiated :func:`ring.ring_shift`: rank r's shard lands on
     rank ``(r + shift) % size`` via one in-kernel remote DMA."""
     size = ring.axis_size(axis)
@@ -366,9 +377,11 @@ def fused_allreduce(x, axis: str, *, op: str = "sum",
             pltpu.SemaphoreType.DMA((size - 1,)),
             pltpu.SemaphoreType.DMA((size - 1,)),
         ],
-        compiler_params=tpu_compiler_params(has_side_effects=True,
-                                            collective_id=1,
-                                            vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=tpu_compiler_params(
+            has_side_effects=True,
+            collective_id=_registered_collective_id(
+                "comm.fused.allreduce"),
+            vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(*operands)
     if n_pad != n:
@@ -451,8 +464,10 @@ def allgather_matmul(x, w, axis: str, *, interpret: bool | None = None):
             pltpu.SemaphoreType.DMA((size - 1,)),
             pltpu.SemaphoreType.DMA((size - 1,)),
         ],
-        compiler_params=tpu_compiler_params(has_side_effects=True,
-                                            collective_id=2),
+        compiler_params=tpu_compiler_params(
+            has_side_effects=True,
+            collective_id=_registered_collective_id(
+                "comm.fused.allgather_matmul")),
         interpret=interpret,
     )(x, w)
 

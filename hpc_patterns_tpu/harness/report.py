@@ -84,6 +84,7 @@ def aggregate(records: list[dict[str, Any]]) -> dict[str, Any]:
                 "baselined": rec.get("baselined", 0),
                 "files": rec.get("files", 0),
                 "by_rule": rec.get("by_rule", {}),
+                "vmem": rec.get("vmem"),
             })
         if kind == "trace_merged":
             # cross-rank merge verdict (harness/collect.py): the
@@ -179,6 +180,22 @@ def format_report(agg: dict[str, Any], source: str = "") -> str:
             + f", {a['suppressed']} suppressed"
             + (f", {a['baselined']} baselined" if a["baselined"] else "")
             + f" across {a['files']} file(s) (jaxlint)")
+        vm = a.get("vmem")
+        if vm:
+            # the pallaslint VMEM budget rollup (analysis/vmem.py):
+            # the worst model-dim kernel named so a chip session's
+            # lowering failure is never the first warning
+            worst = max(vm.get("rows", []),
+                        key=lambda r: (r.get("bytes", 0)
+                                       / max(r.get("limit", 1), 1)),
+                        default=None)
+            line = (f"  vmem: {vm.get('kernels', 0)} kernel(s), "
+                    f"{vm.get('over_limit', 0)} over model-dim budget")
+            if worst is not None:
+                line += (f"; worst {worst['kernel']} "
+                         f"{worst['bytes'] / 1e6:.1f}/"
+                         f"{worst['limit'] / 1e6:.0f} MB")
+            lines.append(line)
     for t in agg.get("merged_traces", []):
         worst_name, worst = None, 0.0
         for name, s in t["skew"].items():

@@ -7,12 +7,77 @@ when to fall back to interpret mode, and how to spell
 (``ops/fused_mlp.py``, ``ops/flash_attention.py``, and now
 ``comm/fused.py``). One module owns them so a kernel added tomorrow
 cannot disagree with the kernels that exist today.
+
+The module also owns the **collective-id registry**
+(:func:`collective_id`): every remote-DMA kernel that may run
+concurrently with another must carry a distinct ``collective_id`` —
+same-id kernels share barrier/DMA state on chip, and a collision hangs
+or corrupts silently (interpret mode never exercises it). The ids used
+to be hand-numbered 0-4 across ``comm/fused.py`` and
+``parallel/ring_attention.py`` by convention; the registry assigns
+them by NAME, so a collision is impossible by construction, and
+pallaslint's ``collective-id-collision`` rule flags any site that
+bypasses it with a magic number.
 """
 
 from __future__ import annotations
 
 import jax
 from jax.experimental.pallas import tpu as pltpu
+
+#: name -> collective_id. Seeded with the historical 0-4 assignment so
+#: the wire ids of the shipped kernels never move; new names derive
+#: their id from the NAME itself (below), so every host of an SPMD job
+#: computes the same id regardless of which kernel warms up first.
+#: Names are dotted module-ish paths — the registry's job is
+#: distinctness, the name's job is greppability.
+_COLLECTIVE_IDS: dict[str, int] = {
+    "comm.fused.permute": 0,
+    "comm.fused.allreduce": 1,
+    "comm.fused.allgather_matmul": 2,
+    "parallel.ring_attention.kshift": 3,
+    "parallel.ring_attention.vshift": 4,
+}
+
+#: new ids live in [_ID_FLOOR, _ID_FLOOR + _ID_SPAN): above the seeded
+#: block, inside int32 (the CompilerParams field), with enough space
+#: that name-hash collisions are a rename away from impossible
+_ID_FLOOR = 16
+_ID_SPAN = (1 << 20) - _ID_FLOOR
+
+
+def _derived_id(name: str) -> int:
+    import hashlib
+
+    digest = hashlib.sha256(name.encode()).digest()
+    return _ID_FLOOR + int.from_bytes(digest[:8], "big") % _ID_SPAN
+
+
+def collective_id(name: str) -> int:
+    """The registered ``collective_id`` for ``name``. Unseeded names
+    get a name-derived id — a pure function of the string, so ids
+    agree across hosts/processes whatever order kernels first run in
+    (order-dependent assignment would be the cross-host wire mismatch
+    this registry exists to prevent). Two kernels that may run
+    concurrently simply register distinct names; nobody ever picks an
+    integer. A hash collision between two registered names raises
+    loudly (rename one) instead of silently sharing barrier state."""
+    if name not in _COLLECTIVE_IDS:
+        new_id = _derived_id(name)
+        taken = {v: k for k, v in _COLLECTIVE_IDS.items()}
+        if new_id in taken:
+            raise ValueError(
+                f"collective_id hash collision: {name!r} and "
+                f"{taken[new_id]!r} both derive id {new_id} — rename "
+                f"one (any change to the string re-rolls the id)")
+        _COLLECTIVE_IDS[name] = new_id
+    return _COLLECTIVE_IDS[name]
+
+
+def registered_collective_ids() -> dict[str, int]:
+    """Snapshot of the registry (tests assert distinctness and the
+    pinned historical assignments)."""
+    return dict(_COLLECTIVE_IDS)
 
 # CompilerParams was TPUCompilerParams before the pallas.tpu rename;
 # bind whichever this jax build exports

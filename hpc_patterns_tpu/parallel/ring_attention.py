@@ -98,17 +98,22 @@ def _kv_rotate(axis: str, shift_impl: str):
             lambda t: ring.ring_shift(t, axis, 1), kv)
     if shift_impl == "fused":
         from hpc_patterns_tpu.comm import fused
+        from hpc_patterns_tpu.ops import tiling
 
         # K and V shift as two data-independent kernels the scheduler
-        # may overlap on chip — distinct collective_ids keep their
-        # barrier/DMA state apart (ids 3/4: 0-2 are taken by
-        # permute/allreduce/allgather_matmul defaults)
+        # may overlap on chip — distinct registered collective_ids keep
+        # their barrier/DMA state apart (the registry in ops/tiling.py
+        # owns the numbering; hand-picked integers are a pallaslint
+        # finding)
+        k_id = tiling.collective_id("parallel.ring_attention.kshift")
+        v_id = tiling.collective_id("parallel.ring_attention.vshift")
+
         def rotate(kv):
             k_blk, v_blk = kv
             return (fused.fused_ring_shift(k_blk, axis, 1,
-                                           collective_id=3),
+                                           collective_id=k_id),
                     fused.fused_ring_shift(v_blk, axis, 1,
-                                           collective_id=4))
+                                           collective_id=v_id))
 
         return rotate
     raise ValueError(

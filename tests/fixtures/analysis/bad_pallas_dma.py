@@ -1,0 +1,161 @@
+"""Known-bad: the PR 8 chip-only bug shapes, minimized. Each kernel
+here passed interpret mode (DMAs serialize, semaphores are inert) and
+would deadlock, race, or corrupt on chip — the exact class pallaslint
+exists to catch at review time. ``drain_double_wait`` and
+``gather_into_rs_recv`` are line-for-line minimizations of the two
+hand-found fused-ring bugs; the collective-id and dtype kernels pin
+the other two review findings."""
+
+import functools
+
+import jax
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _remote(src, dst, send, recv, dev):
+    return pltpu.make_async_remote_copy(
+        src_ref=src, dst_ref=dst, send_sem=send, recv_sem=recv,
+        device_id=dev, device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+
+def drain_double_wait(x, axis, size, cn):
+    """The PR 8 drain bug: the slot-reuse waits inside the ring loop
+    already consumed dmas[0..size-3]'s send semaphores; the exit drain
+    re-waits every one — at size >= 3 the second wait never returns on
+    chip (one signal per DMA)."""
+
+    def kernel(x_ref, o_ref, rs_recv, sendbuf, send_sem, recv_sem):
+        me = lax.axis_index(axis)
+        dst = lax.rem(me + 1, size)
+        sendbuf[0] = x_ref[:, pl.ds(0, cn)]
+        dmas = []
+        d = _remote(sendbuf.at[0], rs_recv.at[0], send_sem.at[0],
+                    recv_sem.at[0], dst)
+        d.start()
+        dmas.append(d)
+        for s in range(1, size):
+            dmas[s - 1].wait_recv()
+            slot = s % 2
+            if s >= 2:
+                dmas[s - 2].wait_send()
+            sendbuf[slot] = x_ref[:, pl.ds(s * cn, cn)] + rs_recv[s - 1]
+            if s < size - 1:
+                d = _remote(sendbuf.at[slot], rs_recv.at[s],
+                            send_sem.at[slot], recv_sem.at[s], dst)
+                d.start()
+                dmas.append(d)
+        o_ref[...] = sendbuf[(size - 1) % 2]
+        for d in dmas:
+            d.wait_send()  # EXPECT: dma-sem-balance
+
+    return pl.pallas_call(kernel, out_shape=x)(x)
+
+
+def undrained_send(x, axis, size):
+    """A started remote DMA whose send semaphore is never waited: the
+    copy outlives the kernel's scratch — racing its teardown."""
+
+    def kernel(x_ref, o_ref, buf, send_sem, recv_sem):
+        me = lax.axis_index(axis)
+        d = _remote(x_ref, buf.at[0], send_sem.at[0], recv_sem.at[0],
+                    lax.rem(me + 1, size))
+        d.start()  # EXPECT: dma-sem-balance
+        d.wait_recv()
+        o_ref[...] = buf[0]
+
+    return pl.pallas_call(kernel, out_shape=x)(x)
+
+
+def gather_into_rs_recv(x, axis, size):
+    """The PR 8 gather-slot bug: the gather phase lands its DMAs in
+    the reduce-scatter recv slots. Nothing orders my phase-1
+    completion after the neighbor's phase-1 READ of that slot — the
+    gather write can clobber bytes a slower neighbor is still
+    consuming. Dedicated per-phase recv buffers are the discipline."""
+
+    def kernel(x_ref, o_ref, rs_recv, sendbuf, rs_send, rs_sem,
+               ag_send, ag_sem):
+        me = lax.axis_index(axis)
+        dst = lax.rem(me + 1, size)
+        d = _remote(sendbuf.at[0], rs_recv.at[0], rs_send.at[0],
+                    rs_sem.at[0], dst)
+        d.start()
+        d.wait()
+        g = _remote(sendbuf.at[0], rs_recv.at[1], ag_send.at[0],
+                    ag_sem.at[0], dst)
+        g.start()  # EXPECT: dma-slot-reuse
+        g.wait()
+
+    return pl.pallas_call(kernel, out_shape=x)(x)
+
+
+def send_slot_rewritten(x, axis, size):
+    """Slot reuse without the send wait: iteration s rewrites the
+    alternating send buffer while the DMA issued two steps earlier may
+    still be reading it — the copy can ship the NEW bytes."""
+
+    def kernel(x_ref, o_ref, recvb, sendbuf, send_sem, recv_sem):
+        me = lax.axis_index(axis)
+        dst = lax.rem(me + 1, size)
+        dmas = []
+        for s in range(size - 1):
+            slot = s % 2
+            sendbuf[slot] = x_ref[...] * s  # EXPECT: dma-slot-reuse
+            d = _remote(sendbuf.at[slot], recvb.at[s],
+                        send_sem.at[slot], recv_sem.at[s], dst)
+            d.start()
+            dmas.append(d)
+        for s in range(size - 1):
+            dmas[s].wait_recv()
+        for d in dmas:
+            d.wait_send()
+
+    return pl.pallas_call(kernel, out_shape=x)(x)
+
+
+def _double_kernel(x_ref, o_ref):
+    o_ref[...] = jnp_dot_like(x_ref)
+
+
+def jnp_dot_like(x_ref):
+    return x_ref[...]
+
+
+def shared_collective_id(x, w):
+    """The PR 8 shared-id bug: two kernels that can run concurrently
+    in one traced region, hand-numbered onto the SAME collective_id —
+    they share barrier/DMA state on chip and hang or corrupt; the
+    registry (ops.tiling.collective_id) makes this unrepresentable."""
+    a = pl.pallas_call(
+        _double_kernel,
+        out_shape=x,
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True,
+            collective_id=1),  # EXPECT: collective-id-collision
+    )(x)
+    b = pl.pallas_call(
+        _double_kernel,
+        out_shape=w,
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True,
+            collective_id=1),  # EXPECT: collective-id-collision
+    )(w)
+    return a, b
+
+
+def _widened_store_kernel(x_ref, w_ref, o_ref):
+    # the PR 8 dtype hole: an f32-widened matmul landing in the output
+    # ref with no explicit narrowing cast — interpret inserts it,
+    # Mosaic need not
+    o_ref[...] = jax.numpy.dot(  # EXPECT: kernel-dtype-cast
+        x_ref[...], w_ref[...],
+        preferred_element_type=jax.numpy.float32)
+
+
+def widened_store(x, w):
+    return pl.pallas_call(
+        functools.partial(_widened_store_kernel),
+        out_shape=x,
+    )(x, w)

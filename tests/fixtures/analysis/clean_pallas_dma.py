@@ -1,0 +1,131 @@
+"""Known-clean: the same ring shapes with the discipline
+``comm/fused.py`` actually ships — tail-only drain after the
+slot-reuse wait chain, dedicated per-phase recv buffers, send waits
+before slot rewrites, registry collective ids, and the explicit
+``.astype(o_ref.dtype)`` on widened stores."""
+
+import jax
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hpc_patterns_tpu.ops.tiling import collective_id
+
+
+def _remote(src, dst, send, recv, dev):
+    return pltpu.make_async_remote_copy(
+        src_ref=src, dst_ref=dst, send_sem=send, recv_sem=recv,
+        device_id=dev, device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+
+def ring_with_tail_drain(x, axis, size, cn):
+    """The fixed drain: the in-loop slot-reuse waits consumed
+    dmas[0..size-3]'s sends; only the LAST send is still outstanding
+    at exit, and only it is waited."""
+
+    def kernel(x_ref, o_ref, rs_recv, sendbuf, send_sem, recv_sem):
+        me = lax.axis_index(axis)
+        dst = lax.rem(me + 1, size)
+        sendbuf[0] = x_ref[:, pl.ds(0, cn)]
+        dmas = []
+        d = _remote(sendbuf.at[0], rs_recv.at[0], send_sem.at[0],
+                    recv_sem.at[0], dst)
+        d.start()
+        dmas.append(d)
+        for s in range(1, size):
+            dmas[s - 1].wait_recv()
+            slot = s % 2
+            if s >= 2:
+                dmas[s - 2].wait_send()
+            sendbuf[slot] = x_ref[:, pl.ds(s * cn, cn)] + rs_recv[s - 1]
+            if s < size - 1:
+                d = _remote(sendbuf.at[slot], rs_recv.at[s],
+                            send_sem.at[slot], recv_sem.at[s], dst)
+                d.start()
+                dmas.append(d)
+        o_ref[...] = sendbuf[(size - 1) % 2]
+        dmas[-1].wait_send()
+
+    return pl.pallas_call(kernel, out_shape=x)(x)
+
+
+def dedicated_phase_buffers(x, axis, size):
+    """Each phase lands its DMAs in its OWN recv scratch under its own
+    semaphore family — the race-free split comm/fused.py documents."""
+
+    def kernel(x_ref, o_ref, rs_recv, ag_recv, sendbuf, rs_send,
+               rs_sem, ag_send, ag_sem):
+        me = lax.axis_index(axis)
+        dst = lax.rem(me + 1, size)
+        d = _remote(sendbuf.at[0], rs_recv.at[0], rs_send.at[0],
+                    rs_sem.at[0], dst)
+        d.start()
+        d.wait()
+        g = _remote(sendbuf.at[0], ag_recv.at[0], ag_send.at[0],
+                    ag_sem.at[0], dst)
+        g.start()
+        g.wait()
+
+    return pl.pallas_call(kernel, out_shape=x)(x)
+
+
+def send_wait_before_rewrite(x, axis, size):
+    """The alternating send slot is rewritten only after the DMA that
+    read it two steps ago has drained."""
+
+    def kernel(x_ref, o_ref, recvb, sendbuf, send_sem, recv_sem):
+        me = lax.axis_index(axis)
+        dst = lax.rem(me + 1, size)
+        dmas = []
+        for s in range(size - 1):
+            slot = s % 2
+            if s >= 2:
+                dmas[s - 2].wait_send()
+            sendbuf[slot] = x_ref[...] * s
+            d = _remote(sendbuf.at[slot], recvb.at[s],
+                        send_sem.at[slot], recv_sem.at[s], dst)
+            d.start()
+            dmas.append(d)
+        for s in range(size - 1):
+            dmas[s].wait_recv()
+        for d in dmas[max(0, len(dmas) - 2):]:
+            d.wait_send()
+
+    return pl.pallas_call(kernel, out_shape=x)(x)
+
+
+def _double_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def registry_collective_ids(x, w):
+    """Concurrent kernels with REGISTERED ids: distinct by
+    construction, greppable by name."""
+    a = pl.pallas_call(
+        _double_kernel,
+        out_shape=x,
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True,
+            collective_id=collective_id("fixture.clean.a")),
+    )(x)
+    b = pl.pallas_call(
+        _double_kernel,
+        out_shape=w,
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True,
+            collective_id=collective_id("fixture.clean.b")),
+    )(w)
+    return a, b
+
+
+def _cast_store_kernel(x_ref, w_ref, o_ref):
+    # the widened matmul lands through an explicit narrowing cast —
+    # the contract interpret and Mosaic both honor
+    o_ref[...] = jax.numpy.dot(
+        x_ref[...], w_ref[...],
+        preferred_element_type=jax.numpy.float32,
+    ).astype(o_ref.dtype)
+
+
+def cast_store(x, w):
+    return pl.pallas_call(_cast_store_kernel, out_shape=x)(x, w)

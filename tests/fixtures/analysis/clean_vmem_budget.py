@@ -1,0 +1,42 @@
+"""Known-clean: the same kernel shapes with scratch sized to the
+budget — a lane-aligned f32 accumulator well under the default scoped
+limit, and a declared limit that actually covers its double-buffer
+(the comm/fused.py pattern: the override is deliberate, justified,
+and sufficient)."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _accum_kernel(x_ref, o_ref, acc_ref):
+    o_ref[...] = x_ref[...] + acc_ref[...]
+
+
+def scratch_inside_default_limit(x):
+    return pl.pallas_call(
+        _accum_kernel,
+        out_shape=jax.ShapeDtypeStruct((128, 128), jnp.float32),
+        in_specs=[pl.BlockSpec((128, 128), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((128, 128), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        grid=(1,),
+        scratch_shapes=[pltpu.VMEM((512, 512), jnp.float32)],
+    )(x)
+
+
+def scratch_inside_declared_limit(x):
+    return pl.pallas_call(
+        _accum_kernel,
+        out_shape=jax.ShapeDtypeStruct((128, 128), jnp.float32),
+        in_specs=[pl.BlockSpec((128, 128), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((128, 128), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        grid=(1,),
+        scratch_shapes=[pltpu.VMEM((2, 1024, 1024), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=16 * 1024 * 1024),
+    )(x)

@@ -74,7 +74,12 @@ class TestGoldenFixtures:
                 "recompile-hazard", "prng-key-reuse",
                 "tracer-leak", "collective-divergence",
                 "collective-order", "unchecked-permutation",
-                "spec-mismatch"} <= caught
+                "spec-mismatch",
+                # the pallaslint family (PR 13): every PR 8 chip-only
+                # bug shape has a caught minimized replica
+                "dma-sem-balance", "dma-slot-reuse",
+                "collective-id-collision", "kernel-dtype-cast",
+                "vmem-budget"} <= caught
 
     def test_rank_branched_deadlock_replica_is_caught_at_the_branch(self):
         live, _ = core.analyze_file(
@@ -236,7 +241,9 @@ class TestCLI:
                      "recompile-hazard", "prng-key-reuse",
                      "tracer-leak", "collective-divergence",
                      "collective-order", "unchecked-permutation",
-                     "spec-mismatch"):
+                     "spec-mismatch", "dma-sem-balance",
+                     "dma-slot-reuse", "collective-id-collision",
+                     "kernel-dtype-cast", "vmem-budget"):
             assert rule in out
 
 
@@ -603,3 +610,449 @@ class TestMarker:
             return x + 1
 
         assert dispatch_critical(g) is g
+
+
+class TestPallasLedger:
+    """Engine-level behaviors of the semaphore-ledger abstract
+    interpreter (analysis/pallas_rules.py) beyond the line-exact
+    fixture corpus."""
+
+    def _ledger(self, path):
+        from hpc_patterns_tpu.analysis import pallas_rules as pr
+
+        return pr.ledger_findings(ModuleInfo.parse(path))
+
+    def test_live_kernel_tier_is_clean(self):
+        # the burn-down target: the fused rings, the flash/paged/MLP
+        # kernels, and the on-chip pipeline all balance
+        for rel in ("comm/fused.py", "concurrency/pipeline.py",
+                    "concurrency/kernels.py", "ops/flash_attention.py",
+                    "ops/flash_decode.py", "ops/fused_mlp.py",
+                    "ops/paged_attention.py"):
+            findings = self._ledger(PACKAGE / rel)
+            assert not findings, (rel, [(k, n.lineno, m)
+                                        for k, n, m in findings])
+
+    def test_fused_kernels_are_analyzed_not_abstained(self):
+        # 0 findings must mean "proved balanced", not "gave up": the
+        # interpreter must actually record DMA signals for every
+        # fused kernel root
+        from hpc_patterns_tpu.analysis import pallas_rules as pr
+
+        mod = ModuleInfo.parse(PACKAGE / "comm" / "fused.py")
+        roots = pr._kernel_roots(mod)
+        assert len(roots) == 3  # permute, allreduce, allgather_matmul
+        signals = {"n": 0}
+        orig = pr._KernelRun._signal
+
+        def counting(self, key, node, _orig=orig):
+            signals["n"] += 1
+            return _orig(self, key, node)
+
+        pr._KernelRun._signal = counting
+        try:
+            for fn in roots:
+                before = signals["n"]
+                assert pr._analyze_kernel(mod, fn) == []
+                assert signals["n"] > before, (
+                    f"kernel at line {fn.lineno} abstained")
+        finally:
+            pr._KernelRun._signal = orig
+
+    def test_model_ring_covers_the_drain_bug_threshold(self):
+        # the PR 8 drain double-wait manifests at size >= 3; the
+        # modeled ring must be past it or the fixture could pass
+        from hpc_patterns_tpu.analysis import pallas_rules as pr
+
+        assert pr.MODEL_RING >= 3
+
+    def test_drain_double_wait_anchored_at_the_drain(self):
+        live, _ = core.analyze_file(FIXTURES / "bad_pallas_dma.py")
+        balance = [f for f in live if f.rule == "dma-sem-balance"]
+        assert balance, "the PR 8 drain replica must be flagged"
+        src = (FIXTURES / "bad_pallas_dma.py").read_text()
+        flagged = src.splitlines()[balance[0].line - 1]
+        assert "wait_send" in flagged  # the re-wait, not the loop head
+
+    def test_phase_crossed_recv_names_both_sem_families(self):
+        live, _ = core.analyze_file(FIXTURES / "bad_pallas_dma.py")
+        reuse = [f for f in live if f.rule == "dma-slot-reuse"
+                 and "semaphore families" in f.message]
+        assert len(reuse) == 1
+        assert "rs_sem" in reuse[0].message
+        assert "ag_sem" in reuse[0].message
+
+    def test_opaque_loop_with_dma_abstains_not_guesses(self, tmp_path):
+        # a DMA under a loop the interpreter cannot unroll (opaque
+        # iterable, not a range) must produce silence, not findings
+        f = tmp_path / "m.py"
+        f.write_text(
+            "from jax.experimental import pallas as pl\n"
+            "from jax.experimental.pallas import tpu as pltpu\n"
+            "def run(x, schedule):\n"
+            "    def kernel(x_ref, o_ref, buf, sem):\n"
+            "        for hop in schedule:\n"
+            "            d = pltpu.make_async_copy(\n"
+            "                x_ref, buf.at[0], sem.at[0])\n"
+            "            d.start()\n"
+            "    return pl.pallas_call(kernel, out_shape=x)(x)\n")
+        live, _ = core.analyze_file(f)
+        assert not live
+
+    def test_mode_switch_predicates_stay_consistent(self, tmp_path):
+        # a factory kernel branching on one opaque subject must not
+        # fork into impossible combinations (mode == 'a' AND
+        # mode == 'b') and fake an imbalance — the pipeline.py shape
+        f = tmp_path / "m.py"
+        f.write_text(
+            "from jax.experimental import pallas as pl\n"
+            "from jax.experimental.pallas import tpu as pltpu\n"
+            "def make(mode):\n"
+            "    def kernel(x_ref, o_ref, buf, sem):\n"
+            "        d = pltpu.make_async_copy(x_ref, buf.at[0],\n"
+            "                                  sem.at[0])\n"
+            "        if mode == 'eager':\n"
+            "            d.start()\n"
+            "            d.wait()\n"
+            "        if mode != 'eager':\n"
+            "            pass\n"
+            "    return kernel\n"
+            "def run(x, mode):\n"
+            "    return pl.pallas_call(make(mode), out_shape=x)(x)\n")
+        live, _ = core.analyze_file(f)
+        assert not live
+
+    def test_magic_collective_id_flagged_registry_call_not(self,
+                                                           tmp_path):
+        f = tmp_path / "m.py"
+        f.write_text(
+            "from hpc_patterns_tpu.ops.tiling import collective_id\n"
+            "def a(params):\n"
+            "    return params(collective_id=7)\n"
+            "def b(params):\n"
+            "    return params(\n"
+            "        collective_id=collective_id('x.y'))\n")
+        live, _ = core.analyze_file(f)
+        assert [x.rule for x in live] == ["collective-id-collision"]
+        assert "7" in live[0].message
+
+    def test_duplicate_registry_names_collide(self, tmp_path):
+        # two call sites registering the SAME name is the shared-id
+        # bug wearing the registry's clothes — still flagged
+        f = tmp_path / "m.py"
+        f.write_text(
+            "from hpc_patterns_tpu.ops.tiling import collective_id\n"
+            "def a(params):\n"
+            "    return params(collective_id=collective_id('k'))\n"
+            "def b(params):\n"
+            "    return params(collective_id=collective_id('k'))\n")
+        live, _ = core.analyze_file(f)
+        assert [x.rule for x in live] == ["collective-id-collision"]
+        assert "'k'" in live[0].message
+
+
+class TestCollectiveIdRegistry:
+    def test_historical_ids_are_pinned(self):
+        # the shipped kernels' wire ids must never move: 0-4 as
+        # hand-numbered before the registry existed
+        from hpc_patterns_tpu.ops import tiling
+
+        ids = tiling.registered_collective_ids()
+        assert ids["comm.fused.permute"] == 0
+        assert ids["comm.fused.allreduce"] == 1
+        assert ids["comm.fused.allgather_matmul"] == 2
+        assert ids["parallel.ring_attention.kshift"] == 3
+        assert ids["parallel.ring_attention.vshift"] == 4
+
+    def test_new_names_get_distinct_ids_idempotently(self):
+        from hpc_patterns_tpu.ops import tiling
+
+        a = tiling.collective_id("test.registry.alpha")
+        b = tiling.collective_id("test.registry.beta")
+        assert a != b
+        assert tiling.collective_id("test.registry.alpha") == a
+        ids = tiling.registered_collective_ids()
+        assert len(set(ids.values())) == len(ids)  # never a collision
+
+    def test_new_ids_are_name_derived_not_order_derived(self):
+        # every host of an SPMD job must compute the same id for a
+        # name regardless of which kernel warms up first — the id is
+        # a pure function of the string, above the seeded block
+        from hpc_patterns_tpu.ops import tiling
+
+        a = tiling._derived_id("test.order.a")
+        b = tiling._derived_id("test.order.b")
+        assert a != b
+        assert min(a, b) >= tiling._ID_FLOOR
+        assert tiling.collective_id("test.order.b") == b  # b first
+        assert tiling.collective_id("test.order.a") == a
+        assert tiling._derived_id("test.order.a") == a  # deterministic
+
+    def test_registry_names_globally_unique_across_package(self):
+        # the cross-module half of collective-id-collision: the lint
+        # rule is per-module by engine design, so the whole-package
+        # invariant — no two call sites registering one name — is
+        # pinned here instead
+        import ast as astmod
+
+        registry_fns = ("collective_id", "_registered_collective_id")
+        sites: dict[str, list[str]] = {}
+        for path in sorted(PACKAGE.rglob("*.py")):
+            tree = astmod.parse(path.read_text())
+            for node in astmod.walk(tree):
+                if not (isinstance(node, astmod.Call) and node.args
+                        and isinstance(node.args[0], astmod.Constant)):
+                    continue
+                # both spellings count: bare collective_id(...) and
+                # tiling.collective_id(...) (the attribute form
+                # parallel/ring_attention.py uses)
+                func = node.func
+                name = (func.id if isinstance(func, astmod.Name)
+                        else func.attr
+                        if isinstance(func, astmod.Attribute) else "")
+                if name in registry_fns:
+                    sites.setdefault(str(node.args[0].value), []).append(
+                        f"{path.name}:{node.lineno}")
+        assert sites, "the registry call sites vanished"
+        dupes = {k: v for k, v in sites.items() if len(v) > 1}
+        assert not dupes, dupes
+
+
+class TestVmemEstimator:
+    """The budget estimator (analysis/vmem.py): the paged_flash golden
+    bound, full-package coverage, and the literal lower-bound rule."""
+
+    def test_paged_flash_row_reproduces_the_docs_bound(self):
+        # docs/quantization.md: the gather scratch holds the whole
+        # allocated span — pages·P·D of pool dtype for K and V each.
+        # At S_alloc = pages·P = 16384, D = 128 that is 4 MiB for int8
+        # pools (plus the two (1, pages·P) f32 scale rows)
+        from hpc_patterns_tpu.analysis import vmem
+
+        mod = ModuleInfo.parse(PACKAGE / "ops" / "paged_attention.py")
+        (est,) = vmem.estimate_module(mod)
+        assert est.kernel == "_paged_attention_kernel"
+        bindings = {"pages": 128, "P": 128, "D": 128}
+        spans = [c for c in est.components
+                 if c.label.startswith("scratch")]
+        assert len(spans) == 4  # K span, V span, 2 scale rows
+        kv_bytes = 0
+        scale_bytes = 0
+        for c in spans:
+            n, assumed = vmem.q_value(c.quantity, bindings)
+            assert not assumed, (c.label, assumed)
+            if c.dtype_bytes == 4:       # the f32 scale rows
+                scale_bytes += n * 4
+            else:                        # pool-dtype spans at int8
+                kv_bytes += n * 1
+        assert kv_bytes == 2 * 16384 * 128          # 4 MiB exactly
+        assert scale_bytes == 2 * 16384 * 4
+        # and at the f32 default the same spans blow the 16 MB scoped
+        # limit — the documented "f32 pools belong on the streaming
+        # route", now a number instead of a sentence
+        total, _ = est.model_bytes(bindings)
+        assert total > est.limit_bytes
+
+    def test_every_package_pallas_call_gets_a_numeric_row(self):
+        # the acceptance criterion: per-kernel byte totals for EVERY
+        # pallas_call under model bindings — no silent gaps
+        from hpc_patterns_tpu.analysis import vmem
+
+        ests = vmem.estimate_paths([PACKAGE])
+        by_file = {Path(e.path).name for e in ests}
+        assert {"fused.py", "pipeline.py", "kernels.py", "device.py",
+                "flash_attention.py", "flash_decode.py",
+                "fused_mlp.py", "paged_attention.py"} <= by_file
+        assert len(ests) >= 12
+        for est in ests:
+            total, _ = est.model_bytes()
+            assert total > 0, (est.kernel, est.path)
+
+    def test_explicit_vmem_limit_is_read(self):
+        from hpc_patterns_tpu.analysis import vmem
+
+        mod = ModuleInfo.parse(PACKAGE / "comm" / "fused.py")
+        ests = {e.line: e for e in vmem.estimate_module(mod)}
+        limits = {e.limit_bytes for e in ests.values()
+                  if not e.limit_default}
+        assert 100 * 1024 * 1024 in limits  # fused.py's _VMEM_LIMIT
+
+    def test_lower_bound_rule_needs_literals(self, tmp_path):
+        # symbolic shapes never fire the rule (the report's job), and
+        # a literal overflow always does
+        f = tmp_path / "m.py"
+        f.write_text(
+            "import jax, jax.numpy as jnp\n"
+            "from jax.experimental import pallas as pl\n"
+            "from jax.experimental.pallas import tpu as pltpu\n"
+            "def k(x_ref, o_ref, acc):\n"
+            "    o_ref[...] = x_ref[...]\n"
+            "def sym(x, n):\n"
+            "    return pl.pallas_call(k, out_shape=x,\n"
+            "        scratch_shapes=[pltpu.VMEM((n, n), jnp.float32)],\n"
+            "    )(x)\n"
+            "def lit(x):\n"
+            "    return pl.pallas_call(k, out_shape=x,\n"
+            "        scratch_shapes=[\n"
+            "            pltpu.VMEM((8192, 8192), jnp.float32)],\n"
+            "    )(x)\n")
+        live, _ = core.analyze_file(f)
+        assert [x.rule for x in live] == ["vmem-budget"]
+        assert "268,435,456" in live[0].message
+
+    def test_unrelated_scope_never_resolves_runtime_dims(self,
+                                                         tmp_path):
+        # scope correctness: another function's local ``n = 8192``
+        # (or a module constant shadowed by a parameter) must not
+        # resolve this kernel's RUNTIME ``n`` into a literal — that
+        # would fire the CI-gating rule on correct code
+        f = tmp_path / "m.py"
+        f.write_text(
+            "import jax, jax.numpy as jnp\n"
+            "from jax.experimental import pallas as pl\n"
+            "from jax.experimental.pallas import tpu as pltpu\n"
+            "n = 8192\n"
+            "def unrelated():\n"
+            "    m = 8192\n"
+            "    return m\n"
+            "def k(x_ref, o_ref, acc):\n"
+            "    o_ref[...] = x_ref[...]\n"
+            "def run_param(x, n):\n"
+            "    return pl.pallas_call(k, out_shape=x,\n"
+            "        scratch_shapes=[pltpu.VMEM((n, n), jnp.float32)],\n"
+            "    )(x)\n"
+            "def run_other(x, m):\n"
+            "    return pl.pallas_call(k, out_shape=x,\n"
+            "        scratch_shapes=[pltpu.VMEM((m, m), jnp.float32)],\n"
+            "    )(x)\n")
+        live, _ = core.analyze_file(f)
+        assert not live
+
+    def test_format_table_names_assumed_symbols(self):
+        from hpc_patterns_tpu.analysis import vmem
+
+        ests = vmem.estimate_paths([PACKAGE / "ops"])
+        table = vmem.format_vmem_table(ests, root=PACKAGE.parent)
+        assert "_paged_attention_kernel" in table
+        assert "ASSUMED" in table  # runtime dtypes are never silent
+        assert "vmem bytes" in table
+
+    def test_vmem_summary_is_json_able(self):
+        from hpc_patterns_tpu.analysis import vmem
+
+        ests = vmem.estimate_paths([PACKAGE / "comm"])
+        summary = vmem.vmem_summary(ests)
+        json.dumps(summary)
+        assert summary["kernels"] == len(ests) >= 3
+        assert all(r["bytes"] > 0 for r in summary["rows"])
+
+
+class TestStrictSemaphores:
+    """The strict-semaphore interpret shim (analysis/runtime.py): the
+    PR 8 balance bug class fails at TRACE time under the shim. The
+    fused parity battery runs under it module-wide
+    (tests/test_fused_comm.py); these pin the shim's own semantics."""
+
+    def _run_kernel(self, kernel, mesh8, extra_scratch=2):
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+        from jax.sharding import PartitionSpec as P
+        from hpc_patterns_tpu.topology import shard_map
+
+        x = jnp.arange(8 * 2 * 8, dtype=jnp.float32).reshape(16, 8)
+
+        def run(v):
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct(v.shape, v.dtype),
+                in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+                scratch_shapes=[pltpu.VMEM(v.shape, v.dtype)]
+                + [pltpu.SemaphoreType.DMA] * extra_scratch,
+                interpret=True,
+            )(v)
+
+        f = jax.jit(shard_map(run, mesh=mesh8, in_specs=P("x"),
+                              out_specs=P("x")))
+        return jax.block_until_ready(f(x))
+
+    def test_balanced_kernel_passes_and_is_counted(self, mesh8):
+        from jax import lax
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(x_ref, o_ref, buf, send_sem, recv_sem):
+            me = lax.axis_index("x")
+            d = pltpu.make_async_remote_copy(
+                src_ref=x_ref, dst_ref=o_ref, send_sem=send_sem,
+                recv_sem=recv_sem, device_id=lax.rem(me + 1, 8),
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            d.start()
+            d.wait()
+
+        with runtime.strict_semaphores() as ledger:
+            self._run_kernel(kernel, mesh8)
+        assert ledger.kernels_checked == 1
+
+    def test_undrained_send_fails_at_trace_time(self, mesh8):
+        from jax import lax
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(x_ref, o_ref, buf, send_sem, recv_sem):
+            me = lax.axis_index("x")
+            d = pltpu.make_async_remote_copy(
+                src_ref=x_ref, dst_ref=buf, send_sem=send_sem,
+                recv_sem=recv_sem, device_id=lax.rem(me + 1, 8),
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            d.start()
+            d.wait_recv()          # BUG: the send is never waited
+            o_ref[...] = buf[...]
+
+        with runtime.strict_semaphores():
+            with pytest.raises(runtime.SemaphoreBalanceError,
+                               match="send wait"):
+                self._run_kernel(kernel, mesh8)
+
+    def test_drain_double_wait_fails_at_trace_time(self, mesh8):
+        # the PR 8 drain bug's exact shape: one descriptor's send
+        # semaphore waited twice
+        from jax import lax
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(x_ref, o_ref, buf, send_sem, recv_sem):
+            me = lax.axis_index("x")
+            d = pltpu.make_async_remote_copy(
+                src_ref=x_ref, dst_ref=o_ref, send_sem=send_sem,
+                recv_sem=recv_sem, device_id=lax.rem(me + 1, 8),
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            d.start()
+            d.wait()
+            d.wait_send()          # BUG: one signal per DMA
+
+        with runtime.strict_semaphores():
+            with pytest.raises(runtime.SemaphoreBalanceError,
+                               match="waited 2 times"):
+                self._run_kernel(kernel, mesh8)
+
+    def test_local_copy_balance_is_checked_too(self, mesh8):
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(x_ref, o_ref, buf, sem, _unused):
+            d = pltpu.make_async_copy(x_ref, buf, sem)
+            d.start()              # BUG: never waited
+            o_ref[...] = x_ref[...]
+
+        with runtime.strict_semaphores():
+            with pytest.raises(runtime.SemaphoreBalanceError,
+                               match="local start"):
+                self._run_kernel(kernel, mesh8)
+
+    def test_shim_uninstalls_cleanly(self):
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        before = (pltpu.make_async_copy, pltpu.make_async_remote_copy,
+                  pl.pallas_call)
+        with runtime.strict_semaphores():
+            assert pl.pallas_call is not before[2]
+        assert (pltpu.make_async_copy, pltpu.make_async_remote_copy,
+                pl.pallas_call) == before

@@ -20,10 +20,42 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
+from hpc_patterns_tpu.analysis import runtime as analysis_runtime
 from hpc_patterns_tpu.comm import Communicator, fused, ring
 from hpc_patterns_tpu.topology import shard_map
 
 WORLD = 8
+
+
+@pytest.fixture(scope="module", autouse=True)
+def strict_sems():
+    """The strict-semaphore interpret shim over the WHOLE battery:
+    every fused kernel traced by these tests has its DMA semaphore
+    ledger balance-asserted at kernel exit (analysis/runtime.py) — so
+    the bug class PR 8 caught by eyeball (double-waited send sems,
+    undrained DMAs) fails here, in one test, not on silicon. No cache
+    clear: every test builds FRESH jit wrappers, which always
+    re-trace, so the kernel bodies run through the patched
+    ``pallas_call`` regardless (a mid-suite ``jax.clear_caches()``
+    would cost the rest of tier-1 its warm traces). Engagement is
+    asserted by ``test_strict_shim_is_engaged``, a selected test —
+    not at teardown, where a ``-k``-filtered run that traces no
+    kernel would fail spuriously."""
+    with analysis_runtime.strict_semaphores() as ledger:
+        yield ledger
+
+
+def test_strict_shim_is_engaged(strict_sems):
+    """Proof the shim is live over this module: tracing one fused
+    kernel must increment the ledger's checked-kernel count — an
+    inert shim would silently void the whole battery's sync-protocol
+    guarantee."""
+    before = strict_sems.kernels_checked
+    mesh = submesh(4)
+    x = jnp.arange(4 * 2 * 8, dtype=jnp.float32).reshape(8, 8)
+    out = shmap(lambda l: fused.fused_allreduce(l, "x"), mesh)(x)
+    jax.block_until_ready(out)
+    assert strict_sems.kernels_checked > before
 
 
 @pytest.fixture(scope="module")
