@@ -244,6 +244,40 @@ def _shared_prefix_detail() -> dict:
     }
 
 
+def _elastic_detail() -> dict:
+    """Elastic-plane headline keys (round 14), captured in the same
+    measurement child as the overlap headline:
+
+    - ``elastic_slo_attainment``: per-class SLO attainment of the
+      autoscaled plane on a diurnal ramp under replica-death chaos —
+      asserted STRICTLY above the fixed plane's on the same replayed
+      schedule before the number exists (the fixed plane sheds);
+    - ``goodput_per_replica_round``: SLO-attained tokens per live
+      replica-round — the efficiency headline that rewards holding
+      the SLO with fewer replica-rounds, not just holding it.
+
+    Runs ``bench_serving.run_elastic``'s smoke shape (every served
+    stream byte-exact greedy AND sampled, warm spin-up beat cold init,
+    the death fault verified fired — all asserted inside). Returns {}
+    on failure — the gate's coverage-loss warning is the tripwire."""
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    import bench_serving
+
+    r = bench_serving.run_elastic(
+        **bench_serving.elastic_smoke_config(), quiet=True)
+    return {
+        "elastic_slo_attainment": round(r["elastic_slo_attainment"], 4),
+        "goodput_per_replica_round": round(
+            r["goodput_per_replica_round"], 2),
+        "elastic_spinups": r["spinups"],
+        "warm_spinup_ms": round(r["warm_spinup_s"] * 1e3, 2),
+        "cold_init_ms": round(r["cold_init_s"] * 1e3, 2),
+    }
+
+
 def _quantized_detail() -> dict:
     """Quantized-decode headline keys (round 13), captured in the same
     measurement child as the overlap headline:
@@ -622,6 +656,16 @@ def main() -> int:
         quant_detail = {"quantized_error":
                         f"{type(err).__name__}: {err}"}
 
+    # the elastic-plane row (round 14): autoscaled-vs-static SLO
+    # attainment under replica-death chaos + goodput per replica-round
+    # (bench_serving.run_elastic smoke — byte-exact greedy AND
+    # sampled, warm spin-up beat cold init, all asserted inside)
+    try:
+        elastic_detail = _elastic_detail()
+    except Exception as err:  # noqa: BLE001 — never sink the headline
+        elastic_detail = {"elastic_error":
+                          f"{type(err).__name__}: {err}"}
+
     # any clamped-to-zero component means the run measured nothing usable
     degenerate = min(t_overlap, t_serial, t_dma, t_comp) <= 0
     if degenerate:
@@ -657,6 +701,7 @@ def main() -> int:
                     **offload_detail,
                     **shared_detail,
                     **quant_detail,
+                    **elastic_detail,
                     # the five raw (serial, overlap) pairs, measurement
                     # order — the distribution behind the median
                     "pairs_us": [

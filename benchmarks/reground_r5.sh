@@ -139,6 +139,24 @@ run "serving quantized kv+weights int8" python benchmarks/bench_serving.py --qua
 run "serving tiered offload @ int8 kv" python benchmarks/bench_serving.py --offload --kv-dtype=int8
 run "serving plane @ int8 kv" python benchmarks/bench_serving.py --plane --kv-dtype=int8
 
+# 4g. ELASTIC-PLANE row (round 14): a diurnal open-loop ramp under
+#     seeded replica-death chaos through a FIXED 2-replica plane (the
+#     death ends in shedding) and the autoscaled ElasticServingPlane
+#     (serving_plane/autoscaler.py — SLO-feedback scale-up on WARM
+#     residency-pulled params, checkpoint resume after the death,
+#     drain-by-migration on the way down). On chip this is the first
+#     real number for warm spin-up: the plane.spinup window's
+#     host->HBM param paging at real DMA rates vs a real on-device
+#     init_params (the CPU smoke's host tier is a same-memory copy),
+#     and for goodput_per_replica_round at chip throughput. The
+#     verdict is asserted in-run before any number prints: elastic
+#     attainment strictly above static, every served stream
+#     byte-exact greedy AND sampled (death-resumed rows included),
+#     warm < cold. Headline keys elastic_slo_attainment /
+#     goodput_per_replica_round are captured by bench.py and gated by
+#     harness/regress.py.
+run "serving elastic ramp under replica death" python benchmarks/bench_serving.py --elastic
+
 # 5. aligned speculative pair + gamma sweep + batched impls (item 4, 7)
 run "make draft pair" python benchmarks/make_draft_pair.py --out=benchmarks/pair_r5
 run "speculative aligned sweep" python benchmarks/bench_speculative.py --pair=benchmarks/pair_r5 --batched=8

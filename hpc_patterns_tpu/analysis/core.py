@@ -133,6 +133,26 @@ DEFAULT_DISPATCH_CRITICAL = frozenset({
     "_dequant",
     "_scale_write",
     "matmul_weight",
+    # the round-14 elastic-plane paths: the scaling decision, the warm
+    # spin-up, the drain's export loop, and death recovery all run at
+    # the plane's round boundary with survivor chunks about to
+    # dispatch — a stray host sync there stalls every replica's next
+    # round behind one controller tick. The DELIBERATE syncs (the
+    # spin-up's completion measurement, the checkpoint's round-
+    # boundary key snapshot, the resume's host-list packing) carry
+    # justified suppressions in serving_plane/autoscaler.py and
+    # serving_plane/service.py.
+    "_autoscale_round",
+    "_spin_up",
+    "_begin_drain",
+    "_drain_step",
+    "_kill_replica",
+    "_recover_casualties",
+    "_resume_request",
+    "_route_again",
+    "_checkpoint_replica",
+    "_probe_replica_chaos",
+    "_shed_request",
 })
 
 # rule names are kebab-case identifiers; anything after the last name

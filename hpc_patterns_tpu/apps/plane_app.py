@@ -80,6 +80,14 @@ def build_parser():
                    help="per-replica arena (0 = slots * pages/seq)")
     p.add_argument("--chunk", type=int, default=4)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="sampled serving (> 0): real replicas sample "
+                        "per-row key streams; stub replicas run the "
+                        "keyed hash chain — either way the round "
+                        "replies carry per-row key state, so a "
+                        "replica death resumes SAMPLED streams "
+                        "byte-exact on survivors (the router's "
+                        "resume checkpoint, docs/serving_plane.md)")
     p.add_argument("--plane-timeout", type=float, default=120.0,
                    help="router drain deadline / replica idle timeout")
     return p
@@ -153,12 +161,19 @@ def _run_router(args, nprocs: int) -> int:
     if args.stub:
         # the stub oracle: every served stream must equal the pure
         # token function of its ORIGINAL prompt — resumed-on-survivor
-        # rows included (that is the point of the drill)
+        # rows included (that is the point of the drill). Sampled
+        # mode walks the key CHAIN from key_0: a resume is only
+        # byte-equal to it when the router's checkpoint carried the
+        # chain state across the death
         for rid, toks in sorted(router.finished.items()):
             if router.stats[rid].get("outcome") != "ok":
                 continue
-            want = [service.stub_token(prompts[rid], k)
-                    for k in range(len(toks))]
+            if args.temperature > 0:
+                want = service.stub_sampled_stream(prompts[rid],
+                                                   len(toks))
+            else:
+                want = [service.stub_token(prompts[rid], k)
+                        for k in range(len(toks))]
             if list(toks) != want:
                 print(f"ORACLE FAIL: rid {rid} tokens diverge "
                       f"(got {list(toks)[:6]}.., want {want[:6]}..)",
@@ -200,7 +215,8 @@ def _run_replica(args, rank: int, role: str) -> int:
         adapter = service.StubAdapter(
             slots=args.slots, pool_pages=pool,
             pages_per_seq=pages_per_seq, page_size=args.page_size,
-            chunk=args.chunk, role=role)
+            chunk=args.chunk, role=role,
+            sampled=args.temperature > 0)
     else:
         import jax
 
@@ -224,7 +240,9 @@ def _run_replica(args, rank: int, role: str) -> int:
             params, cfg, slots=args.slots, pool_pages=pool,
             pages_per_seq=pages_per_seq, page_size=args.page_size,
             chunk=args.chunk,
-            prompt_buckets=bucket_ladder(args.prompt_len))
+            prompt_buckets=bucket_ladder(args.prompt_len),
+            temperature=args.temperature,
+            top_k=8 if args.temperature > 0 else 0, seed=0)
         adapter = service.RealAdapter(engine, role=role)
     return service.serve_replica(
         adapter, rank=rank, rdv_dir=args.rdv,

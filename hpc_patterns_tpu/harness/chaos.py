@@ -277,6 +277,40 @@ def injections() -> tuple[dict, ...]:
     return tuple(_log)
 
 
+def matching(site: str, index: int,
+             rank: int | None = None) -> tuple[Fault, ...]:
+    """The faults that WOULD fire at (site, index, rank) — without
+    executing them. For callers that implement kind-specific semantics
+    themselves: the in-process serving plane runs every replica in ONE
+    process, so a ``die:replica=N`` fault must mark replica N dead
+    (router-visible, recoverable) instead of SIGKILLing the whole
+    plane the way :func:`maybe_inject` would. ``rank`` overrides the
+    process rank for the match — the plane passes the REPLICA ordinal,
+    which is what ``replica=`` addresses there (in the launched plane
+    each replica is its own process, so the two spellings coincide).
+    The caller records what it executed via :func:`record_injection`
+    so the fault-actually-fired asserts keep working."""
+    faults = active()
+    if not faults:
+        return ()
+    if site in getattr(_claimed, "sites", ()):
+        return ()
+    r = _process_rank() if rank is None else int(rank)
+    return tuple(f for f in faults if f.matches(site, index, r))
+
+
+def record_injection(site: str, index: int, kind: str, *,
+                     rank: int | None = None,
+                     delay_s: float = 0.0) -> None:
+    """Log one caller-executed injection (the :func:`matching`
+    counterpart of the log append :func:`maybe_inject` does itself)."""
+    if len(_log) < _LOG_CAP:
+        _log.append({
+            "site": site, "index": index, "kind": kind,
+            "rank": _process_rank() if rank is None else int(rank),
+            "delay_s": delay_s})
+
+
 def maybe_inject(site: str, index: int) -> None:
     """Fire every active fault matching (site, index, this rank).
 

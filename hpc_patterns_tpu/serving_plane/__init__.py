@@ -19,7 +19,15 @@ serving *system* above it — the scale jump from one engine to N:
 - ``service.py``  — the cross-process plane (import-light, jax-free):
   a socket replica server + router client driven by
   ``apps/launch.py`` (one replica per launched process — the DCN
-  analog), with replica-death detection and resume-on-survivor.
+  analog), with replica-death detection and resume-on-survivor
+  (observed tokens AND, in sampled mode, the per-row key state the
+  round replies checkpoint).
+- ``autoscaler.py`` — the ELASTIC plane (round 14): a pure
+  SLO-feedback controller (hysteresis, cooldown, min/max clamps)
+  driving warm replica spin-up (params paged from the residency
+  manager's host tier, measured as ``plane.spinup`` windows),
+  drain-by-migration scale-down, and checkpoint-resume death
+  recovery over the router (docs/serving_plane.md "Elastic plane").
 
 Import discipline: this ``__init__`` stays lazy so launcher children
 can ``import hpc_patterns_tpu.serving_plane.service`` without paying
@@ -33,6 +41,10 @@ _LAZY = {
     "ServingPlane": "hpc_patterns_tpu.serving_plane.router",
     "PLACEMENT_POLICIES": "hpc_patterns_tpu.serving_plane.router",
     "migrate_pages": "hpc_patterns_tpu.serving_plane.migration",
+    "Autoscaler": "hpc_patterns_tpu.serving_plane.autoscaler",
+    "AutoscalerPolicy": "hpc_patterns_tpu.serving_plane.autoscaler",
+    "ElasticServingPlane": "hpc_patterns_tpu.serving_plane.autoscaler",
+    "WarmParamPool": "hpc_patterns_tpu.serving_plane.autoscaler",
 }
 
 

@@ -63,6 +63,7 @@ from contextlib import nullcontext
 import numpy as np
 
 from hpc_patterns_tpu.analysis import runtime as analysis_runtime
+from hpc_patterns_tpu.harness import chaos as chaoslib
 from hpc_patterns_tpu.harness import metrics as metricslib
 from hpc_patterns_tpu.harness import slo as slolib
 from hpc_patterns_tpu.harness import trace as tracelib
@@ -98,6 +99,16 @@ class Replica:
         self.alive = True
         #: bundles transferred toward this replica, awaiting install
         self.pending_migrations: list = []
+        #: plane-assigned ordinal (set at plane construction / scale-up)
+        #: — the identity ``die:replica=N`` chaos addresses in-process,
+        #: mirroring the launched plane where replica N is rank N
+        self.index = -1
+        #: replica-local round counter: the chaos ``replica_round``
+        #: site's index, and the autoscaler's per-replica clock
+        self.rounds = 0
+        #: a draining replica serves what it holds but receives no new
+        #: routing and no migrations — the voluntary scale-down state
+        self.draining = False
 
     def device_ctx(self):
         if self.device is None:
@@ -118,7 +129,7 @@ class Replica:
 def _eligible(plane: "ServingPlane", prompt_len: int,
               max_new: int) -> list[Replica]:
     return [r for r in plane.replicas
-            if r.alive and r.can_prefill
+            if r.alive and not r.draining and r.can_prefill
             and r.engine.would_fit(prompt_len, max_new)]
 
 
@@ -189,6 +200,8 @@ class ServingPlane:
         for r in self.replicas:
             if r.can_decode:
                 r.engine.track_chunk_windows = True
+        for i, r in enumerate(self.replicas):
+            r.index = i
         self.slo = slo
         self._emit = emit or (lambda **kw: None)
         self.stats: dict[int, dict] = {}
@@ -205,6 +218,22 @@ class ServingPlane:
         self._serve_s = 0.0
         self.last_slo: dict | None = None
         self.last_kv_migration_overlap_frac: float | None = None
+        #: original submit kwargs per request — what replica-death
+        #: recovery needs (the elastic plane rebuilds a queued request
+        #: or a resume from them; the static plane's shed path only
+        #: reads them for accounting)
+        self._requests: dict[int, dict] = {}
+        #: replicas lost to chaos (by name, death order)
+        self.deaths: list[str] = []
+        #: requests shed BECAUSE their replica died (the static
+        #: plane's degraded mode — the number the elastic comparison
+        #: exists to drive to zero)
+        self.shed_on_death = 0
+        #: Σ over plane rounds of live (serving) replica count — the
+        #: denominator of ``goodput_per_replica_round``: the gated
+        #: efficiency metric that rewards holding the SLO with FEWER
+        #: replica-rounds, not just holding it
+        self.replica_rounds = 0
 
     # -- construction checks ----------------------------------------------
 
@@ -283,6 +312,11 @@ class ServingPlane:
             prompt, max_new, seq_id=rid, priority=priority,
             deadline_s=deadline_s, temperature=temperature, key=key,
             resume_prefix=resume_prefix)
+        self._requests[rid] = {
+            "prompt": prompt, "max_new": int(max_new),
+            "priority": int(priority), "deadline_s": deadline_s,
+            "temperature": temperature, "key": key,
+        }
         now = time.perf_counter()
         self.stats[rid] = {
             "priority": int(priority), "t_submit": now, "t_first": None,
@@ -312,7 +346,8 @@ class ServingPlane:
         exports must not race one free slot). Least-loaded first."""
         cand = []
         for r in self.replicas:
-            if not (r.alive and r.can_decode) or r is src:
+            if not (r.alive and r.can_decode) or r is src \
+                    or r.draining:
                 continue
             e = r.engine
             free_slots = (sum(1 for s in e._slots if not s.active)
@@ -474,6 +509,10 @@ class ServingPlane:
             ps["outcome"] = es.get("outcome") or "ok"
             ps["preemptions"] = int(es.get("preemptions") or 0)
             ps["replica"] = r.name
+            # the recovery record resolves with the request (death
+            # recovery only ever reads UNRESOLVED rows): a long-lived
+            # plane must not grow one prompt array per served request
+            self._requests.pop(sid, None)
             n += 1
         return n
 
@@ -486,6 +525,93 @@ class ServingPlane:
                 r.engine.queue_depth)
             m.gauge(f"plane.{r.name}.free_pages").set(
                 r.engine.free_page_count)
+
+    # -- replica-level chaos + death recovery ------------------------------
+
+    def _probe_replica_chaos(self, r: Replica) -> bool:
+        """The ``replica_round`` chaos site for the IN-PROCESS plane,
+        probed once per replica per plane round against the replica's
+        ORDINAL (``die:replica=N`` addresses the same identity the
+        launched plane's rank-N process has). Executed here rather
+        than through ``maybe_inject`` because every in-process replica
+        shares one OS process — a literal SIGKILL would take the whole
+        plane down instead of one replica. Stalls sleep their
+        (deterministic) delay; ``die`` marks the replica dead through
+        :meth:`_kill_replica`. Returns True when the replica died."""
+        for f in chaoslib.matching("replica_round", r.rounds, r.index):
+            if f.kind == "die":
+                chaoslib.record_injection("replica_round", r.rounds,
+                                          "die", rank=r.index)
+                self._kill_replica(r)
+                return True
+            delay = f.delay_at("replica_round", r.rounds)
+            chaoslib.record_injection("replica_round", r.rounds,
+                                      f.kind, rank=r.index,
+                                      delay_s=delay)
+            if delay > 0.0:
+                time.sleep(delay)
+        return False
+
+    def _kill_replica(self, r: Replica) -> None:
+        """An involuntary replica loss: its engine's device state is
+        gone (in-process, the plane simply never touches it again).
+        Everything the replica held — active rows, queued requests,
+        bundles parked toward it — goes to
+        :meth:`_recover_casualties`: the base (fixed-replica) plane
+        SHEDS them, counted in the SLO table and ``shed_on_death``,
+        never silently — which is exactly the degraded mode the
+        elastic plane's checkpoint-resume recovery exists to beat."""
+        if not r.alive:
+            return
+        r.alive = False
+        self.deaths.append(r.name)
+        active = [s.seq_id for s in r.engine._slots if s.active]
+        queued = [req.seq_id for req in r.engine._queue]
+        bundles = list(r.pending_migrations)
+        r.pending_migrations.clear()
+        for b in bundles:
+            # the handoff died with its destination: its window can
+            # never complete (don't let it rot in the overlap floor)
+            self._mig_open.pop(b.seq, None)
+        self._emit(kind="plane_replica_death", replica=r.name,
+                   active=len(active), queued=len(queued),
+                   bundles=len(bundles))
+        m = metricslib.get_metrics()
+        if m.enabled:
+            m.counter("plane.replica_deaths").inc()
+        self._recover_casualties(r, active, queued, bundles)
+
+    def _recover_casualties(self, r: Replica, active_sids, queued_sids,
+                            bundles) -> None:
+        """Fixed-replica recovery: SHED every casualty (the static
+        plane cannot adapt — a death today ends in shedding). The
+        elastic plane overrides this with checkpoint resume +
+        re-routing (serving_plane/autoscaler.py)."""
+        for sid in [*active_sids, *queued_sids,
+                    *(b.seq_id for b in bundles)]:
+            self._shed_request(sid, on_death=True)
+
+    def _shed_request(self, sid: int, *, on_death: bool = False) -> None:
+        ps = self.stats.get(sid)
+        if ps is None or ps.get("outcome") is not None:
+            return
+        ps["outcome"] = "shed"
+        ps["t_finish"] = time.perf_counter()
+        self.finished[sid] = np.zeros((0,), np.int32)
+        self._requests.pop(sid, None)  # resolved: recovery never
+        if on_death:                   # reads it again
+            self.shed_on_death += 1
+        self._emit(kind="plane_shed", seq_id=sid, on_death=on_death)
+        m = metricslib.get_metrics()
+        if m.enabled:
+            m.counter("plane.shed").inc()
+
+    def _autoscale_round(self) -> bool:
+        """Post-round scaling hook — the base plane is FIXED (the
+        ROADMAP's nobody-closes-the-loop baseline); the elastic plane
+        overrides this with the SLO-feedback controller. Returns True
+        when the plane changed shape (counts as progress)."""
+        return False
 
     # -- the plane loop ----------------------------------------------------
 
@@ -518,7 +644,25 @@ class ServingPlane:
                 while pending_arrivals \
                         and pending_arrivals[0][0] <= now_rel:
                     t_arr, kw = pending_arrivals.popleft()
-                    rid = self.submit(**kw)
+                    try:
+                        rid = self.submit(**kw)
+                    except ValueError:
+                        if not self.deaths:
+                            raise  # a config error, not degradation
+                        # an arrival no surviving replica can place:
+                        # the degraded plane sheds it, counted — the
+                        # run must keep serving what it can
+                        rid = self._next_rid
+                        self._next_rid += 1
+                        self.stats[rid] = {
+                            "priority": int(kw.get("priority", 0)),
+                            "t_submit": t_run0 + t_arr,
+                            "t_first": None, "t_finish": None,
+                            "tokens": 0, "outcome": None,
+                            "preemptions": 0, "replica": None,
+                        }
+                        self._shed_request(rid, on_death=True)
+                        continue
                     t_abs = t_run0 + t_arr
                     # the schedule's instant, end to end: the plane
                     # row, the replica's queue entry, and the replica's
@@ -543,6 +687,10 @@ class ServingPlane:
             for r in self._round_order():
                 if not r.alive:
                     continue
+                if chaoslib.active() is not None \
+                        and self._probe_replica_chaos(r):
+                    progressed = True  # the death recovery moved work
+                    continue
                 with r.device_ctx():
                     if r.role == "prefill":
                         st = r.engine.service_round(decode=False)
@@ -561,8 +709,11 @@ class ServingPlane:
                                        or bool(installed))
                         if installed:
                             self._complete_migrations(r, installed)
+                r.rounds += 1
+                self.replica_rounds += 1
                 progressed |= self._collect_finished(r) > 0
             self._update_gauges()
+            progressed |= self._autoscale_round()
             if not progressed and not pending_arrivals:
                 queued = {r.name: r.engine.queue_depth
                           for r in self.replicas if r.alive}
@@ -591,4 +742,21 @@ class ServingPlane:
                 m.gauge("plane.tok_s").set(tot["tok_s"])
                 m.gauge("plane.goodput_tok_s").set(
                     tot["goodput_tok_s"])
+                if self.replica_rounds:
+                    m.gauge("plane.goodput_per_replica_round").set(
+                        self.goodput_per_replica_round or 0.0)
         return self.finished
+
+    @property
+    def goodput_per_replica_round(self) -> float | None:
+        """SLO-attained tokens per (live replica × plane round) — the
+        EFFICIENCY headline of the elastic trajectory: a plane that
+        holds attainment by over-provisioning pays for it here, one
+        that sheds pays in the numerator. Gated via
+        ``detail.goodput_per_replica_round`` (harness/regress.py).
+        None until a run with ``slo=`` completed."""
+        if self.last_slo is None or not self.replica_rounds:
+            return None
+        tot = self.last_slo["total"]
+        good_tokens = tot["goodput_tok_s"] * self.last_slo["wall_s"]
+        return good_tokens / self.replica_rounds

@@ -193,6 +193,46 @@ def stub_token(orig_prompt, k: int) -> int:
     return int.from_bytes(h[:4], "little") % 251
 
 
+# -- the stub's SAMPLED mode (the key-stream checkpoint drill) -------------
+#
+# Real sampled engines carry per-row PRNG key STATE that evolves with
+# every emitted token; a resume is only byte-exact when it seeds from
+# the state where the stream stopped (models/serving._preempt's
+# contract). The stub mirrors that shape jax-free with a hash CHAIN:
+# key_0 = H(prompt), key_{k+1} = H(key_k), token_k = f(key_k) — so a
+# death-resume that does NOT carry the checkpointed key restarts the
+# chain at key_0 and diverges at the first resumed position, which is
+# exactly the teeth the tier-1 launch test needs. The router treats
+# the key as OPAQUE (hex here, a uint32 pair for real engines): it
+# checkpoints whatever the round reply reports and hands it back
+# verbatim on resume.
+
+
+def stub_key0(orig_prompt) -> bytes:
+    key = (",".join(str(int(t)) for t in orig_prompt)).encode()
+    return hashlib.sha256(key + b"|k0").digest()[:8]
+
+
+def stub_next_key(key: bytes) -> bytes:
+    return hashlib.sha256(key).digest()[:8]
+
+
+def stub_token_keyed(key: bytes) -> int:
+    return int.from_bytes(hashlib.sha256(key).digest()[4:8],
+                          "little") % 251
+
+
+def stub_sampled_stream(orig_prompt, n: int) -> list[int]:
+    """The full n-token sampled stub stream — the oracle's spelling
+    (walk the chain from key_0; a correct resume lands on the same
+    tokens because it continued the chain from the carried state)."""
+    key, out = stub_key0(orig_prompt), []
+    for _ in range(n):
+        out.append(stub_token_keyed(key))
+        key = stub_next_key(key)
+    return out
+
+
 class StubAdapter(EngineAdapter):
     """A deterministic jax-free engine behind the replica protocol:
     page-pool accounting, slot admission, ``chunk`` tokens per round
@@ -203,13 +243,21 @@ class StubAdapter(EngineAdapter):
 
     def __init__(self, *, slots: int = 2, pool_pages: int = 16,
                  pages_per_seq: int = 8, page_size: int = 16,
-                 chunk: int = 4, role: str = "both"):
+                 chunk: int = 4, role: str = "both",
+                 sampled: bool = False):
         self.slots = slots
         self.pool_pages = pool_pages
         self.pages_per_seq = pages_per_seq
         self.page_size = page_size
         self.chunk = chunk
         self.role = role
+        #: sampled mode: tokens come from an evolving per-row key
+        #: CHAIN (stub_key0/stub_next_key) instead of the position-
+        #: indexed pure function — the jax-free mirror of a real
+        #: engine's PRNG key state, so the router's key checkpoint is
+        #: exercised with teeth (a resume that drops the key restarts
+        #: the chain and fails the oracle)
+        self.sampled = bool(sampled)
         self.free_pages = pool_pages
         self._queue: deque = deque()
         self._rows: list[dict] = []
@@ -243,10 +291,29 @@ class StubAdapter(EngineAdapter):
             "prefix": prefix, "out": list(prefix),
             "budget": int(req["max_new"]), "need": need,
             "priority": int(req.get("priority") or 0),
+            # sampled resumes seed the chain from the ROUTER's
+            # checkpointed key; a fresh row starts its own at admit
+            "key": (bytes.fromhex(req["key"])
+                    if self.sampled and req.get("key") else None),
         })
 
     def queue_install(self, wire: dict, t_disp: float) -> None:
         self._installs.append((wire, t_disp))
+
+    def _emit_token(self, row: dict) -> int:
+        """One emitted token. Greedy mode: the position-indexed pure
+        function of the original prompt. Sampled mode: consume the
+        row's key CHAIN — a fresh row opens it at ``stub_key0``, a
+        resume continues from the carried checkpoint state (and a
+        resume that LOST the key restarts at key_0, diverging at its
+        first token — the oracle's teeth)."""
+        if not self.sampled:
+            return stub_token(row["orig"], len(row["out"]))
+        if row.get("key") is None:
+            row["key"] = stub_key0(row["orig"])
+        tok = stub_token_keyed(row["key"])
+        row["key"] = stub_next_key(row["key"])
+        return tok
 
     def _admit(self) -> None:
         q = sorted(self._queue, key=lambda r: r["priority"])
@@ -261,7 +328,7 @@ class StubAdapter(EngineAdapter):
             # token k is indexed from the ORIGINAL prompt's end, so a
             # resume (out pre-seeded with its prefix) continues the
             # exact stream
-            req["out"].append(stub_token(req["orig"], len(req["out"])))
+            req["out"].append(self._emit_token(req))
             self._rows.append(req)
 
     def _install_pending(self, rec) -> None:
@@ -280,6 +347,9 @@ class StubAdapter(EngineAdapter):
                 "out": list(wire["out"]),
                 "budget": int(wire["budget"]), "need": need,
                 "priority": int(wire.get("priority") or 0),
+                # the migrated key state continues the donor's chain
+                "key": (bytes.fromhex(wire["key"])
+                        if self.sampled and wire.get("key") else None),
             })
             if rec is not None and t_disp:
                 rec.mark_complete(
@@ -310,6 +380,9 @@ class StubAdapter(EngineAdapter):
                     "page_size": self.page_size,
                     "payload_dtype": "uint8",
                     "priority": row["priority"],
+                    "key": (row["key"].hex()
+                            if self.sampled and row.get("key")
+                            else None),
                     # the DONOR assigns seq (its export counter) and
                     # fingerprints it; the router carries it verbatim
                     "seq": self._mig_seq,
@@ -321,10 +394,8 @@ class StubAdapter(EngineAdapter):
             for row in list(self._rows):
                 emitted = len(row["out"]) - len(row["prefix"])
                 take = min(self.chunk, row["budget"] - emitted)
-                base = len(row["out"])
-                row["out"].extend(
-                    stub_token(row["orig"], base + j)
-                    for j in range(take))
+                row["out"].extend(self._emit_token(row)
+                                  for _ in range(take))
         for row in list(self._rows):
             if len(row["out"]) - len(row["prefix"]) >= row["budget"]:
                 self._rows.remove(row)
@@ -343,6 +414,14 @@ class StubAdapter(EngineAdapter):
             "queue_depth": len(self._queue),
             "active": len(self._rows),
         }
+        if self.sampled:
+            # the router's RESUME CHECKPOINT, key half: each active
+            # row's chain state next to the tokens the progress field
+            # already carries — what makes a death-resume byte-exact
+            # in sampled mode (opaque to the router; handed back
+            # verbatim on resume)
+            reply["keys"] = {str(r["rid"]): r["key"].hex()
+                             for r in self._rows if r.get("key")}
         return reply
 
 
@@ -371,13 +450,23 @@ class RealAdapter(EngineAdapter):
     def submit(self, req: dict) -> None:
         import numpy as np
 
+        kw = {}
+        if req.get("key") is not None and not self.engine.greedy:
+            # the router's checkpointed key state (a uint32 pair from
+            # a prior round reply): the resumed row's sampling stream
+            # continues exactly where the dead replica's stopped —
+            # the _preempt/_admit_row split/pick contract
+            import jax.numpy as jnp
+
+            kw["key"] = jnp.asarray(np.asarray(req["key"], np.uint32))
         self.engine.submit(
             np.asarray(req["prompt"], np.int32), int(req["max_new"]),
             seq_id=int(req["rid"]),
             priority=int(req.get("priority") or 0),
             deadline_s=req.get("deadline_s"),
             resume_prefix=(np.asarray(req["resume_prefix"], np.int32)
-                           if req.get("resume_prefix") else None))
+                           if req.get("resume_prefix") else None),
+            **kw)
 
     def queue_install(self, wire: dict, t_disp: float) -> None:
         self._installs.append((wire, t_disp))
@@ -409,6 +498,7 @@ class RealAdapter(EngineAdapter):
         chaoslib.maybe_inject("replica_round", self._round)
         self._round += 1
         e = self.engine
+        keys: dict[str, list[int]] = {}
         if self.role == "prefill":
             e.service_round(decode=False)
             exports = []
@@ -416,6 +506,15 @@ class RealAdapter(EngineAdapter):
                 b = e.export_migration(slot)
                 b.seq = self._mig_seq
                 self._mig_seq += 1
+                if not e.greedy:
+                    # the exported key state also seeds the router's
+                    # checkpoint: a receiver dying between delivery
+                    # and its first round reply must not cost the
+                    # sampled stream its continuation point
+                    import numpy as np
+
+                    keys[str(b.seq_id)] = [
+                        int(v) for v in np.asarray(b.key, np.uint32)]
                 wire = bundle_to_wire(b)
                 wire["payload_dtype"] = str(
                     b.pages_payload["k"][0].dtype)
@@ -436,7 +535,23 @@ class RealAdapter(EngineAdapter):
                                   or "ok")
         progress = {str(s.seq_id): [int(t) for t in s.out]
                     for s in e._slots if s.active}
-        return {
+        if not e.greedy and e.active_count:
+            # the key half of the router's resume checkpoint: the
+            # post-round per-row PRNG state, consistent with the
+            # progress tokens the same reply carries (the chunk was
+            # collected before this round returned)
+            import numpy as np
+
+            import jax
+
+            # jaxlint: disable=host-sync-in-dispatch — round-boundary
+            # snapshot (the chunk readback already synced); np.array
+            # COPIES the view a later donated _chunk_step would mutate
+            arr = np.array(jax.device_get(e.keys))
+            for i, s in enumerate(e._slots):
+                if s.active:
+                    keys[str(s.seq_id)] = [int(v) for v in arr[i]]
+        reply = {
             "ok": 1, "round": self._round, "finished": fin,
             "outcomes": outcomes, "progress": progress,
             "exports": exports,
@@ -444,6 +559,9 @@ class RealAdapter(EngineAdapter):
             "queue_depth": e.queue_depth,
             "active": e.active_count,
         }
+        if keys:
+            reply["keys"] = keys
+        return reply
 
 
 # ---------------------------------------------------------------------------
@@ -609,6 +727,13 @@ class PlaneRouter:
         self.finished: dict[int, list[int]] = {}
         self.requests: dict[int, dict] = {}
         self.progress: dict[int, list[int]] = {}
+        #: the key half of the resume checkpoint (PR 9 remainder):
+        #: per-row sampling key state from the replicas' round
+        #: replies, OPAQUE to the router (a uint32 pair for real
+        #: engines, a hex chain state for the sampled stub) — handed
+        #: back verbatim on a death-resume so sampled streams
+        #: continue byte-exact, not just greedy ones
+        self.key_ckpt: dict[int, object] = {}
         self.pending_bundles: deque = deque()
         self._next_rid = 0
         self._rr = 0
@@ -682,6 +807,11 @@ class PlaneRouter:
                     "priority": req["priority"],
                     "deadline_s": req["deadline_s"],
                     "resume_prefix": list(resume_prefix or []) or None,
+                    # a resume carries the checkpointed key state so a
+                    # sampled stream continues where the dead replica
+                    # stopped; fresh work derives its own request key
+                    "key": (self.key_ckpt.get(rid)
+                            if resume_prefix is not None else None),
                 })
             except ReplicaDead:
                 self._on_death(h)
@@ -752,6 +882,10 @@ class PlaneRouter:
             rec["t_first"] = rec["t_finish"]
         self.finished[rid] = tokens
         self.progress.pop(rid, None)
+        # the key checkpoint resolves with the request, like the
+        # progress half above — a long-lived router must not grow one
+        # dead key entry per served request
+        self.key_ckpt.pop(rid, None)
 
     def _merge_round(self, h: ReplicaHandle, reply: dict) -> None:
         now = time.perf_counter()
@@ -763,6 +897,8 @@ class PlaneRouter:
             rec = self.stats.get(rid)
             if rec is not None and rec["t_first"] is None and toks:
                 rec["t_first"] = now
+        for rid_s, key in reply.get("keys", {}).items():
+            self.key_ckpt[int(rid_s)] = key
         outcomes = reply.get("outcomes", {})
         for rid_s, toks in reply.get("finished", {}).items():
             rid = int(rid_s)

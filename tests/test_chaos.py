@@ -269,3 +269,44 @@ class TestReplicaSite:
                  if e["site"] == "replica_round"]
         assert len(fired) == 1 and fired[0]["index"] == 1
         assert dt >= 0.03
+
+
+class TestMatching:
+    """Round 14: ``matching``/``record_injection`` — the caller-
+    executed injection pair the IN-PROCESS serving plane uses (every
+    replica shares one OS process, so a die fault must mark ONE
+    replica dead instead of SIGKILLing the plane; the plane executes
+    the semantics, these helpers keep the determinism and the
+    fault-actually-fired log)."""
+
+    def test_matching_returns_without_executing(self):
+        chaos.configure("die:replica=1,at=2,site=replica_round")
+        # a die fault MATCHED but not executed: the process survives
+        assert chaos.matching("replica_round", 2, rank=1)
+        assert not chaos.matching("replica_round", 2, rank=0)
+        assert not chaos.matching("replica_round", 1, rank=1)
+        assert not chaos.matching("engine_round", 2, rank=1)
+        assert chaos.injections() == ()  # nothing logged either
+
+    def test_rank_overrides_process_rank(self, monkeypatch):
+        # the explicit rank is the REPLICA ordinal, independent of
+        # the process's own id
+        monkeypatch.setenv(chaos.ENV_PROCESS_ID, "7")
+        chaos.configure("stall:replica=3,at=0,delay_ms=1,"
+                        "site=replica_round")
+        assert chaos.matching("replica_round", 0, rank=3)
+        assert not chaos.matching("replica_round", 0)  # process rank 7
+
+    def test_record_injection_feeds_the_log(self):
+        chaos.configure("die:replica=1,at=0,site=replica_round")
+        chaos.record_injection("replica_round", 0, "die", rank=1)
+        (e,) = chaos.injections()
+        assert e == {"site": "replica_round", "index": 0,
+                     "kind": "die", "rank": 1, "delay_s": 0.0}
+
+    def test_matching_respects_suppression_and_off(self):
+        chaos.configure("stall:at=0,delay_ms=1,site=replica_round")
+        with chaos.suppress("replica_round"):
+            assert chaos.matching("replica_round", 0, rank=0) == ()
+        chaos.configure(None)
+        assert chaos.matching("replica_round", 0, rank=0) == ()

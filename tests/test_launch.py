@@ -571,7 +571,14 @@ class TestServingPlaneLaunch:
         # the router must re-queue its in-flight requests as resumes
         # on survivors — byte-checked by the stub oracle — with the
         # lost replica named in the rank report and on the
-        # trace_merged record, and nothing shed silently
+        # trace_merged record, and nothing shed silently. The stream
+        # is SAMPLED (round 14, the PR 9 remainder): stub tokens come
+        # from an evolving per-row key CHAIN, the round replies
+        # checkpoint the chain state, and the router hands it back on
+        # the death-resume — the oracle walks the chain from key_0,
+        # so a resume that LOST the key restarts the chain and
+        # diverges at its first resumed token (teeth; the greedy stub
+        # oracle stays covered by the disaggregated test above)
         out, log = tmp_path / "merged.json", tmp_path / "run.jsonl"
         code = launch.main([
             "-np", "4", "--timeout", "60",
@@ -580,7 +587,8 @@ class TestServingPlaneLaunch:
             sys.executable, "-m", "hpc_patterns_tpu.apps.plane_app",
             "--stub", "--roles", "both,both,both",
             "--rdv", str(tmp_path / "rdv"), "--requests", "9",
-            "--rate", "10000", "--budget", "16", "--trace",
+            "--rate", "10000", "--budget", "16",
+            "--temperature", "0.7", "--trace",
         ])
         printed = capsys.readouterr().out
         assert code == 1  # a rank died: the launch fails loudly...

@@ -383,6 +383,45 @@ class TestCowComposition:
         np.testing.assert_array_equal(warm.run()[sid], want)
         assert len(warm.run()[sid]) == len(want) and w in warm.finished
 
+    def test_migration_seeds_the_destination_index(self):
+        # the PR 11 remainder, pinned: a migration into a COLD
+        # destination doesn't just materialize — install_migration
+        # PUBLISHES the migrated-in row's prefix span into the
+        # destination's radix index, so the migration WARMS the new
+        # engine's sharing arena (the elastic plane's scale-up/drain
+        # path: a freshly spun-up replica starts sharing immediately)
+        cfg, params = _setup()
+        rng = np.random.RandomState(8)
+        template = rng.randint(0, cfg.vocab, size=16).astype(np.int32)
+        prompt = np.concatenate(
+            [template,
+             rng.randint(0, cfg.vocab, size=5).astype(np.int32)])
+        kw = dict(slots=2, pool_pages=12, pages_per_seq=4, page_size=8,
+                  chunk=2, prompt_buckets=BUCKETS, prefix_cache=True)
+        src = ContinuousBatcher(params, cfg, **kw)
+        sid = src.submit(prompt, 4, seq_id=7)
+        src.service_round(decode=False)
+        bundle = src.export_migration(src.exportable_slots()[0])
+
+        cold = ContinuousBatcher(params, cfg, **kw)
+        s_cold = cold.install_migration(bundle)
+        assert cold._slots[s_cold].shared_pages == 0  # materialized
+        assert cold._prefix.hits == 0
+        # a subsequent same-template admission on the destination
+        # MATCHES the seeded chain: shared pages mapped, prefill
+        # skipped, tokens still standalone-exact
+        p2 = np.concatenate(
+            [template,
+             rng.randint(0, cfg.vocab, size=7).astype(np.int32)])
+        w = cold.submit(p2, 3)
+        got = cold.run()
+        assert cold._prefix.hits >= 1
+        assert cold._prefill_skip_tokens >= 16
+        np.testing.assert_array_equal(
+            got[w], _standalone(params, cfg, p2, 3))
+        np.testing.assert_array_equal(
+            got[sid], _standalone(params, cfg, prompt, 4))
+
     def test_pin_while_shared_blocks_residency_paging(self):
         # refcount >= 2 (net of the index's own reference): the row is
         # PINNED — the manager must never page it to host while the

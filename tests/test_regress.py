@@ -257,3 +257,18 @@ class TestQuantizedSpecs:
         assert f.abs_slack <= 0.05  # dtype geometry: tight band
         b = by_path["detail.quant_bubble_frac"]
         assert b.gated and b.direction == "lower"
+
+
+class TestElasticSpecs:
+    def test_elastic_keys_are_gated_and_covered(self):
+        # the round-14 gated keys exist, gate in the right direction,
+        # and — being gated — ride the coverage-loss warning like
+        # every other headline (a capture that silently drops
+        # elastic_slo_attainment warns instead of reading as green)
+        by_path = {s.path: s for s in regress.SPECS}
+        a = by_path["detail.elastic_slo_attainment"]
+        assert a.gated and a.direction == "higher"
+        assert a.abs_slack <= 0.05  # a fraction near 1.0: tight band
+        g = by_path["detail.goodput_per_replica_round"]
+        assert g.gated and g.direction == "higher"
+        assert g.abs_slack == 0.0

@@ -236,6 +236,52 @@ class TestRouterMechanics:
             _standalone(params, cfg, np.arange(5, dtype=np.int32), 3))
 
 
+class TestReplicaDeathStaticPlane:
+    """The FIXED plane's degraded mode under ``die:replica=N`` chaos
+    (the in-process ``replica_round`` site): a death ends in SHEDDING
+    — counted in the SLO table and ``shed_on_death``, never silent —
+    which is exactly the baseline the elastic plane
+    (serving_plane/autoscaler.py, tests/test_autoscaler.py) beats."""
+
+    def test_death_sheds_counted_survivors_stay_exact(self):
+        from hpc_patterns_tpu.harness import chaos as chaoslib
+        from hpc_patterns_tpu.harness import slo as slolib
+
+        cfg, params = _setup()
+        reqs = _requests(cfg, 4, seed=21)
+        chaoslib.configure("die:replica=1,at=1,site=replica_round")
+        try:
+            plane = ServingPlane(
+                [Replica(EngineCore(params, cfg, **ENG), name=f"r{i}")
+                 for i in range(2)],
+                slo={0: slolib.SLOTarget()})
+            ids = [plane.submit(p, m) for p, m in reqs]
+            got = plane.run()
+            died = [e for e in chaoslib.injections()
+                    if e["kind"] == "die"]
+        finally:
+            chaoslib.reset()
+        # the fault fired against the replica ORDINAL and was logged
+        assert died and died[0]["rank"] == 1
+        assert plane.deaths == ["r1"]
+        # every request resolved: the dead replica's rows are SHED
+        # (empty output, outcome in the table), the survivor's stay
+        # byte-exact — nothing dropped silently
+        assert plane.shed_on_death >= 1
+        outcomes = {plane.stats[r]["outcome"] for r in ids}
+        assert outcomes == {"ok", "shed"}
+        for rid, (p, m) in zip(ids, reqs):
+            if plane.stats[rid]["outcome"] == "ok":
+                np.testing.assert_array_equal(
+                    got[rid], _standalone(params, cfg, p, m))
+            else:
+                assert len(got[rid]) == 0
+        # attainment shows the damage: shed never attains
+        tot = plane.last_slo["total"]
+        assert tot["shed"] == plane.shed_on_death
+        assert tot["attained_frac"] < 1.0
+
+
 class TestMigrationPrimitives:
     def test_export_install_guards(self):
         cfg, params = _setup()
