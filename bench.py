@@ -278,6 +278,40 @@ def _elastic_detail() -> dict:
     }
 
 
+def _autofit_detail() -> dict:
+    """Autofit headline keys (round 16), captured in the same
+    measurement child as the overlap headline:
+
+    - ``fitted_goodput_tok_s``: tok/s of an engine built by
+      ``ContinuousBatcher.from_fitted`` from a FittedConfig that
+      ``harness/autofit.py`` fitted off the recording leg's own RunLog
+      JSONL — the observability-becomes-control loop closed end to
+      end;
+    - ``autofit_gain_frac``: fitted over default wall clock minus one
+      on the same stream and pool geometry (the fitted ladder's
+      expected padding is asserted STRICTLY below the default's before
+      either number exists).
+
+    Runs ``bench_serving.run_fitted``'s smoke shape (both legs
+    byte-exact vs standalone decode). Returns {} on failure — the
+    gate's coverage-loss warning is the tripwire."""
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    import bench_serving
+
+    r = bench_serving.run_fitted(**bench_serving.fit_smoke_config(),
+                                 quiet=True)
+    return {
+        "fitted_goodput_tok_s": round(r["fitted_goodput_tok_s"], 1),
+        "autofit_gain_frac": round(r["autofit_gain_frac"], 4),
+        "autofit_padding_default": round(
+            r["expected_padding_default"], 2),
+        "autofit_padding_fitted": round(r["expected_padding_fitted"], 2),
+    }
+
+
 def _quantized_detail() -> dict:
     """Quantized-decode headline keys (round 13), captured in the same
     measurement child as the overlap headline:
@@ -666,6 +700,16 @@ def main() -> int:
         elastic_detail = {"elastic_error":
                           f"{type(err).__name__}: {err}"}
 
+    # the autofit row (round 16): profile-fitted config A/B — the
+    # fitted ladder's strict padding win + the measured wall-clock
+    # gain (bench_serving.run_fitted smoke — fit ingested through the
+    # real RunLog -> autofit -> from_fitted path, oracle-exact)
+    try:
+        autofit_detail = _autofit_detail()
+    except Exception as err:  # noqa: BLE001 — never sink the headline
+        autofit_detail = {"autofit_error":
+                          f"{type(err).__name__}: {err}"}
+
     # any clamped-to-zero component means the run measured nothing usable
     degenerate = min(t_overlap, t_serial, t_dma, t_comp) <= 0
     if degenerate:
@@ -702,6 +746,7 @@ def main() -> int:
                     **shared_detail,
                     **quant_detail,
                     **elastic_detail,
+                    **autofit_detail,
                     # the five raw (serial, overlap) pairs, measurement
                     # order — the distribution behind the median
                     "pairs_us": [

@@ -3,8 +3,26 @@
 Usage: python benchmarks/bench_serving.py [--n=N] [--slots=S] [--chunk=K]
          [--mix=0|1] [--buckets=auto|none|16,32,...] [--overlap=0|1]
          [--temp=T] [--topk=K] [--smoke] [--scenario] [--plane]
-         [--elastic] [--offload] [--shared] [--quant]
+         [--elastic] [--offload] [--shared] [--quant] [--fit]
+         [--autofit=config.json] [--fit-out=PATH]
          [--kv-dtype=f32|bf16|int8|fp8] [--quant-weights]
+
+``--fit``: the AUTOFIT row (round 16) — observability becomes
+control. A prefill-heavy long-tail stream is served once by the
+default-ladder engine with its ``emit`` stream recorded to a RunLog
+JSONL, ``harness/autofit.py`` fits a versioned FittedConfig from that
+profile (the SAME fitter the CLI ``python -m
+hpc_patterns_tpu.harness.autofit`` runs), and the A/B re-serves the
+stream default vs ``ContinuousBatcher.from_fitted``. The fitted
+ladder's expected padding must STRICTLY beat the default's
+(deterministic, before any wall clock), every sequence on both legs
+is byte-exact vs standalone decode, and the headline keys
+``fitted_goodput_tok_s`` / ``autofit_gain_frac`` are captured by
+``bench.py`` and gated by ``harness/regress.py``
+(docs/observability.md "from diagnosis to control").
+``--autofit=config.json`` replays an existing FittedConfig instead of
+recording (reground step 4h fits from the chip trace); on the plain
+rows it applies the fitted ladder in place of the 'auto' default.
 
 ``--elastic``: the ELASTIC-PLANE row (round 14) — one diurnal
 open-loop ramp under seeded replica-death chaos through a FIXED
@@ -1641,6 +1659,207 @@ def run_plane(*, cfg, params, n, slots, chunk, page_size, prompt_len,
     return result
 
 
+def fit_smoke_config():
+    """The CI autofit shape (tier-1 via tests/test_bench_serving.py):
+    the smoke model on a prefill-heavy long-tail stream whose bulk
+    (60%) sits at a prompt length the default power-of-two ladder pads
+    badly (40 -> 64, +60% prefill work on those rows) — the regime the
+    fitted ladder exists for. Small decode budgets keep the row
+    prefill-dominated so the padding win is visible in wall clock, and
+    the shared smoke cfg/params ride the suite's warm decode caches."""
+    base = smoke_config()
+    return dict(cfg=base["cfg"], params=base["params"], n=16, slots=4,
+                chunk=16, page_size=16, max_budget=32, reps=2,
+                lengths=(16, 40, 64), length_probs=(0.2, 0.6, 0.2))
+
+
+def fit_full_config(on_tpu: bool):
+    """The re-grounding shape (reground_r5.sh step 4h): the scenario
+    model on the same long-tail length mix scaled to chip prompts —
+    fit once from the recorded stream, then the fitted ladder must
+    beat the default on real HBM prefills."""
+    base = scenario_full_config(on_tpu)
+    top = 512 if on_tpu else 64
+    return dict(cfg=base["cfg"], params=base["params"],
+                n=32 if on_tpu else 16, slots=8 if on_tpu else 4,
+                chunk=16, page_size=256 if on_tpu else 16,
+                max_budget=256 if on_tpu else 32, reps=2,
+                lengths=(top // 4, (5 * top) // 8, top),
+                length_probs=(0.2, 0.6, 0.2))
+
+
+def run_fitted(*, cfg, params, n, slots, chunk, page_size, max_budget,
+               lengths, length_probs, reps=2, autofit_path=None,
+               fit_out=None, quiet=False):
+    """The AUTOFIT row (round 16): observability becomes control. One
+    long-tail stream served three times:
+
+    1. the RECORDING leg — the default-ladder engine, untimed, with
+       its ``emit`` stream captured to a RunLog JSONL (the profile
+       artifact a production run would already have);
+    2. ``harness.autofit`` fits a FittedConfig from that JSONL through
+       the REAL ingestion path (``fit_paths`` -> ``dumps_config`` ->
+       ``load_fitted`` round trip, exactly what the CLI does);
+    3. the A/B — the default-ladder engine vs
+       ``ContinuousBatcher.from_fitted`` on the SAME stream and pool
+       geometry, warmed then timed min-of-reps.
+
+    Deterministic win first: the fitted ladder's expected padding must
+    be STRICTLY below the default's on the observed lengths (the DP
+    fitter's contract — no wall clock involved). Oracle before any
+    number: every sequence on BOTH legs byte-exact vs standalone
+    ``paged_generate``. Reports ``fitted_goodput_tok_s`` and
+    ``autofit_gain_frac`` (fitted/default - 1), the two keys
+    ``bench.py`` captures and ``harness/regress.py`` gates.
+
+    ``autofit_path``: skip the recording leg and apply an existing
+    FittedConfig (reground step 4h fits from the chip trace);
+    ``fit_out``: also copy the fitted config JSON here."""
+    import tempfile
+
+    from hpc_patterns_tpu.harness import autofit as autofitlib
+    from hpc_patterns_tpu.harness.runlog import RunLog
+
+    out = print if not quiet else (lambda *a, **k: None)
+    rng = np.random.RandomState(7)
+    reqs = []
+    for _ in range(n):
+        t = int(rng.choice(lengths, p=length_probs))
+        prompt = rng.randint(0, cfg.vocab, size=t).astype(np.int32)
+        budget = int(rng.choice(
+            [max(1, max_budget // 8), max(1, max_budget // 4),
+             max_budget],
+            p=[0.5, 0.3, 0.2]))
+        reqs.append((prompt, budget))
+    total_tokens = sum(b for _, b in reqs)
+    obs_lengths = [len(p) for p, _ in reqs]
+    default_ladder = bucket_ladder(max(obs_lengths))
+
+    def mk_engine(buckets, pages, *, emit=None):
+        return ContinuousBatcher(
+            params, cfg, slots=slots, pool_pages=slots * pages,
+            pages_per_seq=pages, page_size=page_size, chunk=chunk,
+            prompt_buckets=buckets, emit=emit)
+
+    def serve(eng):
+        ids = [eng.submit(p, b) for p, b in reqs]
+        got = eng.run()
+        return {i: got[s] for i, s in enumerate(ids)}
+
+    with tempfile.TemporaryDirectory() as tmp:
+        cfg_path = os.path.join(tmp, "fitted.json")
+        if autofit_path is None:
+            # recording leg: the profile run the fitter consumes —
+            # default config, untimed, emit -> RunLog JSONL
+            log_path = os.path.join(tmp, "profile.jsonl")
+            pages_rec = max(
+                ContinuousBatcher.pages_needed(
+                    len(p), b, page_size,
+                    padded_len=pad_to_bucket(default_ladder, len(p)))
+                for p, b in reqs)
+            serve(mk_engine(default_ladder, pages_rec,
+                            emit=RunLog(log_path).emit))
+            fitted = autofitlib.fit_paths([log_path])
+            with open(cfg_path, "w") as f:
+                f.write(autofitlib.dumps_config(fitted))
+        else:
+            cfg_path = autofit_path
+        # the round trip every consumer uses (CLI parity)
+        fitted = autofitlib.load_fitted(cfg_path)
+        if fit_out:
+            with open(fit_out, "w") as f:
+                f.write(autofitlib.dumps_config(fitted))
+        fitted_ladder = autofitlib.ladder_from(fitted,
+                                               max_seq=cfg.max_seq)
+    assert fitted_ladder is not None, (
+        "the fitted config carries no ladder — the recording leg "
+        "emitted no serve_admit records")
+
+    # the deterministic win BEFORE any wall clock: the DP fit must
+    # strictly beat the shape-blind default on the observed lengths
+    pad_fit = expected_padding(fitted_ladder, obs_lengths)
+    pad_default = expected_padding(default_ladder, obs_lengths)
+    assert pad_fit < pad_default, (
+        f"fitted ladder {fitted_ladder} does not beat default "
+        f"{default_ladder}: E[pad] {pad_fit:.2f} vs {pad_default:.2f}")
+
+    # the A/B shares ONE pool geometry (sized for whichever ladder
+    # pads a length worse) so the comparison is ladder-only
+    pages_per_seq = max(
+        ContinuousBatcher.pages_needed(
+            len(p), b, page_size,
+            padded_len=max(pad_to_bucket(default_ladder, len(p)),
+                           pad_to_bucket(fitted_ladder, len(p))))
+        for p, b in reqs)
+
+    def mk_fitted():
+        eng = ContinuousBatcher.from_fitted(
+            params, cfg, fitted, slots=slots,
+            pool_pages=slots * pages_per_seq,
+            pages_per_seq=pages_per_seq, page_size=page_size,
+            chunk=chunk)
+        assert eng.prompt_buckets == fitted_ladder, (
+            "from_fitted did not apply the fitted ladder")
+        return eng
+
+    # warmup (compiles), then min-of-reps timed legs; the timed runs
+    # must add no prefill compiles
+    serve(mk_engine(default_ladder, pages_per_seq))
+    serve(mk_fitted())
+    compiles_warm = prefill_cache_size()
+    t_default = t_fitted = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        default_out = serve(mk_engine(default_ladder, pages_per_seq))
+        t_default = min(t_default, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        fitted_out = serve(mk_fitted())
+        t_fitted = min(t_fitted, time.perf_counter() - t0)
+    assert prefill_cache_size() == compiles_warm, (
+        "a timed leg recompiled prefill — the warmup missed a rung")
+
+    # oracle before any number is believed: both legs byte-exact vs
+    # standalone decode (the fitted ladder changes padding, never
+    # tokens)
+    for i, (prompt, b) in enumerate(reqs):
+        want = np.asarray(paged_generate(
+            params, jnp.asarray(prompt)[None], cfg, b,
+            page_size=page_size))[0]
+        np.testing.assert_array_equal(default_out[i], want,
+                                      err_msg=f"default seq {i}")
+        np.testing.assert_array_equal(fitted_out[i], want,
+                                      err_msg=f"fitted seq {i}")
+
+    gain = t_default / t_fitted - 1.0
+    result = {
+        "t_default": t_default, "t_fitted": t_fitted,
+        "tokens": total_tokens,
+        "default_goodput_tok_s": total_tokens / t_default,
+        "fitted_goodput_tok_s": total_tokens / t_fitted,
+        "autofit_gain_frac": gain,
+        "ladder_default": list(default_ladder),
+        "ladder_fitted": list(fitted_ladder),
+        "expected_padding_default": pad_default,
+        "expected_padding_fitted": pad_fit,
+        "config_sections": sorted(
+            k for k in ("ladder", "residency", "placement",
+                        "autoscaler") if fitted.get(k)),
+    }
+    out(f"autofit: n={n} slots={slots} chunk={chunk} "
+        f"lengths={sorted(set(obs_lengths))} tokens={total_tokens} "
+        f"({'replayed ' + autofit_path if autofit_path else 'fitted from recording leg'})")
+    out(f"  default : {t_default:.3f}s  "
+        f"{result['default_goodput_tok_s']:,.1f} tok/s  ladder "
+        f"{list(default_ladder)}  E[pad] {pad_default:.1f}")
+    out(f"  fitted  : {t_fitted:.3f}s  "
+        f"{result['fitted_goodput_tok_s']:,.1f} tok/s  ladder "
+        f"{list(fitted_ladder)}  E[pad] {pad_fit:.1f}")
+    out(f"  autofit gain {gain:+.1%} wall clock, E[pad] "
+        f"{pad_default:.1f} -> {pad_fit:.1f} tokens/req "
+        "(oracle-exact, strict padding win asserted)")
+    return result
+
+
 def _apply_kv_dtype(conf, kv_dtype):
     """Thread a ``--kv-dtype`` value into a serving-bench config dict
     (the compound rows: --offload/--plane run their whole scenario on
@@ -1722,6 +1941,14 @@ def main():
             run_elastic(**elastic_full_config(
                 jax.default_backend() == "tpu"))
         return
+    if arg("fit", False, bool):
+        if arg("smoke", False, bool):
+            conf = fit_smoke_config()
+        else:
+            conf = fit_full_config(jax.default_backend() == "tpu")
+        run_fitted(**conf, autofit_path=arg("autofit", None, str),
+                   fit_out=arg("fit-out", None, str))
+        return
     if arg("plane", False, bool):
         if arg("smoke", False, bool):
             run_plane(**_apply_kv_dtype(plane_smoke_config(),
@@ -1737,10 +1964,26 @@ def main():
             run_scenario(**scenario_full_config(
                 jax.default_backend() == "tpu"))
         return
+    def resolve_autofit_buckets(buckets, max_seq):
+        # --autofit on the plain rows: the fitted ladder replaces the
+        # default 'auto' ladder (an explicit --buckets value wins) —
+        # the SAME precedence the CLI serving surfaces apply
+        path = arg("autofit", None, str)
+        if not path or buckets != "auto":
+            return buckets
+        from hpc_patterns_tpu.harness import autofit as autofitlib
+
+        fb = autofitlib.ladder_from(autofitlib.load_fitted(path),
+                                    max_seq=max_seq)
+        return fb if fb is not None else buckets
+
     if arg("smoke", False, bool):
-        run_bench(**smoke_config(),
+        conf = smoke_config()
+        run_bench(**conf,
                   overlap=bool(arg("overlap", 1)),
-                  buckets=arg("buckets", "auto", str))
+                  buckets=resolve_autofit_buckets(
+                      arg("buckets", "auto", str),
+                      conf["cfg"].max_seq))
         return
     on_tpu = jax.default_backend() == "tpu"
     n = arg("n", 32 if on_tpu else 16)
@@ -1768,7 +2011,8 @@ def main():
               prompt_len=prompt_len, max_budget=max_budget,
               cfg=cfg, params=params,
               mix=bool(arg("mix", 1)),
-              buckets=arg("buckets", "auto", str),
+              buckets=resolve_autofit_buckets(
+                  arg("buckets", "auto", str), cfg.max_seq),
               overlap=bool(arg("overlap", 1)),
               temperature=arg("temp", 0.0, float),
               top_k=arg("topk", 0))

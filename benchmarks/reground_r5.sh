@@ -157,6 +157,27 @@ run "serving plane @ int8 kv" python benchmarks/bench_serving.py --plane --kv-dt
 #     harness/regress.py.
 run "serving elastic ramp under replica death" python benchmarks/bench_serving.py --elastic
 
+# 4h. AUTOFIT row (round 16): observability becomes control. The --fit
+#     leg records an untimed serving leg under the default config into
+#     a run log, fits a versioned config from that trace
+#     (harness/autofit.py: exact-DP bucket ladder from serve_admit
+#     prompt lengths, residency prefetch depth from mem.prefetch
+#     overlap, placement weights from per-replica busy/queue rollups,
+#     autoscaler bands by offline replay), then A/Bs default-vs-fitted
+#     on the SAME stream and pool geometry. The strict claim — fitted
+#     expected padding < default — is asserted in-run before any
+#     number prints, and both legs are oracle-exact vs paged_generate.
+#     On chip this is the first real wall-clock number for the fitted
+#     gain; fitted_goodput_tok_s / autofit_gain_frac are captured by
+#     bench.py and gated by harness/regress.py. --fit-out persists the
+#     chip-fitted config; the second leg replays it through the SAME
+#     CLI path serve_app --autofit uses (load_fitted round trip), so
+#     the artifact is proven consumable, not just writable.
+run "serving autofit A/B (fit on chip trace)" \
+  python benchmarks/bench_serving.py --fit --fit-out="${LOG%.log}_autofit.json"
+run "serving autofit replay (chip-fitted config)" \
+  python benchmarks/bench_serving.py --fit --autofit="${LOG%.log}_autofit.json"
+
 # 5. aligned speculative pair + gamma sweep + batched impls (item 4, 7)
 run "make draft pair" python benchmarks/make_draft_pair.py --out=benchmarks/pair_r5
 run "speculative aligned sweep" python benchmarks/bench_speculative.py --pair=benchmarks/pair_r5 --batched=8

@@ -153,6 +153,17 @@ DEFAULT_DISPATCH_CRITICAL = frozenset({
     "_checkpoint_replica",
     "_probe_replica_chaos",
     "_shed_request",
+    # the round-16 autofit-apply paths: from_fitted constructors swap
+    # in the fitted ladder/weights/thresholds right before serving
+    # starts, and the per-round attainment gauge (_judge_window /
+    # _emit_attainment) runs inside the router's service round with
+    # replica chunks in flight — both must stay pure host dict/list
+    # work; a device readback there would stall the very first chunks
+    # the fitted config exists to speed up
+    "from_fitted",
+    "ladder_from",
+    "_judge_window",
+    "_emit_attainment",
 })
 
 # rule names are kebab-case identifiers; anything after the last name

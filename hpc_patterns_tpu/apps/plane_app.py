@@ -48,11 +48,12 @@ import os
 import sys
 import time
 
-from hpc_patterns_tpu.harness.cli import base_parser
+from hpc_patterns_tpu.harness.cli import add_autofit_arg, base_parser
 
 
 def build_parser():
     p = base_parser(__doc__.splitlines()[0])
+    add_autofit_arg(p)
     p.add_argument("--rdv", required=True,
                    help="rendezvous directory replicas publish their "
                         "listen addresses under (shared by all ranks)")
@@ -150,11 +151,24 @@ def _run_router(args, nprocs: int) -> int:
     print(f"router: {len(handles)} replica(s) connected "
           f"(roles {[h.role for h in handles]}, "
           f"policy {args.policy})", flush=True)
-    router = service.PlaneRouter(
-        handles, policy=args.policy,
-        slo_targets=slolib.targets_from_classes(classes),
-        emit=(RunLog(args.log, truncate=False).emit
-              if args.log else None))
+    emit = (RunLog(args.log, truncate=False).emit
+            if args.log else None)
+    if args.fitted is not None:
+        # fitted placement (policy + per-replica weights) applies
+        # unless the user picked a non-default --policy explicitly
+        kw = ({"policy": args.policy}
+              if args.policy != "least_loaded" else {})
+        router = service.PlaneRouter.from_fitted(
+            handles, args.fitted,
+            slo_targets=slolib.targets_from_classes(classes),
+            emit=emit, **kw)
+        print(f"router: autofit placement from {args.autofit} "
+              f"(policy {router.policy})", flush=True)
+    else:
+        router = service.PlaneRouter(
+            handles, policy=args.policy,
+            slo_targets=slolib.targets_from_classes(classes),
+            emit=emit)
     report = router.run(arrivals, timeout_s=args.plane_timeout)
 
     ok = True
@@ -236,13 +250,24 @@ def _run_replica(args, rank: int, role: str) -> int:
         # identical seed on every replica: request_key(sid) must not
         # depend on placement (the plane's routing-invariance contract)
         params = init_params(jax.random.PRNGKey(0), cfg)
-        engine = EngineCore(
-            params, cfg, slots=args.slots, pool_pages=pool,
+        kw = dict(
+            slots=args.slots, pool_pages=pool,
             pages_per_seq=pages_per_seq, page_size=args.page_size,
-            chunk=args.chunk,
-            prompt_buckets=bucket_ladder(args.prompt_len),
-            temperature=args.temperature,
+            chunk=args.chunk, temperature=args.temperature,
             top_k=8 if args.temperature > 0 else 0, seed=0)
+        if args.fitted is not None:
+            # fitted ladder when present; default ladder otherwise
+            engine = EngineCore.from_fitted(
+                params, cfg, args.fitted, **kw)
+            if engine.prompt_buckets is None:
+                engine = EngineCore(
+                    params, cfg,
+                    prompt_buckets=bucket_ladder(args.prompt_len),
+                    **kw)
+        else:
+            engine = EngineCore(
+                params, cfg,
+                prompt_buckets=bucket_ladder(args.prompt_len), **kw)
         adapter = service.RealAdapter(engine, role=role)
     return service.serve_replica(
         adapter, rank=rank, rdv_dir=args.rdv,
@@ -256,6 +281,17 @@ def run(args) -> int:
         print("ERROR: plane_app needs a launcher (-np >= 2: one "
               "router + at least one replica); see docs/serving_plane.md")
         return 2
+    # one load point for every rank: the router applies fitted
+    # placement, real replicas the fitted ladder (cli.load_autofit)
+    args.fitted = None
+    if args.autofit:
+        from hpc_patterns_tpu.harness.cli import load_autofit
+
+        try:
+            args.fitted = load_autofit(args.autofit)
+        except (OSError, ValueError) as e:
+            print(f"ERROR: bad --autofit {args.autofit}: {e}")
+            return 2
     os.makedirs(args.rdv, exist_ok=True)
     roles = _roles_for(nprocs - 1, args.roles)
     t0 = time.perf_counter()

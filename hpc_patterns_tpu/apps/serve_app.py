@@ -31,9 +31,11 @@ from hpc_patterns_tpu.apps import common
 from hpc_patterns_tpu.harness import RunLog, Verdict
 from hpc_patterns_tpu.harness import metrics as metricslib
 from hpc_patterns_tpu.harness.cli import (
+    add_autofit_arg,
     add_kv_dtype_arg,
     add_serving_args,
     base_parser,
+    load_autofit,
     parse_buckets,
     resolve_kv_cache_dtype,
 )
@@ -43,6 +45,7 @@ from hpc_patterns_tpu.models import TransformerConfig, init_params
 def build_parser():
     p = base_parser(__doc__.splitlines()[0])
     add_serving_args(p)
+    add_autofit_arg(p)
     p.add_argument("--requests", type=int, default=8)
     p.add_argument("--slots", type=int, default=2,
                    help="concurrent rows in the pool")
@@ -104,7 +107,20 @@ def run(args) -> int:
     need = args.prompt_len + args.budget
     try:
         buckets = parse_buckets(args.prompt_buckets, args.prompt_len)
-    except (ValueError, argparse.ArgumentTypeError) as e:
+        if args.autofit is not None:
+            # the fitted ladder replaces the default 'auto' ladder;
+            # an explicit --prompt-buckets value still wins
+            from hpc_patterns_tpu.harness import autofit as autofitlib
+
+            fitted = load_autofit(args.autofit)
+            fitted_buckets = autofitlib.ladder_from(
+                fitted, max_seq=args.prompt_len)
+            if (args.prompt_buckets.strip().lower() == "auto"
+                    and fitted_buckets is not None):
+                buckets = fitted_buckets
+                log.print(f"autofit ladder from {args.autofit}: "
+                          f"{list(buckets)}")
+    except (OSError, ValueError, argparse.ArgumentTypeError) as e:
         log.print(f"ERROR: {e}")
         log.print("FAILURE")
         return 1

@@ -186,6 +186,34 @@ def parse_buckets(spec: str, max_prompt_len: int):
             f"comma-separated ints, got {spec!r}") from e
 
 
+def add_autofit_arg(p: argparse.ArgumentParser) -> None:
+    """The shared ``--autofit`` flag: every serving surface that can
+    consume a FittedConfig (serve_app, plane_app; bench_serving mirrors
+    it through its own flag parser) ingests through the SAME
+    :func:`load_autofit`, so a config fitted once applies identically
+    everywhere."""
+    p.add_argument(
+        "--autofit",
+        default=None,
+        metavar="CONFIG",
+        help="apply a FittedConfig JSON emitted by `python -m "
+             "hpc_patterns_tpu.harness.autofit run.jsonl --emit "
+             "CONFIG`: the fitted prompt ladder (and, where the "
+             "surface has them, residency / placement / autoscaler "
+             "knobs) replace the defaults; explicit flags still win",
+    )
+
+
+def load_autofit(path):
+    """Load-and-validate a ``--autofit`` value (None passes through) —
+    the one CLI ingestion point over ``autofit.load_fitted``."""
+    if not path:
+        return None
+    from hpc_patterns_tpu.harness import autofit
+
+    return autofit.load_fitted(path)
+
+
 def add_msg_size_args(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "-p",

@@ -618,6 +618,35 @@ def format_rollup(rollup: dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+#: schema version of the ``--rollup-out`` artifact — bump on any
+#: breaking change to the rollup key layout so downstream fitters
+#: (harness/autofit.py) can refuse a layout they don't understand
+ROLLUP_VERSION = 1
+ROLLUP_KIND = "trace_rollup"
+
+
+def dumps_rollup(rollup: dict[str, Any]) -> str:
+    """The stable serialized form of the ``--rollup-out`` artifact:
+    the trace_merged payload wrapped in a version/kind envelope,
+    sorted keys, trailing newline — byte-identical for identical
+    rollups, so a fitted config derived from it is reproducible."""
+    doc = {"version": ROLLUP_VERSION, "kind": ROLLUP_KIND,
+           **{k: v for k, v in rollup.items()
+              if not k.startswith("_")}}
+    return json.dumps(doc, sort_keys=True, indent=2, default=str) + "\n"
+
+
+def write_rollup(rollup: dict[str, Any], path: str | Path) -> Path:
+    """Write the versioned rollup JSON and record its location in the
+    rollup itself (``rollup_out``), so the ``kind=trace_merged`` runlog
+    record — and harness.report's digest line — name the artifact."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    rollup["rollup_out"] = str(path)
+    path.write_text(dumps_rollup(rollup))
+    return path
+
+
 def collect_to_file(inputs: Iterable[str | Path],
                     out: str | Path) -> dict[str, Any] | None:
     """Load, merge, and write the Perfetto JSON to ``out``. Returns the
@@ -652,6 +681,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log", default=None,
                    help="append the kind=trace_merged rollup record to "
                         "this runlog JSONL (harness.report renders it)")
+    p.add_argument("--rollup-out", default=None, metavar="PATH",
+                   help="also write the cross-rank rollup as a stable "
+                        "versioned JSON artifact (kind=trace_rollup, "
+                        f"version {ROLLUP_VERSION}; sorted keys, "
+                        "reproducible bytes) — the file "
+                        "harness/autofit.py consumes for placement "
+                        "fitting, named in harness.report's digest "
+                        "line")
     return p
 
 
@@ -675,6 +712,17 @@ def main(argv=None) -> int:
               "of apps/launch.py --trace-out; kind=trace records by "
               "--trace --log runs)", file=sys.stderr)
         return 2
+    if args.rollup_out:
+        # BEFORE the --log emit: the trace_merged record must carry
+        # the artifact's location for report's digest line
+        try:
+            write_rollup(rollup, args.rollup_out)
+        except OSError as e:
+            print(f"ERROR: cannot write --rollup-out: {e}",
+                  file=sys.stderr)
+            return 2
+        print(f"rollup artifact: {args.rollup_out} "
+              f"(kind={ROLLUP_KIND} v{ROLLUP_VERSION})")
     print(format_rollup(rollup))
     print(f"{out}: open in Perfetto (ui.perfetto.dev) or "
           "chrome://tracing — one pid lane per rank, flow arrows link "

@@ -35,7 +35,9 @@ gated key that a prior comparable same-headline round carried (e.g.
 ``detail.serving_tok_s`` silently dropping out of a capture), that is
 a lost measurement, not a pass — value-only gating would never notice.
 The gate still exits 0 (the round may legitimately skip a subsystem),
-but the warning makes the day a key disappears visible.
+but the warning makes the day a key disappears visible;
+``--strict-coverage`` promotes it to a gate failure for CI legs where
+every subsystem is expected to capture.
 
 Usage::
 
@@ -160,6 +162,18 @@ SPECS: tuple[MetricSpec, ...] = (
     MetricSpec("detail.elastic_slo_attainment", "higher",
                abs_slack=0.05),
     MetricSpec("detail.goodput_per_replica_round", "higher"),
+    # the autofit row (bench_serving --fit, round 16): fitted goodput
+    # is the tok/s of an engine configured by harness/autofit.py from
+    # the recording leg's own RunLog (the fitted ladder's expected
+    # padding is asserted strictly below the default's before the
+    # number exists), and the gain fraction is fitted/default - 1 on
+    # the same stream and pool geometry. The gain is a small ratio of
+    # two wall clocks on a shared CI box, so it carries an absolute
+    # slack wide enough that scheduler noise cannot fail the gate —
+    # the fitter going WRONG shows up as the row's own strict-padding
+    # assertion (coverage loss here), not as a small gain wobble.
+    MetricSpec("detail.fitted_goodput_tok_s", "higher"),
+    MetricSpec("detail.autofit_gain_frac", "higher", abs_slack=0.05),
 )
 
 
@@ -342,6 +356,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "enough for session-to-session chip noise, "
                         "narrow enough to catch a real fast-path "
                         "regression)")
+    p.add_argument("--strict-coverage", action="store_true",
+                   help="fail (exit 1) on coverage loss instead of "
+                        "warning: a gated key that prior rounds "
+                        "carried but the newest lacks becomes a gate "
+                        "failure — for CI legs where every subsystem "
+                        "is expected to capture")
     return p
 
 
@@ -358,12 +378,18 @@ def main(argv=None) -> int:
         return 2
     result = compare(rounds, tolerance=args.tolerance)
     print(format_table(result, args.tolerance))
-    for name, last_n in result.get("coverage_loss", []):
+    coverage_loss = result.get("coverage_loss", [])
+    for name, last_n in coverage_loss:
         # stderr too: CI logs that only keep stderr still surface it
-        print(f"WARNING: coverage loss — gated key {name!r} absent "
+        severity = "ERROR" if args.strict_coverage else "WARNING"
+        print(f"{severity}: coverage loss — gated key {name!r} absent "
               f"from the newest round (last carried by r{last_n})",
               file=sys.stderr)
-    return 1 if any(r.failed for r in result["rows"]) else 0
+    if any(r.failed for r in result["rows"]):
+        return 1
+    if args.strict_coverage and coverage_loss:
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

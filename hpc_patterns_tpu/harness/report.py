@@ -97,6 +97,7 @@ def aggregate(records: list[dict[str, Any]]) -> dict[str, Any]:
                 "schedule": rec.get("schedule", {}),
                 "stragglers": rec.get("stragglers", {}),
                 "out": rec.get("out"),
+                "rollup_out": rec.get("rollup_out"),
             })
         if kind == "trace":
             # flight-recorder snapshot (harness/trace.py): summarize
@@ -224,6 +225,10 @@ def format_report(agg: dict[str, Any], source: str = "") -> str:
             line += f", SCHEDULE DIVERGENCE at #{fd.get('index', '?')}"
         if t.get("out"):
             line += f" — timeline: {t['out']}"
+        if t.get("rollup_out"):
+            # the versioned rollup artifact (collect --rollup-out):
+            # name it so the autofit leg knows what to consume
+            line += f", rollup: {t['rollup_out']}"
         lines.append(line)
     for t in agg.get("traces", []):
         cats = ", ".join(f"{k}={n}" for k, n in sorted(t["by_cat"].items()))

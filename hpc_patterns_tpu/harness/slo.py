@@ -32,6 +32,7 @@ Import-light (numpy only), same discipline as loadgen/chaos.
 
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Iterable, Mapping
 
@@ -92,6 +93,56 @@ def attained(rec: Mapping[str, Any], target: SLOTarget) -> bool:
             and tpot > target.tpot_s:
         return False
     return True
+
+
+class AttainmentWindow:
+    """A sliding window over the most recent per-request SLO judgments
+    — the ONE attainment signal the serving planes emit per round (as a
+    metrics gauge, a trace counter, and a ``kind=plane_attainment``
+    RunLog record), so the in-process autoscaler, the launched router,
+    and the offline autofit threshold fitter all read the same number
+    instead of three subtly different recomputations.
+
+    Judgments enter as requests RESOLVE (served → :func:`attained`
+    verdict; shed → not attained), so the window tracks recent service
+    quality, not the full-run average :func:`attainment` reports at the
+    end. Pure bookkeeping: no clocks, no I/O."""
+
+    def __init__(self, window: int = 64):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.window = int(window)
+        self._judgments: deque[tuple[int, bool]] = deque(
+            maxlen=self.window)
+        self.judged = 0      # lifetime totals (per-round deltas are
+        self.attained = 0    # the autoscaler's Signals currency)
+
+    def judge(self, rec: Mapping[str, Any], target: SLOTarget) -> bool:
+        """Judge one resolved stats record against its class target and
+        fold the verdict into the window."""
+        ok = attained(rec, target)
+        self.observe(int(rec.get("priority", 0)), ok)
+        return ok
+
+    def observe(self, priority: int, ok: bool) -> None:
+        self._judgments.append((int(priority), bool(ok)))
+        self.judged += 1
+        self.attained += int(bool(ok))
+
+    def snapshot(self) -> dict[str, Any]:
+        """``{"n", "overall", "per_class"}`` over the current window;
+        ``overall`` is None while nothing has been judged."""
+        per: dict[int, list[bool]] = {}
+        for prio, ok in self._judgments:
+            per.setdefault(prio, []).append(ok)
+        n = len(self._judgments)
+        return {
+            "n": n,
+            "overall": (sum(ok for _, ok in self._judgments) / n
+                        if n else None),
+            "per_class": {p: sum(v) / len(v)
+                          for p, v in sorted(per.items())},
+        }
 
 
 def attainment(stats: Mapping[int, Mapping[str, Any]],

@@ -174,18 +174,27 @@ class ResidencyManager:
     pool is the larger tier the HBM arena caches. ``policy``: an
     :class:`EvictionPolicy` (default LRU). ``min_resident_rounds``: a
     group prefetched in stays unevictable this many rounds (anti-
-    thrash floor). ``device``: where pulls land (default first
-    device)."""
+    thrash floor). ``prefetch_depth``: advisory cap on concurrently
+    in-flight pulls the consumer should dispatch (None = unlimited —
+    the engine reads it at its prefetch-dispatch site; autofit sets 1
+    when the recorded pulls ran exposed). ``device``: where pulls land
+    (default first device)."""
 
     def __init__(self, *, host_blocks: int, policy: EvictionPolicy
                  | None = None, min_resident_rounds: int = 1,
-                 device=None):
+                 prefetch_depth: int | None = None, device=None):
         if host_blocks < 1:
             raise ValueError(
                 f"host_blocks must be >= 1, got {host_blocks}")
+        if prefetch_depth is not None and prefetch_depth < 1:
+            raise ValueError(
+                f"prefetch_depth must be >= 1 or None, got "
+                f"{prefetch_depth}")
         self.host_blocks = int(host_blocks)
         self.policy = policy or LRUPolicy()
         self.min_resident_rounds = int(min_resident_rounds)
+        self.prefetch_depth = (None if prefetch_depth is None
+                               else int(prefetch_depth))
         self._device = device
         self.blocks: dict[object, BlockState] = {}
         self.round = 0
@@ -203,6 +212,40 @@ class ResidencyManager:
         #: open ``mem.evict`` windows awaiting a cheap completion
         #: observation: (trace_stamp, track, payload leaf, attrs)
         self._open_evicts: list[tuple] = []
+
+    @classmethod
+    def from_fitted(cls, fitted, *, host_blocks: int, device=None):
+        """Build a manager from an autofit ``FittedConfig``: the fitted
+        ``residency`` section picks the eviction policy (``lru`` /
+        ``priority`` / ``cold_after_n``), the anti-thrash floor, and
+        the prefetch depth; a config with no residency section (the
+        run never paged) yields the plain default manager. Capacity
+        (``host_blocks``) stays the caller's — it is sized by the
+        deployment, not the profile."""
+        from hpc_patterns_tpu.harness import autofit as autofitlib
+
+        fitted = autofitlib.validate_fitted(fitted)
+        section = fitted.get("residency") or {}
+        name = section.get("policy") or "lru"
+        if name == "priority":
+            policy: EvictionPolicy = PriorityAwarePolicy()
+        elif name == "cold_after_n":
+            policy = ColdAfterNPolicy(int(section.get("cold_after_n")
+                                          or 1))
+        elif name == "lru":
+            policy = LRUPolicy()
+        else:
+            raise ValueError(
+                f"fitted residency policy {name!r} unknown (expected "
+                "lru / priority / cold_after_n)")
+        return cls(
+            host_blocks=host_blocks,
+            policy=policy,
+            min_resident_rounds=int(
+                section.get("min_resident_rounds") or 1),
+            prefetch_depth=section.get("prefetch_depth"),
+            device=device,
+        )
 
     # -- device / tier plumbing --------------------------------------------
 

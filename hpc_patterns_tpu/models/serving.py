@@ -874,6 +874,23 @@ class EngineCore:
         # completions, and queue waits; None = silent
         self._emit = emit or (lambda **kw: None)
 
+    @classmethod
+    def from_fitted(cls, params, cfg: TransformerConfig, fitted, **kw):
+        """Build an engine from a :mod:`hpc_patterns_tpu.harness.autofit`
+        ``FittedConfig`` (the dict, as ``autofit.load_fitted`` returns
+        it): the fitted prompt ladder becomes ``prompt_buckets``
+        (clamped to this model's ``max_seq``), everything else passes
+        through unchanged. An explicit ``prompt_buckets=`` kwarg wins —
+        the caller's hand-tuned ladder outranks the fit."""
+        from hpc_patterns_tpu.harness import autofit as autofitlib
+
+        fitted = autofitlib.validate_fitted(fitted)
+        if kw.get("prompt_buckets") is None:
+            buckets = autofitlib.ladder_from(fitted, max_seq=cfg.max_seq)
+            if buckets is not None:
+                kw["prompt_buckets"] = buckets
+        return cls(params, cfg, **kw)
+
     # -- admission ---------------------------------------------------------
 
     @staticmethod
@@ -2219,8 +2236,14 @@ class EngineCore:
         # less important row back (same class: the swapped row wins —
         # its tokens are already paid for, the resume-before-fresh rule)
         q_min = min((r.priority for r in self._queue), default=None)
+        # the manager's fitted prefetch depth (autofit): cap in-flight
+        # pulls so exposed transfers never stack — None = unlimited,
+        # the pre-fit behavior
+        depth = getattr(self.residency, "prefetch_depth", None)
         for sid, bundle in sorted(self._swapped.items(),
                                   key=lambda kv: kv[1].priority):
+            if depth is not None and len(self._prefetching) >= depth:
+                break
             if free_slots < 1:
                 break
             if q_min is not None and q_min < bundle.priority:

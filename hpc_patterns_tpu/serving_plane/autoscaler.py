@@ -57,6 +57,7 @@ from __future__ import annotations
 import time
 from collections import deque
 from dataclasses import dataclass, field
+from dataclasses import fields as dataclasses_fields
 
 import numpy as np
 
@@ -111,6 +112,25 @@ class AutoscalerPolicy:
     down_attainment: float = 0.98
     cooldown_rounds: int = 4
     window: int = 8
+
+    @classmethod
+    def from_fitted(cls, fitted, **overrides) -> "AutoscalerPolicy":
+        """A policy from an autofit ``FittedConfig``: the fitted
+        ``autoscaler`` section's hysteresis bands (picked by replaying
+        the recorded attainment/queue trajectory through this very
+        controller offline and keeping the non-flapping candidate) —
+        defaults where the config has no trajectory. Keyword overrides
+        win over the fit (deployment clamps like ``max_replicas``
+        stay the operator's)."""
+        from hpc_patterns_tpu.harness import autofit as autofitlib
+
+        fitted = autofitlib.validate_fitted(fitted)
+        section = fitted.get("autoscaler") or {}
+        kw = {f.name: section[f.name]
+              for f in dataclasses_fields(cls)
+              if f.name in section}
+        kw.update(overrides)
+        return cls(**kw)
 
     def __post_init__(self):
         if not 1 <= self.min_replicas <= self.max_replicas:

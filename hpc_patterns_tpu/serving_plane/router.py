@@ -151,6 +151,21 @@ def _round_robin(plane, prompt_len, max_new):
     return r
 
 
+def _weighted(plane, prompt_len, max_new):
+    # autofit's fitted capacity shares: route toward the replica with
+    # the most fitted weight per unit of present pressure. A replica
+    # the fit never saw gets weight 1.0 (neutral), so a fresh spin-up
+    # is routable immediately.
+    cand = _eligible(plane, prompt_len, max_new)
+    if not cand:
+        return None
+    return max(cand, key=lambda r: (
+        plane.placement_weights.get(r.name, 1.0)
+        / (1.0 + r.engine.queue_depth),
+        r.engine.free_page_count,
+        -plane.replicas.index(r)))
+
+
 PLACEMENT_POLICIES = {
     "least_loaded": _least_loaded,
     "round_robin": _round_robin,
@@ -158,6 +173,9 @@ PLACEMENT_POLICIES = {
     # prefill-capable replicas, so in a disaggregated plane the
     # least-loaded pick IS the prefill-decode policy
     "prefill_decode": _least_loaded,
+    # per-replica weights fitted from a prior run's busy/queue rollups
+    # (harness/autofit.py) — plane.placement_weights holds them
+    "weighted": _weighted,
 }
 
 
@@ -173,7 +191,8 @@ class ServingPlane:
     """
 
     def __init__(self, replicas, *, policy: str = "least_loaded",
-                 slo: dict | None = None, emit=None):
+                 slo: dict | None = None, emit=None,
+                 placement_weights: dict | None = None):
         self.replicas = list(replicas)
         if not self.replicas:
             raise ValueError("need at least one replica")
@@ -186,6 +205,11 @@ class ServingPlane:
                 f"(known: {', '.join(sorted(PLACEMENT_POLICIES))})")
         self.policy_name = policy
         self.policy = PLACEMENT_POLICIES[policy]
+        #: fitted per-replica capacity shares ({name: weight}), read by
+        #: the "weighted" policy; empty = neutral
+        self.placement_weights = {
+            str(k): float(v)
+            for k, v in (placement_weights or {}).items()}
         self.disaggregated = any(r.role != "both" for r in self.replicas)
         if self.disaggregated:
             if not any(r.can_prefill for r in self.replicas):
@@ -234,6 +258,15 @@ class ServingPlane:
         #: efficiency metric that rewards holding the SLO with FEWER
         #: replica-rounds, not just holding it
         self.replica_rounds = 0
+        #: the sliding-window SLO-attainment signal (satellite of the
+        #: autofit round): every request judged as it RESOLVES, the
+        #: window fraction emitted per plane round as a gauge, a trace
+        #: counter, and a ``kind=plane_attainment`` record — the one
+        #: signal the in-process autoscaler, the launched router, and
+        #: the offline autofit threshold fitter all consume
+        self.attain_window = slolib.AttainmentWindow()
+        self._plane_rounds = 0
+        self._attain_emitted = (0, 0)  # (judged, attained) last round
 
     # -- construction checks ----------------------------------------------
 
@@ -266,6 +299,24 @@ class ServingPlane:
                     raise ValueError(
                         f"replica {r.name!r}: migration needs identical "
                         "model config and page_size across replicas")
+
+    @classmethod
+    def from_fitted(cls, replicas, fitted, *, slo: dict | None = None,
+                    emit=None, **kw):
+        """Build a plane from an autofit ``FittedConfig``: the fitted
+        ``placement`` section picks the policy (``weighted`` routes by
+        the fitted per-replica capacity shares) — a config with no
+        placement signal yields the default least-loaded plane. An
+        explicit ``policy=`` kwarg wins over the fit."""
+        from hpc_patterns_tpu.harness import autofit as autofitlib
+
+        fitted = autofitlib.validate_fitted(fitted)
+        section = fitted.get("placement") or {}
+        if "policy" not in kw and section.get("policy"):
+            kw["policy"] = section["policy"]
+        if "placement_weights" not in kw and section.get("weights"):
+            kw["placement_weights"] = section["weights"]
+        return cls(replicas, slo=slo, emit=emit, **kw)
 
     # -- submission (the router transport) ---------------------------------
 
@@ -509,12 +560,64 @@ class ServingPlane:
             ps["outcome"] = es.get("outcome") or "ok"
             ps["preemptions"] = int(es.get("preemptions") or 0)
             ps["replica"] = r.name
+            self._judge_window(ps)
             # the recovery record resolves with the request (death
             # recovery only ever reads UNRESOLVED rows): a long-lived
             # plane must not grow one prompt array per served request
             self._requests.pop(sid, None)
             n += 1
         return n
+
+    def _judge_window(self, ps: dict) -> None:
+        """Fold one RESOLVED stats row (served or shed) into the
+        sliding attainment window — at resolution time, so the window
+        tracks recent service quality rather than the end-of-run
+        average."""
+        if self.slo is None:
+            return
+        target = self.slo.get(int(ps.get("priority") or 0),
+                              slolib.SLOTarget())
+        self.attain_window.judge(ps, target)
+
+    def _emit_attainment(self) -> None:
+        """The per-round sliding-window SLO-attainment gauge: one
+        number in three mediums (metrics gauge, trace counter, RunLog
+        record), emitted from the SAME window the elastic controller
+        reads — so autofit's offline threshold replay sees exactly the
+        trajectory the live autoscaler saw."""
+        if self.slo is None:
+            return
+        self._plane_rounds += 1
+        snap = self.attain_window.snapshot()
+        judged, attained = (self.attain_window.judged,
+                            self.attain_window.attained)
+        judged_round = judged - self._attain_emitted[0]
+        attained_round = attained - self._attain_emitted[1]
+        self._attain_emitted = (judged, attained)
+        queued = sum(r.engine.queue_depth for r in self.replicas
+                     if r.alive)
+        active = sum(1 for r in self.replicas if r.alive
+                     for s in r.engine._slots if s.active)
+        live = sum(1 for r in self.replicas
+                   if r.alive and not r.draining)
+        m = metricslib.get_metrics()
+        if m.enabled and snap["overall"] is not None:
+            m.gauge("plane.attainment").set(snap["overall"])
+            for prio, frac in snap["per_class"].items():
+                m.gauge(f"plane.attainment.p{prio}").set(frac)
+        rec = tracelib.active()
+        if rec is not None and snap["overall"] is not None:
+            rec.counter("plane.attainment", {
+                "overall": snap["overall"],
+                **{f"p{prio}": frac
+                   for prio, frac in snap["per_class"].items()}})
+        self._emit(kind="plane_attainment", round=self._plane_rounds,
+                   overall=snap["overall"],
+                   per_class={str(p): f
+                              for p, f in snap["per_class"].items()},
+                   window_n=snap["n"], judged_round=judged_round,
+                   attained_round=attained_round, queued=queued,
+                   active=active, replicas=live)
 
     def _update_gauges(self) -> None:
         m = metricslib.get_metrics()
@@ -597,6 +700,7 @@ class ServingPlane:
             return
         ps["outcome"] = "shed"
         ps["t_finish"] = time.perf_counter()
+        self._judge_window(ps)  # a shed never attains — it counts
         self.finished[sid] = np.zeros((0,), np.int32)
         self._requests.pop(sid, None)  # resolved: recovery never
         if on_death:                   # reads it again
@@ -713,6 +817,7 @@ class ServingPlane:
                 self.replica_rounds += 1
                 progressed |= self._collect_finished(r) > 0
             self._update_gauges()
+            self._emit_attainment()
             progressed |= self._autoscale_round()
             if not progressed and not pending_arrivals:
                 queued = {r.name: r.engine.queue_depth

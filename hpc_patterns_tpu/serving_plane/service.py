@@ -50,7 +50,9 @@ from pathlib import Path
 
 from hpc_patterns_tpu.analysis import runtime as analysis_runtime
 from hpc_patterns_tpu.harness import chaos as chaoslib
+from hpc_patterns_tpu.harness import metrics as metricslib
 from hpc_patterns_tpu.harness import slo as slolib
+from hpc_patterns_tpu.harness import trace as tracelib
 
 
 class ReplicaDead(Exception):
@@ -716,13 +718,27 @@ class PlaneRouter:
 
     def __init__(self, handles: list[ReplicaHandle], *,
                  policy: str = "least_loaded", slo_targets=None,
-                 emit=None):
+                 emit=None, placement_weights: dict | None = None):
         if not handles:
             raise ValueError("no replicas")
         self.handles = handles
         self.policy = policy
+        #: fitted per-replica capacity shares keyed by str(rank) —
+        #: read by the "weighted" policy (harness/autofit.py); empty =
+        #: neutral (every replica weight 1.0)
+        self.placement_weights = {
+            str(k): float(v)
+            for k, v in (placement_weights or {}).items()}
         self.slo_targets = slo_targets or {}
         self._emit = emit or (lambda **kw: None)
+        #: the sliding-window SLO-attainment signal (the in-process
+        #: plane's satellite, mirrored here so the LAUNCHED plane feeds
+        #: the same ``kind=plane_attainment`` trajectory to autofit and
+        #: any future launched autoscaler): judged at resolution,
+        #: emitted once per router round
+        self.attain_window = slolib.AttainmentWindow()
+        self._plane_rounds = 0
+        self._attain_emitted = (0, 0)  # (judged, attained) last emit
         self.stats: dict[int, dict] = {}
         self.finished: dict[int, list[int]] = {}
         self.requests: dict[int, dict] = {}
@@ -743,6 +759,24 @@ class PlaneRouter:
         self.shed: list[int] = []
         self.last_slo: dict | None = None
 
+    @classmethod
+    def from_fitted(cls, handles, fitted, *, slo_targets=None,
+                    emit=None, **kw):
+        """A router from an autofit ``FittedConfig``: the fitted
+        ``placement`` section picks the policy and the per-replica
+        weights (keyed by rank in the launched plane) — defaults when
+        the config carries no placement signal. An explicit ``policy=``
+        kwarg wins over the fit."""
+        from hpc_patterns_tpu.harness import autofit as autofitlib
+
+        fitted = autofitlib.validate_fitted(fitted)
+        section = fitted.get("placement") or {}
+        if "policy" not in kw and section.get("policy"):
+            kw["policy"] = section["policy"]
+        if "placement_weights" not in kw and section.get("weights"):
+            kw["placement_weights"] = section["weights"]
+        return cls(handles, slo_targets=slo_targets, emit=emit, **kw)
+
     # -- placement ---------------------------------------------------------
 
     def _alive(self, pred=None):
@@ -756,6 +790,14 @@ class PlaneRouter:
             h = cand[self._rr % len(cand)]
             self._rr += 1
             return h
+        if self.policy == "weighted":
+            # the fitted capacity share per unit of present pressure —
+            # the launched twin of router.py's _weighted (a replica
+            # the fit never saw is neutral at 1.0)
+            return max(cand, key=lambda h: (
+                self.placement_weights.get(str(h.rank), 1.0)
+                / (1.0 + h.load["queue_depth"]),
+                h.load["free_pages"]))
         return max(cand, key=lambda h: (h.load["free_pages"],
                                         -h.load["queue_depth"]))
 
@@ -831,9 +873,54 @@ class PlaneRouter:
         rec = self.stats[rid]
         rec["outcome"] = "shed"
         rec["t_finish"] = time.perf_counter()
+        self._judge_window(rec)  # a shed never attains — it counts
         self.finished[rid] = []
         self.shed.append(rid)
         self._emit(kind="plane_shed", seq_id=rid)
+
+    def _judge_window(self, rec: dict) -> None:
+        """Fold one resolved stats row into the sliding attainment
+        window (a rank with no declared target judges trivially when
+        served — the signal still tracks sheds and queue health)."""
+        target = self.slo_targets.get(int(rec.get("priority") or 0),
+                                      slolib.SLOTarget())
+        self.attain_window.judge(rec, target)
+
+    def _emit_attainment(self) -> None:
+        """The per-round sliding-window SLO-attainment gauge of the
+        LAUNCHED plane — same window, same three mediums (metrics
+        gauge / trace counter / ``kind=plane_attainment`` record) as
+        the in-process plane, so autofit's threshold fitter replays
+        one trajectory format regardless of which plane recorded it."""
+        self._plane_rounds += 1
+        snap = self.attain_window.snapshot()
+        judged, attained = (self.attain_window.judged,
+                            self.attain_window.attained)
+        judged_round = judged - self._attain_emitted[0]
+        attained_round = attained - self._attain_emitted[1]
+        self._attain_emitted = (judged, attained)
+        alive = self._alive()
+        queued = sum(int(h.load.get("queue_depth") or 0)
+                     for h in alive)
+        active = sum(int(h.load.get("active") or 0) for h in alive)
+        m = metricslib.get_metrics()
+        if m.enabled and snap["overall"] is not None:
+            m.gauge("plane.attainment").set(snap["overall"])
+            for prio, frac in snap["per_class"].items():
+                m.gauge(f"plane.attainment.p{prio}").set(frac)
+        rec = tracelib.active()
+        if rec is not None and snap["overall"] is not None:
+            rec.counter("plane.attainment", {
+                "overall": snap["overall"],
+                **{f"p{prio}": frac
+                   for prio, frac in snap["per_class"].items()}})
+        self._emit(kind="plane_attainment", round=self._plane_rounds,
+                   overall=snap["overall"],
+                   per_class={str(p): f
+                              for p, f in snap["per_class"].items()},
+                   window_n=snap["n"], judged_round=judged_round,
+                   attained_round=attained_round, queued=queued,
+                   active=active, replicas=len(alive))
 
     # -- failure handling --------------------------------------------------
 
@@ -880,6 +967,7 @@ class PlaneRouter:
         rec["tokens"] = len(tokens)
         if rec["t_first"] is None and tokens:
             rec["t_first"] = rec["t_finish"]
+        self._judge_window(rec)
         self.finished[rid] = tokens
         self.progress.pop(rid, None)
         # the key checkpoint resolves with the request, like the
@@ -998,6 +1086,7 @@ class PlaneRouter:
                     continue
                 self._merge_round(h, reply)
             self._forward_bundles()
+            self._emit_attainment()
         for h in self._alive():
             try:
                 h.call({"op": "stop"})

@@ -159,6 +159,41 @@ def _poison_donated_serving(request):
         uninstall()
 
 
+# Every live compiled executable keeps its JIT'd code pages mapped, and
+# one full-suite process now compiles enough of them to exhaust the
+# kernel's per-process map budget (vm.max_map_count, default 65530):
+# the next mmap inside XLA's compiler fails and the process segfaults
+# in backend_compile — observed at ~65k maps, ~85% through the fast
+# tier, landing on whichever test happens to compile at that point.
+# Dropping the jit caches unmaps retired executables (measured: 200
+# small compiles cost ~600 maps; clear_caches + gc returns ~95% of
+# them), and the persistent compile cache above makes the few
+# re-compiles that follow cheap. The threshold leaves ~20k headroom —
+# more than the heaviest single module allocates — so the guard fires
+# at most a handful of times per run and never mid-test.
+_MAP_PRESSURE_LIMIT = 45_000
+
+
+def _memory_map_count() -> int:
+    try:
+        with open("/proc/self/maps") as f:
+            return sum(1 for _ in f)
+    except OSError:
+        # non-Linux (no /proc): guard disabled — the platforms this
+        # repo tests on are Linux, and macOS has no equivalent cap
+        return 0
+
+
+@pytest.fixture(autouse=True)
+def _jax_map_pressure_guard():
+    yield
+    if _memory_map_count() > _MAP_PRESSURE_LIMIT:
+        import gc
+
+        jax.clear_caches()
+        gc.collect()
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     from hpc_patterns_tpu import topology

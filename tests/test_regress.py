@@ -272,3 +272,58 @@ class TestElasticSpecs:
         g = by_path["detail.goodput_per_replica_round"]
         assert g.gated and g.direction == "higher"
         assert g.abs_slack == 0.0
+
+
+class TestAutofitSpecs:
+    def test_autofit_keys_are_gated_and_covered(self):
+        # the round-16 gated keys exist, gate in the right direction,
+        # and — being gated — ride the coverage-loss warning like
+        # every other headline (a capture that silently drops
+        # fitted_goodput_tok_s warns instead of reading as green)
+        by_path = {s.path: s for s in regress.SPECS}
+        g = by_path["detail.fitted_goodput_tok_s"]
+        assert g.gated and g.direction == "higher"
+        assert g.abs_slack == 0.0
+        f = by_path["detail.autofit_gain_frac"]
+        assert f.gated and f.direction == "higher"
+        # the gain is a RATIO of two wall clocks: scheduler noise must
+        # not fail the gate (a wrong fitter fails the row's own strict
+        # padding assertion instead, surfacing as coverage loss here)
+        assert f.abs_slack >= 0.03
+
+
+class TestStrictCoverage:
+    _round = TestGateMechanics._round
+
+    def test_default_mode_warns_and_passes(self, tmp_path, capsys):
+        # without the flag, coverage loss stays a warning: exit 0,
+        # WARNING on stderr (the pre-existing contract)
+        files = [self._round(tmp_path, 1, 2.0,
+                             detail={"serving_tok_s": 100.0}),
+                 self._round(tmp_path, 2, 2.0)]
+        assert regress.main(files) == 0
+        captured = capsys.readouterr()
+        assert "WARNING" in captured.err
+        assert "coverage loss" in captured.err
+
+    def test_strict_mode_fails_on_coverage_loss(self, tmp_path, capsys):
+        # --strict-coverage turns the same loss into a failure: exit 1
+        # with ERROR severity naming the key and the round that last
+        # carried it
+        files = [self._round(tmp_path, 1, 2.0,
+                             detail={"serving_tok_s": 100.0}),
+                 self._round(tmp_path, 2, 2.0)]
+        assert regress.main(files + ["--strict-coverage"]) == 1
+        captured = capsys.readouterr()
+        assert "ERROR" in captured.err
+        assert "serving_tok_s" in captured.err
+        assert "r1" in captured.err
+
+    def test_strict_mode_passes_when_coverage_holds(self, tmp_path,
+                                                    capsys):
+        files = [self._round(tmp_path, 1, 2.0,
+                             detail={"serving_tok_s": 100.0}),
+                 self._round(tmp_path, 2, 2.0,
+                             detail={"serving_tok_s": 110.0})]
+        assert regress.main(files + ["--strict-coverage"]) == 0
+        assert capsys.readouterr().err == ""
