@@ -159,7 +159,15 @@ def _serving_plane_detail() -> dict:
     - ``kv_migration_overlap_frac``: the measured fraction of each
       KV-handoff window hidden under the destination replica's
       in-flight decode chunk in the disaggregated 1-prefill/1-decode
-      shape (serving_plane/router.py).
+      shape (serving_plane/router.py);
+    - ``dma_migration_overlap_frac`` / ``migration_bytes_per_round``
+      (round 17): the same overlap measured on a second 1p/1d run
+      whose handoffs ride the fused paired remote-DMA kernel
+      (``ServingPlane(migration="dma")``, comm/migration_dma.py) —
+      the router reports the DMA ledger only for bundles that
+      actually rode the kernel, so a silent fallback shows up as
+      coverage loss here, not as a wrong number — and the dispatched
+      KV-payload bytes per plane round on that run.
 
     Runs ``bench_serving.run_plane``'s smoke shape (oracle-exact on
     every leg before any number is returned). Returns {} when there is
@@ -173,12 +181,20 @@ def _serving_plane_detail() -> dict:
 
     r = bench_serving.run_plane(**bench_serving.plane_smoke_config(),
                                 quiet=True)
-    return {
+    rd = bench_serving.run_plane(**bench_serving.plane_smoke_config(),
+                                 migration="dma", quiet=True)
+    detail = {
         "plane_goodput_tok_s": round(r["plane_goodput_tok_s"], 1),
         "kv_migration_overlap_frac": round(
             r["kv_migration_overlap_frac"], 4),
         "plane_migrations": r["migrations"],
+        "migration_bytes_per_round": round(
+            rd["migration_bytes_per_round"], 1),
     }
+    if rd["dma_migration_overlap_frac"] is not None:
+        detail["dma_migration_overlap_frac"] = round(
+            rd["dma_migration_overlap_frac"], 4)
+    return detail
 
 
 def _offload_detail() -> dict:

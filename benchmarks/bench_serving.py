@@ -3,6 +3,7 @@
 Usage: python benchmarks/bench_serving.py [--n=N] [--slots=S] [--chunk=K]
          [--mix=0|1] [--buckets=auto|none|16,32,...] [--overlap=0|1]
          [--temp=T] [--topk=K] [--smoke] [--scenario] [--plane]
+         [--migration=dma|device_put|wire]
          [--elastic] [--offload] [--shared] [--quant] [--fit]
          [--autofit=config.json] [--fit-out=PATH]
          [--kv-dtype=f32|bf16|int8|fp8] [--quant-weights]
@@ -84,9 +85,15 @@ overlapped behind the decode chunk (``hpc_patterns_tpu/
 serving_plane/``). The bucket ladder is FIT from the stream's
 observed prompt lengths (``serving.fit_bucket_ladder``) and must beat
 the default ladder's expected padding; every leg is oracle-exact
-(migrated rows included) before any number prints. Headline keys
-``plane_goodput_tok_s`` / ``kv_migration_overlap_frac`` are captured
-into ``bench.py``'s detail and gated by ``harness/regress.py``.
+(migrated rows included) before any number prints.
+``--migration dma|device_put|wire`` picks the 1p/1d leg's KV-handoff
+transport (round 17): ``dma`` routes bundles over the fused paired
+remote-DMA kernel (``comm/migration_dma.py``, forces per-device
+replica placement), ``wire`` round-trips the socket byte codec.
+Headline keys ``plane_goodput_tok_s`` / ``kv_migration_overlap_frac``
+/ ``dma_migration_overlap_frac`` / ``migration_bytes_per_round`` are
+captured into ``bench.py``'s detail and gated by
+``harness/regress.py``.
 
 ``--scenario``: the ROBUSTNESS row (round 8) — an OPEN-loop two-class
 stream (harness/loadgen.py) served under page pressure that forces
@@ -1492,9 +1499,25 @@ def plane_full_config(on_tpu: bool):
                 place_on_devices=on_tpu)
 
 
+def devices_share_host(devs) -> bool:
+    """True when the 'distinct' devices replicas were placed on are
+    virtual shards of ONE host (the CPU mesh under
+    ``--xla_force_host_platform_device_count``): placement still pins
+    arrays and exercises the real transfer paths, but every copy
+    crosses the same memory — so cross-device timings on such a mesh
+    are mechanism proofs, not speed claims. The plane row prints this
+    loudly instead of letting the CPU numbers impersonate a chip
+    result (tests/test_bench_serving.py pins the detection)."""
+    if len(devs) < 2:
+        return False
+    return (all(d.platform == "cpu" for d in devs)
+            or len({d.process_index for d in devs}) == 1
+            and all(d.platform == "cpu" for d in devs))
+
+
 def run_plane(*, cfg, params, n, slots, chunk, page_size, prompt_len,
               max_budget, rate_rps, seed=11, place_on_devices=False,
-              quiet=False):
+              migration="device_put", quiet=False):
     """The serving-plane row: one open-loop stream through three legs
     — single engine (the baseline), a homogeneous 2-replica plane
     (router + least-loaded placement), and the disaggregated
@@ -1503,14 +1526,26 @@ def run_plane(*, cfg, params, n, slots, chunk, page_size, prompt_len,
     standalone ``paged_generate`` before any number is believed; the
     ladder is FIT from the stream's observed prompt lengths
     (serving.fit_bucket_ladder — the round-6 autotuning item) and must
-    beat the default ladder's expected padding. Reports
-    ``plane_goodput_tok_s`` (2-replica leg) and
-    ``kv_migration_overlap_frac`` (1p/1d leg), the two keys
-    ``bench.py`` captures and ``harness/regress.py`` gates."""
+    beat the default ladder's expected padding.
+
+    ``migration`` selects the 1p/1d leg's KV-handoff transport
+    (``--migration dma|device_put|wire``, router.MIGRATION_TRANSPORTS);
+    ``dma`` forces per-device placement (the paired remote-DMA kernel
+    needs distinct chips) even when ``place_on_devices`` is off.
+    Reports ``plane_goodput_tok_s`` (2-replica leg),
+    ``kv_migration_overlap_frac``, ``dma_migration_overlap_frac`` and
+    ``migration_bytes_per_round`` (1p/1d leg) — the keys ``bench.py``
+    captures and ``harness/regress.py`` gates."""
     from hpc_patterns_tpu.serving_plane.router import (
+        MIGRATION_TRANSPORTS,
         Replica,
         ServingPlane,
     )
+
+    if migration not in MIGRATION_TRANSPORTS:
+        raise SystemExit(
+            f"--migration {migration!r} not in "
+            f"{'/'.join(MIGRATION_TRANSPORTS)}")
 
     out = print if not quiet else (lambda *a, **k: None)
     rng = np.random.RandomState(13)
@@ -1577,14 +1612,20 @@ def run_plane(*, cfg, params, n, slots, chunk, page_size, prompt_len,
         got = eng.run(arrivals=arrivals())
         return got, eng
 
+    # the DMA tier needs replicas on distinct devices — force
+    # placement for it even on the CPU mesh (mechanism proof there;
+    # devices_share_host() below keeps the wording honest)
+    placed = place_on_devices or migration == "dma"
+
     def run_plane_leg(roles):
-        devs = jax.devices() if place_on_devices else []
+        devs = jax.devices() if placed else []
         replicas = []
         for i, role in enumerate(roles):
             dev = devs[i % len(devs)] if len(devs) > 1 else None
             replicas.append(Replica(mk_engine(dev), name=f"r{i}",
                                     role=role, device=dev))
-        plane = ServingPlane(replicas, slo=targets)
+        plane = ServingPlane(replicas, slo=targets,
+                             migration=migration)
         got = plane.run(arrivals=arrivals())
         return got, plane
 
@@ -1626,6 +1667,8 @@ def run_plane(*, cfg, params, n, slots, chunk, page_size, prompt_len,
     tot2 = plane2.last_slo["total"]
     totd = disagg.last_slo["total"]
     overlap = disagg.last_kv_migration_overlap_frac or 0.0
+    dma_overlap = disagg.last_dma_migration_overlap_frac
+    shared_host = placed and devices_share_host(jax.devices())
     result = {
         "t_single": t_single, "t_plane": t_plane, "t_disagg": t_disagg,
         "single_goodput_tok_s": tot1["goodput_tok_s"]
@@ -1635,6 +1678,13 @@ def run_plane(*, cfg, params, n, slots, chunk, page_size, prompt_len,
         "disagg_goodput_tok_s": totd["goodput_tok_s"]
         * disagg._serve_s / t_disagg if t_disagg > 0 else 0.0,
         "kv_migration_overlap_frac": overlap,
+        # DMA-tier-only overlap: None unless bundles actually rode the
+        # paired kernel — a fallback cannot impersonate the DMA tier
+        "dma_migration_overlap_frac": dma_overlap,
+        "migration_bytes_per_round": disagg.migration_bytes_per_round,
+        "migration_transport": migration,
+        "migration_transports": dict(disagg.migration_transports),
+        "placement_shares_host": shared_host,
         "migrations": disagg.migrations,
         "shed": tot2["shed"] + totd["shed"],
         "ladder_fit": list(buckets),
@@ -1654,7 +1704,15 @@ def run_plane(*, cfg, params, n, slots, chunk, page_size, prompt_len,
     out(f"  1p/1d     : {t_disagg:.3f}s  "
         f"{result['disagg_goodput_tok_s']:,.1f} goodput tok/s  "
         f"migrations {disagg.migrations}  "
-        f"kv overlap {overlap:.1%}")
+        f"kv overlap {overlap:.1%}  transport {migration} "
+        f"{dict(disagg.migration_transports)}  "
+        + (f"dma overlap {dma_overlap:.1%}  "
+           if dma_overlap is not None else "")
+        + f"{result['migration_bytes_per_round']:,.0f} B/round")
+    if shared_host:
+        out("  NOTE: replicas placed on VIRTUAL devices sharing one "
+            "host — cross-device copies are mechanism proofs, not "
+            "bandwidth numbers (run the chip leg for those)")
     out("  oracle-exact on all three legs (migrated rows included)")
     return result
 
@@ -1950,12 +2008,26 @@ def main():
                    fit_out=arg("fit-out", None, str))
         return
     if arg("plane", False, bool):
+        mig = arg("migration", "device_put", str)
         if arg("smoke", False, bool):
-            run_plane(**_apply_kv_dtype(plane_smoke_config(),
-                                        kv_dtype))
+            conf = _apply_kv_dtype(plane_smoke_config(), kv_dtype)
         else:
-            run_plane(**_apply_kv_dtype(plane_full_config(
-                jax.default_backend() == "tpu"), kv_dtype))
+            conf = _apply_kv_dtype(plane_full_config(
+                jax.default_backend() == "tpu"), kv_dtype)
+        # --trace/--log ride the apps' shared instrumentation session
+        # (reground step 7e: the DMA-migration row traced, so the
+        # plane.kv_migration windows + algorithm="dma" fingerprints
+        # land in a flight-recorder snapshot like the launched tier's)
+        from types import SimpleNamespace
+
+        from hpc_patterns_tpu.apps import common
+
+        ns = SimpleNamespace(trace=arg("trace", False, bool),
+                             metrics=False,
+                             log=arg("log", None, str),
+                             trace_capacity=None)
+        common.run_instrumented(
+            lambda _a: (run_plane(**conf, migration=mig), 0)[1], ns)
         return
     if arg("scenario", False, bool):
         if arg("smoke", False, bool):

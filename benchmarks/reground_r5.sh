@@ -249,6 +249,23 @@ run "launched serving plane (1p/1d, real engines)" env JAX_PLATFORMS=cpu \
   python -m hpc_patterns_tpu.apps.plane_app --roles prefill,decode \
   --rdv "${LOG%.log}_plane_rdv" --requests 8 --trace
 
+# 7e. DEVICE-SIDE KV migration (round 17): the 1p/1d plane with the
+#     handoff routed over the fused paired remote-DMA kernel
+#     (comm/migration_dma.py) instead of device_put — per-device
+#     replica placement is forced, every served stream stays
+#     oracle-exact, and the traced run's plane.kv_migration windows
+#     carry algorithm="dma" in the schedule chain (the fingerprint
+#     that catches a silent fallback; a fallback also warns loudly in
+#     the row output). On the chip this is the ICI replica-to-replica
+#     copy the transport tier exists for; the kind=trace snapshot in
+#     the jsonl exports to Perfetto via `python -m
+#     hpc_patterns_tpu.harness.trace` and the row prints
+#     dma_migration_overlap_frac / migration_bytes_per_round — step
+#     8's gate holds both from BENCH_rNN.json.
+run "serving plane 1p/1d over DMA migration (traced)" \
+  python benchmarks/bench_serving.py --plane --migration=dma --trace \
+  "--log=${LOG%.log}_plane_dma.jsonl"
+
 # 8. final health check + REGRESSION GATE: capture the closing round,
 #    write it as the next BENCH_rNN.json, and compare its headline
 #    numbers against the best prior round (harness.regress) — a

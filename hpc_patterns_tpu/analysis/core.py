@@ -164,6 +164,19 @@ DEFAULT_DISPATCH_CRITICAL = frozenset({
     "ladder_from",
     "_judge_window",
     "_emit_attainment",
+    # the round-17 device-side migration paths: the fused DMA pair's
+    # send dispatch and the recv-side landing check both run inside
+    # the router's handoff window, behind the destination's in-flight
+    # decode chunk — a host readback there (e.g. np.asarray of a page
+    # slab to "verify" the copy) drags the payload back through the
+    # host and forfeits exactly the device-to-device hop the tier
+    # exists to buy. The transport resolution (_resolve_transport)
+    # rides the same dispatch. (service.py's same-named socket
+    # functions are pure host wire work and stay clean by
+    # construction.)
+    "send_migration",
+    "recv_migration",
+    "_resolve_transport",
 })
 
 # rule names are kebab-case identifiers; anything after the last name

@@ -629,7 +629,9 @@ _COLLECTIVE_NAMES = frozenset({
     "fused_allreduce", "allreduce_into", "allgather_matmul",
     "fused_permute", "fused_ring_shift",
     # serving-plane KV handoff (serving_plane/migration.py,
-    # service.py): a migration has two parties that must agree on the
+    # service.py, and the fused DMA pair in comm/migration_dma.py —
+    # one send_migration/recv_migration protocol, three transports): a
+    # migration has two parties that must agree on the
     # (kv_migration, seq) schedule — rank-dependent control flow
     # around the transfer entry points is the same desync shape the
     # runtime verifier catches at merge time

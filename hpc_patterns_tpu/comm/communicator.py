@@ -204,19 +204,53 @@ class Communicator:
 
     # -- collectives over (size, n) arrays --------------------------------
 
-    def _check_fusable(self, algorithm: str) -> None:
-        """The fused kernels bind LOGICAL neighbor ids (and jax's
-        dma-discharge interpreter binds a single named axis), so the
-        fused route requires the communicator's mesh to be one-axis.
-        Fail here with the route named rather than deep inside a
-        kernel trace."""
-        if algorithm == "fused" and len(self.mesh.axis_names) > 1:
-            raise ValueError(
-                f"algorithm 'fused' needs a single-axis mesh (logical "
-                f"ring ids); this communicator's mesh has axes "
-                f"{tuple(self.mesh.axis_names)} — use a host-driven "
-                "algorithm here, or a dedicated 1-axis mesh"
-            )
+    def _fused_route(self):
+        """``(mesh, axis, geometry)`` the fused kernels run over. The
+        kernels bind LOGICAL neighbor ids under ONE named axis (jax's
+        dma-discharge rule and the logical id space are both
+        single-axis), so a 1-D mesh runs as-is (geometry ``None`` —
+        the identity ring) and a multi-axis mesh runs over its FLAT
+        1-axis view with ring neighbors computed from mesh coordinates
+        (:func:`fused.mesh_ring_geometry` — stride = product of the
+        axis sizes to the right). Ranks sharing a ring position are
+        replicas: each reduces its own copy, bitwise-identically, and
+        :meth:`_fused_shmap` folds one representative row back per
+        position."""
+        if len(self.mesh.axis_names) == 1:
+            return self.mesh, self.axis, None
+        return (fused.flat_mesh(self.mesh), fused.FLAT_AXIS,
+                fused.mesh_ring_geometry(self.mesh, self.axis))
+
+    def _fused_shmap(self, mk_per_rank, *xs):
+        """One jitted closure around a fused kernel over operands
+        ``xs`` (each with the leading rank dim). ``mk_per_rank`` gets
+        ``(axis, geometry)`` and returns the rank-local function —
+        single-axis meshes shard_map it directly, multi-axis meshes
+        take-expand every operand onto the flat mesh (row ``f`` =
+        ring-position row ``pos(f)``), run the kernel, and fold the
+        representative rows back, all inside the same jit (one compile
+        per cache key, same as the 1-D route)."""
+        mesh, axis, g = self._fused_route()
+        per_rank = mk_per_rank(axis, g)
+        specs = tuple(P(axis, *([None] * (jnp.ndim(v) - 1)))
+                      for v in xs)
+        mapped = shard_map(
+            per_rank, mesh=mesh,
+            in_specs=specs if len(specs) > 1 else specs[0],
+            out_specs=specs[0])
+        if g is None:
+            return jax.jit(mapped)
+        idx = jnp.asarray(g.positions())
+        sel = jnp.asarray(g.ring_ids())
+        shardings = tuple(NamedSharding(mesh, s) for s in specs)
+
+        def run(*vals):
+            expanded = [
+                jax.device_put(jnp.take(v, idx, axis=0), s)
+                for v, s in zip(vals, shardings)]
+            return jnp.take(mapped(*expanded), sel, axis=0)
+
+        return jax.jit(run)
 
     def allreduce(self, x, algorithm: Algorithm = "collective") -> jax.Array:
         """Elementwise sum across ranks; every row of the result holds the
@@ -224,29 +258,43 @@ class Communicator:
         ``"collective"``; the :173-182 hand ring for ``"ring"``;
         two-phase bandwidth-optimal ring for ``"ring_chunked"``; the
         same two-phase ring as device-initiated in-kernel remote DMA
-        for ``"fused"`` — comm/fused.py, docs/comm.md)."""
-        impl = _ALLREDUCE[algorithm]
-        self._check_fusable(algorithm)
+        for ``"fused"`` — comm/fused.py, docs/comm.md; on a multi-axis
+        mesh the fused route runs over the flat view with
+        coordinate-computed neighbors, :meth:`_fused_route`)."""
         seq = self._next_seq()
         _inject_chaos(seq)
         with metricslib.span("comm.allreduce", algorithm=algorithm):
+            if algorithm == "fused":
+                result = self.jit_allreduce(x, algorithm)(x)
+            else:
+                impl = _ALLREDUCE[algorithm]
+                result = self._shmap(
+                    lambda local: impl(local, self.axis), x)(x)
             return _ready_in_span(
-                self._shmap(lambda local: impl(local, self.axis), x)(x),
+                result,
                 op=f"allreduce.{algorithm}", seq=seq, axis=self.axis,
                 algorithm=algorithm)
 
     def jit_allreduce(self, x, algorithm: Algorithm = "collective"):
         """The compiled allreduce closure for ``x``'s shape — what a
         benchmark should time (compile excluded per SURVEY.md §7(d)).
-        Cached per (shape, dtype, algorithm): an algorithm sweep at one
-        shape gets one traced closure per algorithm instead of
-        re-tracing whichever it asked for last."""
-        self._check_fusable(algorithm)
-        key = (jnp.shape(x), str(jnp.result_type(x)), algorithm)
+        Cached per (shape, dtype, axis, algorithm): an algorithm sweep
+        at one shape gets one traced closure per algorithm instead of
+        re-tracing whichever it asked for last (the axis key is
+        redundant per instance — the communicator binds one axis — but
+        pins the multi-axis sweep discipline the fused-route tests
+        assert)."""
+        key = (jnp.shape(x), str(jnp.result_type(x)), self.axis,
+               algorithm)
         fn = self._jit_allreduce_cache.get(key)
         if fn is None:
-            impl = _ALLREDUCE[algorithm]
-            fn = self._shmap(lambda local: impl(local, self.axis), x)
+            if algorithm == "fused":
+                fn = self._fused_shmap(
+                    lambda axis, g: (lambda local: fused.fused_allreduce(
+                        local, axis, geometry=g)), x)
+            else:
+                impl = _ALLREDUCE[algorithm]
+                fn = self._shmap(lambda local: impl(local, self.axis), x)
             self._jit_allreduce_cache[key] = fn
         return fn
 
@@ -327,24 +375,29 @@ class Communicator:
             raise ValueError(
                 f"allgather_matmul algorithm {algorithm!r} not in "
                 "('fused', 'collective')")
-        self._check_fusable(algorithm)
         if jnp.ndim(x) != 3 or jnp.ndim(w) != 3:
             raise ValueError(
                 f"want x (size, m, k) and w (size, k, n), got "
                 f"{jnp.shape(x)} and {jnp.shape(w)}")
         key = (jnp.shape(x), str(jnp.result_type(x)), jnp.shape(w),
-               str(jnp.result_type(w)), algorithm)
+               str(jnp.result_type(w)), self.axis, algorithm)
         fn = self._agmm_cache.get(key)
         if fn is None:
-            impl = (fused.allgather_matmul if algorithm == "fused"
-                    else fused.allgather_matmul_reference)
+            if algorithm == "fused":
+                fn = self._fused_shmap(
+                    lambda axis, g: (
+                        lambda xl, wl: fused.allgather_matmul(
+                            xl[0], wl[0], axis, geometry=g)[None]),
+                    x, w)
+            else:
+                def per_rank(xl, wl):
+                    return fused.allgather_matmul_reference(
+                        xl[0], wl[0], self.axis)[None]
 
-            def per_rank(xl, wl):
-                return impl(xl[0], wl[0], self.axis)[None]
-
-            spec = P(self.axis, None, None)
-            fn = jax.jit(shard_map(per_rank, mesh=self.mesh,
-                                   in_specs=(spec, spec), out_specs=spec))
+                spec = P(self.axis, None, None)
+                fn = jax.jit(shard_map(per_rank, mesh=self.mesh,
+                                       in_specs=(spec, spec),
+                                       out_specs=spec))
             self._agmm_cache[key] = fn
         seq = self._next_seq()
         _inject_chaos(seq)
@@ -369,15 +422,11 @@ class Communicator:
             raise ValueError(
                 f"allreduce_into algorithm {algorithm!r} not in "
                 "('fused', 'collective')")
-        self._check_fusable(algorithm)
         row_bias = None
         if bias is not None:
             row_bias = jnp.asarray(bias, jnp.result_type(x))
 
-        def per_rank(local):
-            if algorithm == "fused":
-                return fused.allreduce_into(
-                    local, self.axis, bias=row_bias, epilogue=epilogue)
+        def per_rank_collective(local):
             out = collectives.allreduce(local, self.axis, "sum")
             if row_bias is not None:
                 out = out + row_bias
@@ -391,8 +440,16 @@ class Communicator:
         seq = self._next_seq()
         _inject_chaos(seq)
         with metricslib.span("comm.allreduce_into", algorithm=algorithm):
+            if algorithm == "fused":
+                fn = self._fused_shmap(
+                    lambda axis, g: (lambda local: fused.allreduce_into(
+                        local, axis, bias=row_bias, epilogue=epilogue,
+                        geometry=g)), x)
+                result = fn(x)
+            else:
+                result = self._shmap(per_rank_collective, x)(x)
             return _ready_in_span(
-                self._shmap(per_rank, x)(x),
+                result,
                 op=f"allreduce_into.{algorithm}", seq=seq,
                 axis=self.axis, algorithm=algorithm)
 

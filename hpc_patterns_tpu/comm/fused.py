@@ -51,12 +51,22 @@ Execution modes:
   time) and the strict-semaphore shim the parity battery runs under
   (``analysis/runtime.strict_semaphores``, trace time); what stays
   hardware-empirical is Mosaic's lowering and real DMA rates — the
-  documented reground step. jax's discharge rule supports a single
-  named mesh axis only; the Communicator enforces that at routing
-  time.
+  documented reground step.
 - **compiled** (TPU): the same kernel lowered by Mosaic; neighbor ids
-  ride ``DeviceIdType.LOGICAL`` scalars (mesh position == logical id on
-  the 1-D meshes this layer binds).
+  ride ``DeviceIdType.LOGICAL`` scalars.
+
+Multi-axis meshes: jax's dma-discharge rule (and the LOGICAL id space)
+supports a single named mesh axis, so the kernels always run under a
+shard_map binding ONE flat axis. A ring over one axis of a multi-axis
+mesh is expressed as a :class:`RingGeometry` — the flat-id stride
+between consecutive ring positions, computed from the mesh coordinates
+(row-major device order, so axis ``i`` of sizes ``s`` has stride
+``prod(s[i+1:])``). Every kernel takes ``geometry=`` and computes its
+logical neighbor as ``flat_id + (next_pos - pos) * stride``; ranks that
+share a ring position are replicas and run the identical schedule (the
+parity suite pins their outputs bitwise-equal). The Communicator routes
+multi-axis meshes through :func:`mesh_ring_geometry` / ``flat_mesh``
+automatically — docs/comm.md walks the neighbor math.
 
 VMEM footprint: the whole local shard plus ~2x its chunk working set
 must fit VMEM (no grid streaming yet — benchmark shapes to ~MBs). The
@@ -66,6 +76,7 @@ slices the pad back off; zero padding is combine-neutral for sum.
 
 from __future__ import annotations
 
+import dataclasses
 import math
 from typing import Callable, Sequence
 
@@ -98,6 +109,124 @@ _TPU_LANE = 128
 #: 16 MB default scoped limit at benchmark shapes; well under the
 #: physical budget (the fused-MLP kernels use the same override)
 _VMEM_LIMIT = 100 * 1024 * 1024
+
+#: the single flat axis name every multi-axis routing binds (module
+#: docstring): shard_map over ``flat_mesh(mesh)`` with this axis, ring
+#: neighbors computed by :class:`RingGeometry` from mesh coordinates
+FLAT_AXIS = "_fusedflat"
+
+
+@dataclasses.dataclass(frozen=True)
+class RingGeometry:
+    """How one logical ring sits inside a flat device ordering.
+
+    ``axis`` is the (single) mesh axis name the kernel's shard_map
+    binds; ``size`` the ring length; ``stride`` the flat-id distance
+    between consecutive ring positions; ``total`` the flat mesh size.
+    The identity geometry (``stride=1, total=size``) is the classic
+    1-D mesh and reproduces the original kernels' traces exactly; a
+    multi-axis ring (from :func:`mesh_ring_geometry`) has
+    ``total > size`` and every ``total // size`` flat ranks sharing a
+    ring position compute identical (replicated) results."""
+
+    axis: str
+    size: int
+    stride: int = 1
+    total: int | None = None
+
+    def __post_init__(self):
+        if self.total is None:
+            object.__setattr__(self, "total", self.size * self.stride)
+        if self.size < 1 or self.stride < 1:
+            raise ValueError(f"degenerate ring geometry: {self}")
+        if self.total % (self.size * self.stride):
+            raise ValueError(
+                f"flat size {self.total} not divisible by "
+                f"size*stride = {self.size * self.stride}: {self}")
+
+    @property
+    def identity(self) -> bool:
+        return self.stride == 1 and self.total == self.size
+
+    # -- in-kernel (traced) --------------------------------------------
+    def me_and_right(self):
+        """(ring position, right-neighbor FLAT id) — computed INSIDE
+        the kernel body (a pallas kernel cannot capture traced values
+        from the caller; ``lax.axis_index`` is legal in-kernel). The
+        position indexes chunks; the flat id feeds ``device_id``."""
+        me = lax.axis_index(self.axis)
+        if self.identity:
+            return me, lax.rem(me + 1, self.size)
+        pos = lax.rem(me // self.stride, self.size)
+        dst = me + (lax.rem(pos + 1, self.size) - pos) * self.stride
+        return pos, dst
+
+    def flat_index(self):
+        """The rank's FLAT id (traced, in-kernel) — indexes per-rank
+        SMEM tables like :func:`fused_permute`'s destination table."""
+        return lax.axis_index(self.axis)
+
+    # -- host-side (static) --------------------------------------------
+    def positions(self) -> list[int]:
+        """Ring position of every flat id — the take-index that expands
+        a ``(size, ...)`` global array to its ``(total, ...)``
+        replicated layout."""
+        return [(f // self.stride) % self.size for f in range(self.total)]
+
+    def ring_ids(self) -> list[int]:
+        """One representative flat id per ring position (the fold-back
+        selection after a flat-mesh collective)."""
+        return [p * self.stride for p in range(self.size)]
+
+    def flat_dst_table(self, dst_by_pos: Sequence[int]) -> list[int]:
+        """Expand a position-level permutation destination table to
+        flat ids: each flat rank sends to the SAME-replica rank of its
+        position's destination."""
+        out = []
+        for f in range(self.total):
+            pos = (f // self.stride) % self.size
+            out.append(f + (int(dst_by_pos[pos]) - pos) * self.stride)
+        return out
+
+
+def mesh_ring_geometry(mesh, axis: str) -> RingGeometry:
+    """The :class:`RingGeometry` of ring ``axis`` inside ``mesh``'s
+    row-major flat device order: stride = product of the axis sizes to
+    its RIGHT (``mesh.devices`` is C-ordered), bound under
+    :data:`FLAT_AXIS` on :func:`flat_mesh`."""
+    names = list(mesh.axis_names)
+    if axis not in names:
+        raise ValueError(f"axis {axis!r} not in mesh axes {names}")
+    sizes = [int(mesh.shape[a]) for a in names]
+    i = names.index(axis)
+    stride = int(math.prod(sizes[i + 1:]))
+    return RingGeometry(axis=FLAT_AXIS, size=sizes[i], stride=stride,
+                        total=int(math.prod(sizes)))
+
+
+def flat_mesh(mesh):
+    """``mesh`` re-expressed as a 1-D mesh over :data:`FLAT_AXIS` in
+    the same (row-major) device order — the mesh the multi-axis fused
+    route shard_maps over."""
+    from jax.sharding import Mesh
+
+    return Mesh(mesh.devices.flatten(), (FLAT_AXIS,))
+
+
+def _resolve_geometry(axis: str, geometry: RingGeometry | None, *,
+                      shift: int = 1) -> RingGeometry:
+    """Default (``geometry=None``) is the identity ring over ``axis``
+    — the original single-axis behavior, ring size validated exactly
+    like before. An explicit geometry carries a static size, so the
+    same pair-list sanitizer runs on ring positions."""
+    if geometry is None:
+        return RingGeometry(axis=axis, size=_ring_size(axis, shift=shift))
+    if geometry.axis != axis:
+        raise ValueError(
+            f"geometry axis {geometry.axis!r} != bound axis {axis!r}")
+    ring.check_permutation(ring._ring_perm(geometry.size, shift),
+                           geometry.size)
+    return geometry
 
 
 def _check_op(op: str) -> None:
@@ -146,14 +275,6 @@ def _ring_size(axis: str, *, shift: int = 1) -> int:
     return size
 
 
-def _me_and_right(axis: str, size: int):
-    """(me, right-neighbor) — computed INSIDE the kernel body (a
-    pallas kernel cannot capture traced values from the caller; axis
-    names are static and ``lax.axis_index`` is legal in-kernel)."""
-    me = lax.axis_index(axis)
-    return me, lax.rem(me + 1, size)
-
-
 def _remote_copy(src, dst, send_sem, recv_sem, device_id):
     """One device-initiated neighbor hop. Scalar LOGICAL ids: identical
     lowering on Mosaic (returned as-is) and under the dma-discharge
@@ -171,7 +292,8 @@ def _remote_copy(src, dst, send_sem, recv_sem, device_id):
 
 
 def fused_permute(x, axis: str, perm, *, interpret: bool | None = None,
-                  collective_id: int | None = None):
+                  collective_id: int | None = None,
+                  geometry: RingGeometry | None = None):
     """``lax.ppermute`` with the transfer issued by the device: rank
     ``s`` DMAs its shard straight into rank ``d``'s buffer for every
     ``(s, d)`` in ``perm``. The pair list passes
@@ -183,10 +305,17 @@ def fused_permute(x, axis: str, perm, *, interpret: bool | None = None,
     kernels share barrier state. Pass an id from
     :func:`ops.tiling.collective_id` (never a hand-picked integer —
     pallaslint flags magic ids); None takes this kernel's registered
-    default."""
+    default. ``geometry``: a multi-axis ring (``perm`` is over ring
+    POSITIONS; every replica rank of a position sends to the matching
+    replica of the destination position)."""
     if collective_id is None:
         collective_id = _registered_collective_id("comm.fused.permute")
-    size = ring.axis_size(axis)
+    g = (geometry if geometry is not None
+         else RingGeometry(axis=axis, size=ring.axis_size(axis)))
+    if g.axis != axis:
+        raise ValueError(
+            f"geometry axis {g.axis!r} != bound axis {axis!r}")
+    size = g.size
     perm = [(int(s), int(d)) for s, d in perm]
     ring.check_permutation(perm, size)
     if interpret is None:
@@ -200,10 +329,11 @@ def fused_permute(x, axis: str, perm, *, interpret: bool | None = None,
     shape = x.shape
     x2 = x.reshape(max(1, math.prod(shape[:-1]) if len(shape) > 1 else 1),
                    shape[-1] if shape else 1)
-    dsts = jnp.asarray(dst_table, jnp.int32).reshape(size, 1)
+    dsts = jnp.asarray(g.flat_dst_table(dst_table),
+                       jnp.int32).reshape(g.total, 1)
 
     def kernel(dst_ref, x_ref, o_ref, send_sem, recv_sem):
-        me = lax.axis_index(axis)
+        me = g.flat_index()
         dma = _remote_copy(x_ref, o_ref, send_sem, recv_sem,
                            dst_ref[me, 0])
         dma.start()
@@ -225,14 +355,15 @@ def fused_permute(x, axis: str, perm, *, interpret: bool | None = None,
 
 def fused_ring_shift(x, axis: str, shift: int = 1, *,
                      interpret: bool | None = None,
-                     collective_id: int | None = None):
+                     collective_id: int | None = None,
+                     geometry: RingGeometry | None = None):
     """Device-initiated :func:`ring.ring_shift`: rank r's shard lands on
     rank ``(r + shift) % size`` via one in-kernel remote DMA."""
-    size = ring.axis_size(axis)
+    size = geometry.size if geometry is not None else ring.axis_size(axis)
     perm = ring._ring_perm(size, shift)
     ring.check_permutation(perm, size)
     return fused_permute(x, axis, perm, interpret=interpret,
-                         collective_id=collective_id)
+                         collective_id=collective_id, geometry=geometry)
 
 
 # ---------------------------------------------------------------------------
@@ -255,18 +386,22 @@ def _epilogue_write(o_ref, b_ref, epilogue, chunk_idx, cn, value):
 
 def fused_allreduce(x, axis: str, *, op: str = "sum",
                     bias=None, epilogue: Callable | None = None,
-                    interpret: bool | None = None):
+                    interpret: bool | None = None,
+                    geometry: RingGeometry | None = None):
     """Ring allreduce(sum) with the schedule run inside one Pallas
     kernel (module docstring). Rank-local: call inside ``shard_map``
     over ``axis``. Bitwise-equal to
     ``ring.ring_allreduce_chunked`` over the :func:`ring_layout`-padded
     array (the parity suite's oracle). ``bias``/``epilogue`` fuse a
     reduction consumer into the gather phase — see
-    :func:`allreduce_into`."""
+    :func:`allreduce_into`. ``geometry``: run the ring over one axis of
+    a multi-axis mesh (replica ranks reduce redundantly, bitwise-equal
+    — the Communicator's multi-axis route)."""
     _check_op(op)
     if interpret is None:
         interpret = default_interpret()
-    size = _ring_size(axis)
+    g = _resolve_geometry(axis, geometry)
+    size = g.size
     shape = x.shape
     m, n, cn, n_pad = ring_layout(shape, size, interpret=interpret)
     if size == 1:
@@ -296,7 +431,7 @@ def fused_allreduce(x, axis: str, *, op: str = "sum",
             scratch = refs[2:]
         (rs_recv, sendbuf, ag_recv, rs_recv_sem, send_sem,
          ag_recv_sem, ag_send_sem) = scratch
-        me, dst = _me_and_right(axis, size)
+        me, dst = g.me_and_right()
 
         def chunk(j):
             return x_ref[:, pl.ds(j * cn, cn)]
@@ -391,7 +526,8 @@ def fused_allreduce(x, axis: str, *, op: str = "sum",
 
 def allreduce_into(x, axis: str, *, bias=None,
                    epilogue: Callable | None = None,
-                   interpret: bool | None = None):
+                   interpret: bool | None = None,
+                   geometry: RingGeometry | None = None):
     """Allreduce with its consumer fused into the gather phase: each
     reduced chunk gets ``epilogue(chunk + bias)`` applied AS THE DMA
     LANDS — the reduction's consumer (a bias add, an activation) costs
@@ -399,7 +535,7 @@ def allreduce_into(x, axis: str, *, bias=None,
     (chunkwise application is asserted byte-equal to whole-array
     application by the parity suite)."""
     return fused_allreduce(x, axis, bias=bias, epilogue=epilogue,
-                           interpret=interpret)
+                           interpret=interpret, geometry=geometry)
 
 
 # ---------------------------------------------------------------------------
@@ -407,7 +543,8 @@ def allreduce_into(x, axis: str, *, bias=None,
 # ---------------------------------------------------------------------------
 
 
-def allgather_matmul(x, w, axis: str, *, interpret: bool | None = None):
+def allgather_matmul(x, w, axis: str, *, interpret: bool | None = None,
+                     geometry: RingGeometry | None = None):
     """``all_gather(x) @ w`` with the gather ring inside the kernel:
     at step ``s`` the shard that just arrived is forwarded to the next
     neighbor and THEN multiplied against the local weight panel — the
@@ -422,7 +559,8 @@ def allgather_matmul(x, w, axis: str, *, interpret: bool | None = None):
         )
     if interpret is None:
         interpret = default_interpret()
-    size = _ring_size(axis)
+    g = _resolve_geometry(axis, geometry)
+    size = g.size
     m, k = x.shape
     n = w.shape[1]
     if size == 1:
@@ -430,7 +568,7 @@ def allgather_matmul(x, w, axis: str, *, interpret: bool | None = None):
                        ).astype(x.dtype)
 
     def kernel(x_ref, w_ref, o_ref, buf, send_sem, recv_sem):
-        me, dst = _me_and_right(axis, size)
+        me, dst = g.me_and_right()
 
         def tile(block, j):
             o_ref[pl.ds(j * m, m), :] = jnp.dot(

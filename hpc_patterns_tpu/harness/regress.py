@@ -115,6 +115,27 @@ SPECS: tuple[MetricSpec, ...] = (
     MetricSpec("detail.plane_goodput_tok_s", "higher"),
     MetricSpec("detail.kv_migration_overlap_frac", "higher",
                abs_slack=0.10),
+    # the device-side migration tier (round 17): the overlap fraction
+    # measured ONLY over bundles that rode the fused paired remote-DMA
+    # kernel (ServingPlane(migration="dma") — the router's DMA ledger
+    # is None when nothing did, so a silent fallback to device_put
+    # reads as coverage loss here, never as a passing number measured
+    # on the wrong transport). Same cold-start wobble as the other
+    # overlap fractions, same wider absolute slack. Bytes-per-round is
+    # the dataplane pressure the tier carries — transport-invariant
+    # workload geometry, so its band is tight: a bundle that silently
+    # grows (a scale pool duplicated, a payload staged twice) regresses
+    # here even when the wall clock forgives it.
+    MetricSpec("detail.dma_migration_overlap_frac", "higher",
+               abs_slack=0.10),
+    # the Σ-bytes numerator is exact; the per-round denominator wobbles
+    # with scheduler timing (a fast box drains the stream in fewer
+    # rounds and the ratio RISES) — the absolute slack covers roughly
+    # one round's worth of smoke-shape payload on top of the relative
+    # band so only a real payload-size change (not a round-count
+    # wobble) trips the gate
+    MetricSpec("detail.migration_bytes_per_round", "lower",
+               abs_slack=2048),
     # the tiered-memory row (bench_serving --offload, round 11):
     # constrained-HBM goodput is the SLO-attained tok/s of an engine
     # serving a working set ~2x its HBM pool through the residency

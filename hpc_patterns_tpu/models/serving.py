@@ -369,6 +369,13 @@ class MigrationBundle:
     #: with a warm prefix cache may resolve to its own shared pages
     #: instead of installing the payload — byte-exact either way
     prefix_len: int = 0
+    #: how the payload reached (or will reach) the installing replica:
+    #: "local" (never left the exporting engine), "device_put" (host
+    #: -staged cross-device copy), "dma" (the fused remote-DMA pair,
+    #: comm/migration_dma.py), "wire" (the socket codec). The router
+    #: fingerprints this into the collective schedule's
+    #: ``kv_migration`` entries as the ``algorithm`` field
+    transport: str = "local"
 
 
 @dataclass

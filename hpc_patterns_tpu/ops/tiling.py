@@ -37,6 +37,10 @@ _COLLECTIVE_IDS: dict[str, int] = {
     "comm.fused.allgather_matmul": 2,
     "parallel.ring_attention.kshift": 3,
     "parallel.ring_attention.vshift": 4,
+    # the serving plane's device-side KV handoff (comm/migration_dma):
+    # seeded so the exchange kernel's wire id is stable across hosts
+    # from day one, like the original five
+    "comm.fused.migration": 5,
 }
 
 #: new ids live in [_ID_FLOOR, _ID_FLOOR + _ID_SPAN): above the seeded

@@ -53,7 +53,8 @@ def migrate_pages(bundle: MigrationBundle, device=None) -> MigrationBundle:
         name: tuple(jax.device_put(a, device) for a in arrs)
         for name, arrs in bundle.pages_payload.items()
     }
-    return replace(bundle, pages_payload=payload)
+    return replace(bundle, pages_payload=payload,
+                   transport="device_put")
 
 
 # ---------------------------------------------------------------------------
@@ -123,6 +124,7 @@ def bundle_to_wire(bundle: MigrationBundle) -> dict:
         # either way — docs/prefix_cache.md)
         "rung": int(bundle.rung),
         "prefix_len": int(bundle.prefix_len),
+        "transport": str(bundle.transport),
     }
 
 
@@ -152,4 +154,6 @@ def bundle_from_wire(wire: dict) -> MigrationBundle:
         seq=int(wire.get("seq", -1)),
         rung=int(wire.get("rung", 0)),
         prefix_len=int(wire.get("prefix_len", 0)),
+        # pre-transport-field artifacts crossed a socket by definition
+        transport=str(wire.get("transport", "wire")),
     )

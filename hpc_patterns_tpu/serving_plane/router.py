@@ -28,9 +28,12 @@ The migration pipeline per plane round (the overlap discipline):
    (``service_round(decode=False)``): bucket-padded prefill + first
    token, no decode chunk ever;
 2. rows whose first token resolved are EXPORTED and their transfer is
-   DISPATCHED toward the chosen decode replica
-   (``migration.migrate_pages`` — async ``device_put``), before that
-   replica's decode chunk of the round;
+   DISPATCHED toward the chosen decode replica over the plane's
+   transport tier (``migration=`` kwarg, see MIGRATION_TRANSPORTS:
+   the fused remote-DMA pair of ``comm/migration_dma.py``, the
+   ``migration.migrate_pages`` async ``device_put``, or the socket
+   codec's byte round-trip), before that replica's decode chunk of
+   the round;
 3. the decode replica's round dispatches its chunk FIRST, then
    installs arrived bundles BEHIND it (``service_round``'s
    ``pre_collect`` hook → ``install_migration``), exactly like
@@ -51,27 +54,48 @@ ends share one chain; the launched plane records one chain per side).
 spent under an in-flight decode chunk on the DESTINATION replica,
 over Σ window time — the measured proof that the handoff hid behind
 compute (gated via ``detail.kv_migration_overlap_frac``).
+``dma_migration_overlap_frac`` is the same ratio restricted to
+bundles that actually rode the DMA tier (None when none did — a
+fallback can't impersonate the kernel path), and
+``migration_bytes_per_round`` pins the dataplane pressure the tier
+carries; both are regress-gated (``harness/regress.py``).
 """
 
 from __future__ import annotations
 
 import os
 import time
-from collections import deque
+import warnings
+from collections import Counter, deque
 from contextlib import nullcontext
 
 import numpy as np
 
 from hpc_patterns_tpu.analysis import runtime as analysis_runtime
+from hpc_patterns_tpu.comm import migration_dma
 from hpc_patterns_tpu.harness import chaos as chaoslib
 from hpc_patterns_tpu.harness import metrics as metricslib
 from hpc_patterns_tpu.harness import slo as slolib
 from hpc_patterns_tpu.harness import trace as tracelib
 from hpc_patterns_tpu.models.serving import EngineCore, fit_bucket_ladder
-from hpc_patterns_tpu.serving_plane.migration import migrate_pages
+from hpc_patterns_tpu.serving_plane.migration import (
+    bundle_from_wire,
+    bundle_to_wire,
+    migrate_pages,
+)
 from hpc_patterns_tpu.serving_plane.service import migration_track
 
 ROLES = ("both", "prefill", "decode")
+
+#: KV-handoff transport tiers, fastest first — the fallback ladder
+#: :meth:`ServingPlane._resolve_transport` walks LOUDLY (a warning +
+#: a ``plane_transport_fallback`` emit per distinct reason):
+#: ``dma`` = the paired remote-DMA kernel (comm/migration_dma.py,
+#: chips must be ICI-reachable), ``device_put`` = host-staged
+#: cross-device copy (today's default; a device-less pair degrades
+#: further to the in-place passthrough, recorded as ``local``),
+#: ``wire`` = the socket codec's byte round-trip (the DCN analog).
+MIGRATION_TRANSPORTS = ("dma", "device_put", "wire")
 
 
 class Replica:
@@ -192,7 +216,8 @@ class ServingPlane:
 
     def __init__(self, replicas, *, policy: str = "least_loaded",
                  slo: dict | None = None, emit=None,
-                 placement_weights: dict | None = None):
+                 placement_weights: dict | None = None,
+                 migration: str = "device_put"):
         self.replicas = list(replicas)
         if not self.replicas:
             raise ValueError("need at least one replica")
@@ -235,13 +260,42 @@ class ServingPlane:
         self._rr = 0
         self._mig_seq = 0
         self.migrations = 0
+        if migration not in MIGRATION_TRANSPORTS:
+            raise ValueError(
+                f"unknown migration transport {migration!r} "
+                f"(known: {', '.join(MIGRATION_TRANSPORTS)})")
+        #: requested KV-handoff transport tier (module constant
+        #: MIGRATION_TRANSPORTS); per-bundle resolution may fall back
+        #: down the ladder — loudly — when a pair can't serve it
+        self.migration = migration
+        #: bundles dispatched per RESOLVED transport ("dma" /
+        #: "device_put" / "local" / "wire") — what the oracle tests
+        #: assert so a silent fallback can't impersonate the DMA tier
+        self.migration_transports: Counter = Counter()
+        #: distinct (requested, actual, reason) fallbacks already
+        #: warned about — loud once, not once per bundle
+        self._transport_warned: set = set()
+        #: Σ payload bytes over dispatched bundles (all transports) —
+        #: the numerator of ``migration_bytes_per_round``
+        self.migration_bytes = 0
         #: open migration windows: seq -> (t_trace_dispatch, t_host0)
         self._mig_open: dict[int, tuple[float, float]] = {}
         self._mig_overlap_s = 0.0
         self._mig_total_s = 0.0
+        # the DMA tier's own overlap ledger (subset of the above):
+        # ``dma_migration_overlap_frac`` gates on it, so a plane that
+        # silently fell back to device_put reports None, not a number
+        # measured on the wrong transport
+        self._dma_overlap_s = 0.0
+        self._dma_total_s = 0.0
         self._serve_s = 0.0
+        #: total plane rounds served (unconditional — unlike
+        #: ``_plane_rounds``, which only counts SLO-judged rounds):
+        #: the denominator of ``migration_bytes_per_round``
+        self.rounds_total = 0
         self.last_slo: dict | None = None
         self.last_kv_migration_overlap_frac: float | None = None
+        self.last_dma_migration_overlap_frac: float | None = None
         #: original submit kwargs per request — what replica-death
         #: recovery needs (the elastic plane rebuilds a queued request
         #: or a resume from them; the static plane's shed path only
@@ -435,17 +489,76 @@ class ServingPlane:
             n += 1
         return n
 
+    def _transport_fallback(self, requested: str, actual: str,
+                            reason: str) -> None:
+        """The LOUD half of the fallback ladder: a warning (once per
+        distinct reason), an emit record, and a counter — a plane
+        asked for DMA must never quietly serve on a slower tier."""
+        key = (requested, actual, reason)
+        if key not in self._transport_warned:
+            self._transport_warned.add(key)
+            warnings.warn(
+                f"plane migration transport fell back "
+                f"{requested} -> {actual}: {reason}",
+                RuntimeWarning, stacklevel=3)
+        self._emit(kind="plane_transport_fallback", requested=requested,
+                   actual=actual, reason=reason)
+        m = metricslib.get_metrics()
+        if m.enabled:
+            m.counter("plane.transport_fallbacks").inc()
+
+    def _resolve_transport(self, src: Replica,
+                           dst: Replica) -> tuple[str, str]:
+        """(transport to attempt, fallback reason so far) for one
+        (src, dst) pair under the plane's requested tier. ``dma``
+        demands an ICI-reachable device pair
+        (:func:`migration_dma.dma_reachable`); the per-bundle VMEM
+        gate inside ``send_migration`` may still drop an oversized
+        slab to ``device_put`` at dispatch time."""
+        if self.migration == "dma":
+            ok, reason = migration_dma.dma_reachable(src.device,
+                                                     dst.device)
+            if ok:
+                return "dma", ""
+            self._transport_fallback("dma", "device_put", reason)
+            return "device_put", reason
+        return self.migration, ""
+
     def _dispatch_migration(self, src: Replica, slot: int,
                             dst: Replica) -> None:
         """Export + transfer dispatch (dispatch-only: the gather and
         the cross-device copy enqueue async; the deliberate cursor
         snapshot inside export_migration is the chunk-boundary resume
         contract). Opens the migration's device-track window and
-        fingerprints it into the schedule chain."""
+        fingerprints it into the schedule chain — with the RESOLVED
+        transport as the entry's ``algorithm``, so a fallback is
+        visible in the verifier's chain, not just the logs."""
         bundle = src.engine.export_migration(slot)
         bundle.seq = self._mig_seq
         self._mig_seq += 1
-        bundle = migrate_pages(bundle, dst.device)
+        self.migration_bytes += sum(
+            int(a.nbytes) for arrs in bundle.pages_payload.values()
+            for a in arrs)
+        transport, _ = self._resolve_transport(src, dst)
+        if transport == "dma":
+            try:
+                bundle = migration_dma.send_migration(
+                    bundle, src.device, dst.device)
+            except migration_dma.MigrationDmaError as e:
+                self._transport_fallback("dma", "device_put", str(e))
+                transport = "device_put"
+        if transport == "device_put":
+            # dst.device None degrades further to the in-place
+            # passthrough; the bundle then says "local" truthfully
+            bundle = migrate_pages(bundle, dst.device)
+        elif transport == "wire":
+            # the byte codec round-trip IS the transport: the installed
+            # payload crossed the same encode/decode the socket plane
+            # ships, so the oracle covers the codec end to end
+            w = bundle_to_wire(bundle)
+            w["transport"] = "wire"
+            bundle = bundle_from_wire(w)
+        self.migration_transports[bundle.transport] += 1
         ps = self.stats.get(bundle.seq_id)
         if ps is not None and ps["t_first"] is None:
             ps["t_first"] = bundle.t_first
@@ -463,7 +576,7 @@ class ServingPlane:
             analysis_runtime.record_collective(
                 "kv_migration", bundle.seq,
                 shape=(bundle.n_pages, bundle.page_size), dtype=kdt,
-                axis="plane", algorithm="device")
+                axis="plane", algorithm=bundle.transport)
         self._mig_open[bundle.seq] = (t_disp, time.perf_counter())
         dst.pending_migrations.append(bundle)
         self._emit(kind="plane_migrate", seq=bundle.seq,
@@ -482,6 +595,11 @@ class ServingPlane:
         while r.pending_migrations and r.engine.migration_admissible(
                 r.pending_migrations[0].n_pages):
             b = r.pending_migrations.pop(0)
+            if b.transport == "dma":
+                # metadata-only landing check (device residency /
+                # chunk-shape sanity) — raises MigrationDmaError
+                # rather than scattering a misdelivered payload
+                migration_dma.recv_migration(b, r.device)
             r.engine.install_migration(b)
             installed.append((b, overlapped))
             self.migrations += 1
@@ -530,6 +648,9 @@ class ServingPlane:
                 for s, e in windows)
             self._mig_total_s += span
             self._mig_overlap_s += min(under_chunk, span)
+            if bundle.transport == "dma":
+                self._dma_total_s += span
+                self._dma_overlap_s += min(under_chunk, span)
             if rec is not None and t_disp:
                 rec.mark_complete(
                     "plane.kv_migration", t_disp,
@@ -787,6 +908,7 @@ class ServingPlane:
             if max_rounds is not None and rounds >= max_rounds:
                 break
             rounds += 1
+            self.rounds_total += 1
             progressed = False
             for r in self._round_order():
                 if not r.alive:
@@ -833,12 +955,20 @@ class ServingPlane:
         if self._mig_total_s > 0:
             self.last_kv_migration_overlap_frac = (
                 self._mig_overlap_s / self._mig_total_s)
+        if self._dma_total_s > 0:
+            self.last_dma_migration_overlap_frac = (
+                self._dma_overlap_s / self._dma_total_s)
         m = metricslib.get_metrics()
         if m.enabled:
             m.gauge("plane.migrations").set(self.migrations)
             if self.last_kv_migration_overlap_frac is not None:
                 m.gauge("plane.kv_migration_overlap_frac").set(
                     self.last_kv_migration_overlap_frac)
+            if self.last_dma_migration_overlap_frac is not None:
+                m.gauge("plane.dma_migration_overlap_frac").set(
+                    self.last_dma_migration_overlap_frac)
+            m.gauge("plane.migration_bytes_per_round").set(
+                self.migration_bytes_per_round)
         if self.slo is not None:
             self.last_slo = slolib.attainment(self.stats, self.slo,
                                               self._serve_s)
@@ -865,3 +995,13 @@ class ServingPlane:
         tot = self.last_slo["total"]
         good_tokens = tot["goodput_tok_s"] * self.last_slo["wall_s"]
         return good_tokens / self.replica_rounds
+
+    @property
+    def migration_bytes_per_round(self) -> float:
+        """Σ dispatched KV-payload bytes per plane round — the
+        dataplane-pressure headline the transport tier exists to hide:
+        the SAME bytes cross whichever transport resolved, so this
+        number is transport-invariant and regress-gated
+        (``detail.migration_bytes_per_round``) as a workload-shape
+        pin rather than a speed score. 0.0 before any round ran."""
+        return self.migration_bytes / max(1, self.rounds_total)

@@ -518,6 +518,10 @@ class RealAdapter(EngineAdapter):
                     keys[str(b.seq_id)] = [
                         int(v) for v in np.asarray(b.key, np.uint32)]
                 wire = bundle_to_wire(b)
+                # the socket IS the transport on this plane: stamp it
+                # at export so the receiver's install (and any replayed
+                # artifact) records how the payload traveled
+                wire["transport"] = "wire"
                 wire["payload_dtype"] = str(
                     b.pages_payload["k"][0].dtype)
                 record_export(wire, rec)

@@ -1,0 +1,54 @@
+"""Known-bad: the round-17 device-side-migration bug shapes,
+minimized. ``send_migration`` drags the payload through the host on
+the dispatch path — the exact staging the DMA tier exists to delete,
+stalling the destination's in-flight decode chunk behind a readback.
+``exchange_shared_landing_slot`` lands two semaphore families in ONE
+recv buffer: nothing orders the payload copy's completion against the
+ack copy's write, so the ack can clobber bytes the installer is still
+reading — the cross-family sibling of the PR 8 gather-slot race."""
+
+import numpy as np
+
+import jax
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _remote(src, dst, send, recv, dev):
+    return pltpu.make_async_remote_copy(
+        src_ref=src, dst_ref=dst, send_sem=send, recv_sem=recv,
+        device_id=dev, device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+
+def send_migration(bundle, dst_device):
+    """Host-staged 'device-side' migration: the np.asarray readback
+    synchronizes the source's queue and ships every page slab through
+    host memory before re-uploading it — device_put with extra steps,
+    on the one path that must stay dispatch-only."""
+    staged = [np.asarray(page) for page in bundle]  # EXPECT: host-sync-in-dispatch
+    return [jax.device_put(p, dst_device) for p in staged]
+
+
+def exchange_shared_landing_slot(x, axis, size):
+    """The migration pair with the ack riding the payload's landing
+    buffer: chunk 0's page copy arrives in recvbuf under the payload
+    semaphore family, then the ack DMA lands in the SAME buffer under
+    its own family — the installer's read of the pages races the ack's
+    write (dedicated per-purpose recv buffers are the discipline)."""
+
+    def kernel(x_ref, o_ref, recvbuf, pay_send, pay_recv, ack_send,
+               ack_sem):
+        me = lax.axis_index(axis)
+        dst = lax.rem(me + 1, size)
+        d = _remote(x_ref, recvbuf.at[0], pay_send.at[0],
+                    pay_recv.at[0], dst)
+        d.start()
+        d.wait()
+        a = _remote(x_ref, recvbuf.at[1], ack_send.at[0],
+                    ack_sem.at[0], dst)
+        a.start()  # EXPECT: dma-slot-reuse
+        a.wait()
+        o_ref[...] = recvbuf[0]
+
+    return pl.pallas_call(kernel, out_shape=x)(x)

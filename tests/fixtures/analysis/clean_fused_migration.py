@@ -1,0 +1,72 @@
+"""Known-clean: the migration pair's shipped discipline
+(``comm/migration_dma.py``): dispatch-only send/recv entry points that
+never read a device value back, and a chunked exchange kernel with a
+DEDICATED send/recv semaphore pair per chunk landing each chunk in its
+own output slice — all recvs awaited before the first send wait, every
+send drained before the kernel returns, collective id from the
+registry."""
+
+import jax
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hpc_patterns_tpu.ops.tiling import collective_id
+
+
+def _remote(src, dst, send, recv, dev):
+    return pltpu.make_async_remote_copy(
+        src_ref=src, dst_ref=dst, send_sem=send, recv_sem=recv,
+        device_id=dev, device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+
+def send_migration(bundle, dst_device):
+    """Dispatch-only: the payload arrays are re-homed by an ASYNC
+    transfer — no readback, nothing on the host path but metadata."""
+    return [jax.device_put(page, dst_device) for page in bundle]
+
+
+def recv_migration(bundle, device):
+    """Install-side acceptance: device METADATA checks only — the
+    landing check must not synchronize the decode replica's queue."""
+    for page in bundle:
+        if device not in page.devices():
+            raise RuntimeError("payload not resident on installer")
+    return bundle
+
+
+def chunked_exchange_dedicated_slots(x, n_pages, page_chunk, axis):
+    """The paired exchange: chunk c's DMA reads its own input slice,
+    lands in its own output slice, and signals its OWN send/recv
+    semaphore pair — no slot is ever reused across families, and the
+    recv-then-send drain order means no transfer outlives scratch."""
+    chunks = -(-n_pages // page_chunk)
+
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        me = lax.axis_index(axis)
+        dst = lax.rem(me + 1, 2)
+        dmas = []
+        for c in range(chunks):
+            lo = c * page_chunk
+            span = min(page_chunk, n_pages - lo)
+            d = _remote(x_ref.at[pl.ds(lo, span)],
+                        o_ref.at[pl.ds(lo, span)],
+                        send_sem.at[c], recv_sem.at[c], dst)
+            d.start()
+            dmas.append(d)
+        for d in dmas:
+            d.wait_recv()
+        for d in dmas:
+            d.wait_send()
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=x,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((chunks,)),
+                        pltpu.SemaphoreType.DMA((chunks,))],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True,
+            collective_id=collective_id("comm.fused.migration")),
+    )(x)

@@ -81,6 +81,42 @@ def test_smoke_plane_row_reports_goodput_and_migration_overlap():
         f"KV migration did not overlap the decode chunk: "
         f"{r['kv_migration_overlap_frac']:.1%}")
     assert r["expected_padding_fit"] <= r["expected_padding_default"]
+    # default transport: nothing rode (or claimed to ride) the DMA
+    # tier, and the host-sharing note is off (no device placement)
+    assert r["migration_transport"] == "device_put"
+    assert r["dma_migration_overlap_frac"] is None
+    assert r["placement_shares_host"] is False
+    assert r["migration_bytes_per_round"] > 0
+
+
+def test_smoke_plane_row_dma_transport_and_placement_note():
+    # the round-17 transport row: --migration dma routes every 1p/1d
+    # handoff over the fused paired remote-DMA kernel (per-device
+    # placement forced), stays oracle-exact (run_plane asserts it),
+    # reports the DMA-only overlap ledger, and — because the CPU
+    # mesh's devices are virtual shards of one host — says so loudly
+    # instead of letting the numbers impersonate a chip result
+    import jax
+
+    from benchmarks.bench_serving import (
+        devices_share_host,
+        plane_smoke_config,
+        run_plane,
+    )
+
+    r = run_plane(**plane_smoke_config(), migration="dma", quiet=True)
+    assert r["migration_transport"] == "dma"
+    # every bundle rode the kernel — no silent fallback
+    assert set(r["migration_transports"]) == {"dma"}
+    assert r["migration_transports"]["dma"] == r["migrations"]
+    assert r["dma_migration_overlap_frac"] is not None
+    assert r["migration_bytes_per_round"] > 0
+    # the satellite-4 pin: forced placement on the CPU mesh IS
+    # host-shared, and the result says so
+    assert devices_share_host(jax.devices()) is True
+    assert r["placement_shares_host"] is True
+    assert devices_share_host([]) is False
+    assert devices_share_host(jax.devices()[:1]) is False
 
 
 def test_smoke_offload_row_forces_eviction_and_reports_overlap():

@@ -280,16 +280,6 @@ class TestGuardsAndCaching:
         with pytest.raises(ValueError, match="prod"):
             fused.fused_allreduce(jnp.ones((2, 2)), "x", op="prod")
 
-    def test_multi_axis_mesh_refused(self):
-        from hpc_patterns_tpu import topology
-
-        c = Communicator(topology.make_mesh({"dp": 2, "tp": 4}), "tp")
-        with pytest.raises(ValueError, match="single-axis"):
-            c.allreduce(c.shard(np.ones((4, 8), np.float32)), "fused")
-        with pytest.raises(ValueError, match="single-axis"):
-            c.allgather_matmul(np.ones((4, 2, 4), np.float32),
-                               np.ones((4, 4, 4), np.float32))
-
     def test_jit_allreduce_one_compile_per_key(self, comm):
         """The satellite claim: sweeping algorithms at one shape holds
         ONE traced closure per (shape, dtype, algorithm) — repeated
@@ -311,6 +301,120 @@ class TestGuardsAndCaching:
         y = comm.shard(np.ones((WORLD, 16), np.float32))
         assert comm.jit_allreduce(y, "fused") is not fns["fused"]
         assert comm.jit_allreduce(x, "fused") is fns["fused"]
+
+
+# every factorization the 8-device mesh offers, paired with each of
+# its axes — the full (mesh, ring) product the multi-axis lift claims
+MULTIAXIS_CASES = [
+    pytest.param(axes, axis, id=f"{'x'.join(map(str, axes.values()))}-{axis}")
+    for axes in ({"a": 2, "b": 4}, {"a": 4, "b": 2},
+                 {"a": 2, "b": 2, "c": 2})
+    for axis in axes
+]
+
+
+def multiaxis_host_oracle(mesh, axis, x, n):
+    """:func:`host_ring_oracle` generalized to one axis of a
+    multi-axis mesh: the host two-phase ring runs on the REAL mesh
+    (XLA's discharge-free path has no single-axis restriction), padded
+    to the identical fused chunk layout."""
+    from jax.sharding import NamedSharding
+
+    size = mesh.shape[axis]
+    _, _, _, n_pad = fused.ring_layout((1, n), size, interpret=True)
+    xp = jnp.pad(jnp.asarray(x), ((0, 0), (0, n_pad - n)))
+    spec = P(axis, None)
+    fn = jax.jit(shard_map(
+        lambda l: ring.ring_allreduce_chunked(l, axis, scatter_axis=1),
+        mesh=mesh, in_specs=spec, out_specs=spec))
+    out = fn(jax.device_put(xp, NamedSharding(mesh, spec)))
+    return np.asarray(out)[:, :n]
+
+
+class TestMultiAxisFused:
+    """The multi-axis lift: the fused kernels run over one axis of a
+    2-D torus / multi-slice mesh via the flat-mesh route (neighbor ids
+    from mesh coordinates — fused.RingGeometry), bitwise-equal to the
+    host ring running natively on the multi-axis mesh."""
+
+    @pytest.mark.parametrize("axes,axis", MULTIAXIS_CASES)
+    def test_fused_allreduce_matches_host_ring(self, axes, axis):
+        from hpc_patterns_tpu import topology
+
+        mesh = topology.make_mesh(axes)
+        c = Communicator(mesh, axis)
+        x = rand(np.random.default_rng(c.size), c.size, 40, "float32")
+        got = np.asarray(c.allreduce(c.shard(x), "fused"))
+        np.testing.assert_array_equal(
+            got, multiaxis_host_oracle(mesh, axis, x, 40))
+
+    @pytest.mark.parametrize("axes,axis", MULTIAXIS_CASES)
+    def test_fused_ring_shift_matches_host_shift(self, axes, axis):
+        from jax.sharding import NamedSharding
+
+        from hpc_patterns_tpu import topology
+
+        mesh = topology.make_mesh(axes)
+        g = fused.mesh_ring_geometry(mesh, axis)
+        fm = fused.flat_mesh(mesh)
+        x = rand(np.random.default_rng(7), g.size, 24, "float32")
+
+        spec = P(fused.FLAT_AXIS, None)
+        fn = jax.jit(shard_map(
+            lambda l: fused.fused_ring_shift(l, fused.FLAT_AXIS,
+                                             geometry=g),
+            mesh=fm, in_specs=spec, out_specs=spec))
+        xf = jax.device_put(
+            jnp.take(jnp.asarray(x), jnp.asarray(g.positions()), axis=0),
+            NamedSharding(fm, spec))
+        full = np.asarray(fn(xf))
+
+        rspec = P(axis, None)
+        host = jax.jit(shard_map(
+            lambda l: ring.ring_shift(l, axis, 1),
+            mesh=mesh, in_specs=rspec, out_specs=rspec))
+        want = np.asarray(host(jax.device_put(
+            jnp.asarray(x), NamedSharding(mesh, rspec))))
+
+        np.testing.assert_array_equal(full[g.ring_ids()], want)
+        # replica discipline: every flat rank sharing a ring position
+        # computed the identical row, bit for bit
+        pos = g.positions()
+        for f in range(g.total):
+            np.testing.assert_array_equal(
+                full[f], full[pos[f] * g.stride])
+
+    def test_allgather_matmul_multiaxis_matches_reference(self):
+        from hpc_patterns_tpu import topology
+
+        mesh = topology.make_mesh({"a": 2, "b": 4})
+        c = Communicator(mesh, "b")
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(4, 2, 8)).astype(np.float32)
+        w = rng.normal(size=(4, 8, 4)).astype(np.float32)
+        got = np.asarray(c.allgather_matmul(x, w, "fused"))
+        ref = np.asarray(c.allgather_matmul(x, w, "collective"))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_jit_cache_one_compile_per_shape_dtype_axis(self):
+        """The sweep-discipline pin: on ONE multi-axis mesh, a
+        communicator per axis holds one compiled fused closure per
+        (shape, dtype, axis) — repeat calls hit the same wrapper and
+        its jit cache stays at 1, so an axis sweep never thrashes."""
+        from hpc_patterns_tpu import topology
+        from hpc_patterns_tpu.harness.trace import jit_cache_size
+
+        mesh = topology.make_mesh({"a": 2, "b": 4})
+        for axis in ("a", "b"):
+            c = Communicator(mesh, axis)
+            x = c.shard(np.ones((c.size, 32), np.float32))
+            f1 = c.jit_allreduce(x, "fused")
+            assert c.jit_allreduce(x, "fused") is f1, axis
+            jax.block_until_ready(f1(x))
+            jax.block_until_ready(f1(x))
+            assert jit_cache_size(f1, strict=True) == 1, axis
+            key = ((c.size, 32), "float32", axis, "fused")
+            assert key in c._jit_allreduce_cache, axis
 
 
 class TestScheduleFingerprints:

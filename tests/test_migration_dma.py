@@ -1,0 +1,117 @@
+"""Device-side KV migration (comm/migration_dma.py): the paired
+remote-DMA transport's own contracts, below the plane-level oracle in
+tests/test_serving_plane.py — reachability verdicts, the per-slab VMEM
+gate, byte-exact transfer with destination residency at every pool
+dtype, the install-side acceptance check, and the one-compile-per-
+geometry cache."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hpc_patterns_tpu.comm import migration_dma
+from hpc_patterns_tpu.comm.migration_dma import (
+    MigrationDmaError,
+    dma_reachable,
+    recv_migration,
+    send_migration,
+)
+from hpc_patterns_tpu.models import TransformerConfig, init_params
+from hpc_patterns_tpu.models.serving import EngineCore
+
+BASE = dict(vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq=64, dtype="float32")
+ENG = dict(slots=2, pool_pages=8, pages_per_seq=4, page_size=8,
+           chunk=2)
+
+
+def _bundle(device, **over):
+    """One exportable bundle with its engine pinned to ``device``."""
+    cfg = TransformerConfig(**{**BASE, **over})
+    with jax.default_device(device):
+        params = jax.device_put(init_params(jax.random.PRNGKey(0), cfg),
+                                device)
+        eng = EngineCore(params, cfg, **ENG)
+        eng.submit(np.arange(5, dtype=np.int32), 4)
+        eng.service_round(decode=False)
+        return eng.export_migration(eng.exportable_slots()[0])
+
+
+class TestReachability:
+    def test_verdicts(self):
+        d0, d1 = jax.devices()[:2]
+        assert dma_reachable(d0, d1) == (True, "")
+        ok, reason = dma_reachable(None, d1)
+        assert not ok and "no committed device" in reason
+        ok, reason = dma_reachable(d0, d0)
+        assert not ok and "share one device" in reason
+
+    def test_send_refuses_unreachable_pair(self):
+        d0 = jax.devices()[0]
+        b = _bundle(d0)
+        with pytest.raises(MigrationDmaError, match="not DMA-reachable"):
+            send_migration(b, d0, d0)
+
+
+class TestTransfer:
+    @pytest.mark.parametrize(
+        "over", [{}, {"dtype": "bfloat16"},
+                 {"kv_cache_dtype": "int8"}, {"kv_cache_dtype": "fp8"}],
+        ids=["f32", "bf16", "int8", "fp8"])
+    def test_payload_byte_exact_and_dst_resident(self, over):
+        # every payload array (quantized pools ship their scale pools
+        # as extra keys) arrives byte-identical AND committed to dst
+        d0, d1 = jax.devices()[:2]
+        b = _bundle(d0, **over)
+        out = send_migration(b, d0, d1)
+        assert out.transport == "dma"
+        assert set(out.pages_payload) == set(b.pages_payload)
+        for name, arrs in b.pages_payload.items():
+            for a, a2 in zip(arrs, out.pages_payload[name]):
+                assert a2.devices() == {d1}, f"{name} not on dst"
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(a2), err_msg=name)
+        # cursor/key metadata rides untouched
+        assert out.pos == b.pos and out.limit == b.limit
+        np.testing.assert_array_equal(np.asarray(out.key),
+                                      np.asarray(b.key))
+
+    def test_exchange_cache_one_entry_per_geometry(self):
+        d0, d1 = jax.devices()[:2]
+        migration_dma._XFER_CACHE.clear()
+        b = _bundle(d0)
+        send_migration(b, d0, d1)
+        n = len(migration_dma._XFER_CACHE)
+        assert n >= 1
+        b2 = _bundle(d0)
+        send_migration(b2, d0, d1)  # same pool geometry: all hits
+        assert len(migration_dma._XFER_CACHE) == n
+
+    def test_vmem_gate_refuses_oversized_slab(self):
+        d0, d1 = jax.devices()[:2]
+        big = jnp.zeros(
+            (1, migration_dma._VMEM_LIMIT // 8 + 16), jnp.float32)
+        with pytest.raises(MigrationDmaError, match="VMEM"):
+            migration_dma._transfer_array(
+                jax.device_put(big, d0), d0, d1,
+                page_chunk=migration_dma.PAGE_CHUNK, interpret=True)
+
+
+class TestRecvAcceptance:
+    def test_accepts_dma_bundle_on_dst(self):
+        d0, d1 = jax.devices()[:2]
+        out = send_migration(_bundle(d0), d0, d1)
+        assert recv_migration(out, d1) is out
+
+    def test_rejects_wrong_transport_and_wrong_device(self):
+        d0, d1, d2 = jax.devices()[:3]
+        b = _bundle(d0)
+        with pytest.raises(MigrationDmaError, match="transport"):
+            recv_migration(b, d1)  # never crossed the DMA pair
+        out = send_migration(b, d0, d1)
+        with pytest.raises(MigrationDmaError, match="not resident"):
+            recv_migration(out, d2)  # landed on d1, installer is d2
+        with pytest.raises(MigrationDmaError, match="no committed"):
+            recv_migration(out, None)

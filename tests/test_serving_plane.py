@@ -236,6 +236,130 @@ class TestRouterMechanics:
             _standalone(params, cfg, np.arange(5, dtype=np.int32), 3))
 
 
+def _pinned_plane(cfg, params, migration, eng_kw=None, n_reqs=4,
+                  seed=1):
+    """1 prefill + 1 decode replica pinned to DISTINCT devices (the
+    multi-chip serving shape on the CPU mesh) with the requested
+    KV-handoff transport, plus the request list they'll serve."""
+    d = jax.devices()[:2]
+    replicas = []
+    for i, role in enumerate(("prefill", "decode")):
+        with jax.default_device(d[i]):
+            p = jax.device_put(params, d[i])
+            eng = EngineCore(p, cfg, **{**ENG, **(eng_kw or {})})
+        replicas.append(Replica(eng, name=role[0], role=role,
+                                device=d[i]))
+    return (ServingPlane(replicas, migration=migration),
+            _requests(cfg, n_reqs, seed=seed))
+
+
+class TestDmaMigration:
+    """The round-17 transport tier: ``ServingPlane(migration="dma")``
+    routes every KV handoff over the fused paired remote-DMA kernel
+    (comm/migration_dma.py) — and must stay byte-exact vs the
+    colocated engine AND vs the wire-codec path, greedy and sampled,
+    at every pool dtype, with the DMA ledger proving no silent
+    fallback impersonated the kernel route."""
+
+    @pytest.mark.parametrize(
+        "over", [{}, {"dtype": "bfloat16"},
+                 {"kv_cache_dtype": "int8"}, {"kv_cache_dtype": "fp8"}],
+        ids=["f32", "bf16", "int8", "fp8"])
+    def test_dma_plane_exact_greedy_every_pool_dtype(self, over):
+        cfg, params = _setup(**over)
+        plane, reqs = _pinned_plane(cfg, params, "dma")
+        ids = [plane.submit(p, m) for p, m in reqs]
+        got = plane.run()
+        # every handoff rode the kernel — the transports Counter and
+        # the DMA-only overlap ledger both say so
+        assert plane.migration_transports["dma"] == len(reqs)
+        assert sum(plane.migration_transports.values()) == len(reqs)
+        assert plane.last_dma_migration_overlap_frac is not None
+        assert plane.migration_bytes_per_round > 0
+        for rid, (p, m) in zip(ids, reqs):
+            np.testing.assert_array_equal(
+                got[rid], _standalone(params, cfg, p, m),
+                err_msg=f"rid {rid}")
+
+    def test_dma_plane_exact_sampled(self):
+        cfg, params = _setup()
+        skw = dict(temperature=0.8, top_k=8, seed=0)
+        plane, reqs = _pinned_plane(cfg, params, "dma", eng_kw=skw,
+                                    seed=5)
+        ids = [plane.submit(p, m) for p, m in reqs]
+        got = plane.run()
+        assert plane.migration_transports["dma"] == len(reqs)
+        key_src = plane.replicas[0].engine
+        for rid, (p, m) in zip(ids, reqs):
+            want = _standalone(params, cfg, p, m,
+                               key=key_src.request_key(rid),
+                               temperature=0.8, top_k=8)
+            np.testing.assert_array_equal(got[rid], want,
+                                          err_msg=f"rid {rid}")
+
+    def test_dma_matches_wire_path(self):
+        # the two extreme transports (device-side kernel vs byte
+        # codec) must agree token for token on the same stream
+        cfg, params = _setup()
+        outs = {}
+        for mig in ("dma", "wire"):
+            plane, reqs = _pinned_plane(cfg, params, mig, seed=3)
+            ids = [plane.submit(p, m) for p, m in reqs]
+            got = plane.run()
+            assert plane.migration_transports[mig] == len(reqs)
+            outs[mig] = [got[r] for r in ids]
+        for i, (a, b) in enumerate(zip(outs["dma"], outs["wire"])):
+            np.testing.assert_array_equal(a, b, err_msg=f"req {i}")
+
+    def test_schedule_chain_fingerprints_resolved_transport(self):
+        # the CollectiveSchedule's kv_migration entries carry the
+        # RESOLVED algorithm — a fallback is visible in the chain,
+        # not just the logs
+        from hpc_patterns_tpu.analysis import runtime as art
+        from hpc_patterns_tpu.harness import trace as tracelib
+
+        cfg, params = _setup()
+        tracelib.configure(enabled=True)  # fresh recorder + chain
+        try:
+            plane, reqs = _pinned_plane(cfg, params, "dma", n_reqs=2)
+            ids = [plane.submit(p, m) for p, m in reqs]
+            plane.run()
+            algos = [e.get("algorithm") for e in art._schedule.entries
+                     if e["op"] == "kv_migration"]
+        finally:
+            # also resets the chain — read the entries BEFORE this
+            tracelib.configure(enabled=False)
+        assert algos and set(algos) == {"dma"}
+
+    def test_fallback_to_device_put_is_loud(self):
+        # device-less (host-shared) replicas cannot serve DMA: the
+        # plane still serves exactly, but warns, counts the fallback,
+        # and reports NO dma overlap number (None, not a value
+        # measured on the wrong transport)
+        cfg, params = _setup()
+        plane = ServingPlane([
+            Replica(EngineCore(params, cfg, **ENG), name="p",
+                    role="prefill"),
+            Replica(EngineCore(params, cfg, **ENG), name="d",
+                    role="decode"),
+        ], migration="dma")
+        reqs = _requests(cfg, 3)
+        ids = [plane.submit(p, m) for p, m in reqs]
+        with pytest.warns(RuntimeWarning, match="fell back"):
+            got = plane.run()
+        assert plane.migration_transports["dma"] == 0
+        assert plane.last_dma_migration_overlap_frac is None
+        for rid, (p, m) in zip(ids, reqs):
+            np.testing.assert_array_equal(
+                got[rid], _standalone(params, cfg, p, m))
+
+    def test_unknown_transport_rejected(self):
+        cfg, params = _setup()
+        with pytest.raises(ValueError, match="migration transport"):
+            ServingPlane([Replica(EngineCore(params, cfg, **ENG))],
+                         migration="carrier-pigeon")
+
+
 class TestReplicaDeathStaticPlane:
     """The FIXED plane's degraded mode under ``die:replica=N`` chaos
     (the in-process ``replica_round`` site): a death ends in SHEDDING
@@ -316,8 +440,18 @@ class TestMigrationPrimitives:
         src.service_round(decode=False)
         b = src.export_migration(src.exportable_slots()[0])
         b.seq = 3
-        b2 = bundle_from_wire(bundle_to_wire(b))
+        wire = bundle_to_wire(b)
+        b2 = bundle_from_wire(wire)
         assert b2.seq == 3 and b2.pos == b.pos and b2.limit == b.limit
+        # the transport field (round 17) crosses the codec: the dict
+        # carries the bundle's value, and a PRE-transport-field
+        # artifact (no key) decodes as "wire" — it crossed a socket by
+        # definition, so old recorded handoffs still load
+        assert wire["transport"] == b.transport
+        assert b2.transport == b.transport
+        legacy = dict(wire)
+        del legacy["transport"]
+        assert bundle_from_wire(legacy).transport == "wire"
         np.testing.assert_array_equal(b2.key, np.asarray(b.key))
         for name, arrs in b.pages_payload.items():
             for a, a2 in zip(arrs, b2.pages_payload[name]):
