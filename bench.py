@@ -328,6 +328,38 @@ def _autofit_detail() -> dict:
     }
 
 
+def _reqtrace_detail() -> dict:
+    """Request-forensics headline keys (round 18), captured in the
+    same measurement child as the overlap headline:
+
+    - ``attribution_coverage_frac``: fraction of finished-request wall
+      time the lifecycle-segment tilings (harness/reqtrace.py) account
+      for over the chaos scenario's timed leg — run_scenario already
+      asserts it in-run at >= 0.95, so the gate watches for drift, not
+      correctness;
+    - ``ttft_p99_queue_share``: share of the p99 TTFT band's
+      attribution window spent in the ``queued`` segment
+      (harness/explain.py) — the "where did the p99 go" number,
+      captured per round so tail regressions come pre-attributed.
+
+    Runs ``bench_serving.run_scenario``'s smoke shape (oracle-exact,
+    chaos seeded). Returns {} on failure — the gate's coverage-loss
+    warning is the tripwire."""
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    import bench_serving
+
+    r = bench_serving.run_scenario(
+        **bench_serving.scenario_smoke_config(), quiet=True)
+    return {
+        "attribution_coverage_frac": round(
+            r["attribution_coverage_frac"], 4),
+        "ttft_p99_queue_share": round(r["ttft_p99_queue_share"], 4),
+    }
+
+
 def _quantized_detail() -> dict:
     """Quantized-decode headline keys (round 13), captured in the same
     measurement child as the overlap headline:
@@ -726,6 +758,16 @@ def main() -> int:
         autofit_detail = {"autofit_error":
                           f"{type(err).__name__}: {err}"}
 
+    # the request-forensics row (round 18): lifecycle-segment coverage
+    # + the p99 band's queued share over the chaos scenario smoke
+    # (bench_serving.run_scenario — coverage invariant asserted
+    # in-run before either number exists)
+    try:
+        reqtrace_detail = _reqtrace_detail()
+    except Exception as err:  # noqa: BLE001 — never sink the headline
+        reqtrace_detail = {"reqtrace_error":
+                           f"{type(err).__name__}: {err}"}
+
     # any clamped-to-zero component means the run measured nothing usable
     degenerate = min(t_overlap, t_serial, t_dma, t_comp) <= 0
     if degenerate:
@@ -763,6 +805,7 @@ def main() -> int:
                     **quant_detail,
                     **elastic_detail,
                     **autofit_detail,
+                    **reqtrace_detail,
                     # the five raw (serial, overlap) pairs, measurement
                     # order — the distribution behind the median
                     "pairs_us": [

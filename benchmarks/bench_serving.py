@@ -108,7 +108,12 @@ standalone paged_generate before any number is reported.
 tests/test_bench_serving.py); the full shape runs in
 benchmarks/reground_r5.sh and its ``serving_goodput_tok_s`` /
 ``serving_degraded_bubble_frac`` keys are gated by
-``harness/regress.py`` like every other headline.
+``harness/regress.py`` like every other headline. The timed leg also
+runs under request-scoped lifecycle tracing (harness/reqtrace.py),
+enforcing the coverage invariant in-run (untracked share < 5%) and
+capturing ``attribution_coverage_frac`` / ``ttft_p99_queue_share``;
+``--explain=1`` (or ``--explain-out=PATH``) renders the per-class
+tail-attribution table (harness/explain.py) after the goodput row.
 
 The capacity story measured on the REALISTIC stream: N requests with
 VARIED prompt lengths (``--mix``, default on) and varied generation
@@ -153,7 +158,10 @@ import jax
 import jax.numpy as jnp
 
 from hpc_patterns_tpu.harness import chaos as chaoslib
-from hpc_patterns_tpu.harness import loadgen, slo
+from hpc_patterns_tpu.harness import explain as explainlib
+from hpc_patterns_tpu.harness import loadgen
+from hpc_patterns_tpu.harness import reqtrace as reqtracelib
+from hpc_patterns_tpu.harness import slo
 from hpc_patterns_tpu.models import TransformerConfig
 from hpc_patterns_tpu.models.decode import paged_generate
 from hpc_patterns_tpu.models.serving import (
@@ -444,7 +452,8 @@ def scenario_full_config(on_tpu: bool):
 
 def run_scenario(*, cfg, params, schedule, classes, page_size, slots,
                  chunk, pool_pages, pages_per_seq, buckets,
-                 chaos_spec=None, admit_highwater=0.95, quiet=False):
+                 chaos_spec=None, admit_highwater=0.95, quiet=False,
+                 explain=False, explain_out=None):
     """One robustness row: the open-loop schedule through (a) clean
     static batching (closed-loop, arrival order — the baseline that
     ignores arrival gaps, generous to static) and (b) the engine with
@@ -531,14 +540,21 @@ def run_scenario(*, cfg, params, schedule, classes, page_size, slots,
     static_out = run_static()
     t_static = time.perf_counter() - t0
     chaoslib.configure(chaos_spec)  # also clears the injection log
+    # request-scoped lifecycle tracing (harness/reqtrace.py) is ALWAYS
+    # on for the timed leg: the attribution keys are gated per round,
+    # so coverage regressions surface even without --explain. Fresh
+    # recorder — the warmup leg reused the same seq_ids.
+    reqtracelib.configure(enabled=True)
     try:
         t0 = time.perf_counter()
         engine_out, eng = run_engine()
         t_engine = time.perf_counter() - t0
         stalls = [e for e in chaoslib.injections()
                   if e["site"] == "engine_round"]
+        req_snap = reqtracelib.active().snapshot(eng.stats)
     finally:
         chaoslib.reset()
+        reqtracelib.reset()
     compiles = prefill_cache_size() - compiles_before
 
     # oracle before any number is believed — the DEGRADED path included:
@@ -559,6 +575,16 @@ def run_scenario(*, cfg, params, schedule, classes, page_size, slots,
         f"{compiles} prefill compiles > ladder {len(buckets)} — "
         "resumed prompts left the bucket ladder")
 
+    # tail attribution over the timed leg: the coverage invariant is
+    # ENFORCED in-run — finished requests whose segment tilings leave
+    # more than 5% of wall time untracked mean a stamp site went
+    # missing, and the table below could no longer be believed
+    dig = explainlib.digest([req_snap])
+    assert dig["coverage_frac"] >= 0.95, (
+        f"request-trace coverage {dig['coverage_frac']:.3f} < 0.95 — "
+        "segment tilings leak untracked time (harness/reqtrace.py "
+        "stamp site missing?)")
+
     tot = rep["total"]
     served_tokens = tot["tokens"]
     result = {
@@ -575,6 +601,8 @@ def run_scenario(*, cfg, params, schedule, classes, page_size, slots,
         "stall_injections": len(stalls),
         "stall_injected_s": sum(e["delay_s"] for e in stalls),
         "prefill_compiles": compiles, "ladder": len(buckets),
+        "attribution_coverage_frac": dig["coverage_frac"],
+        "ttft_p99_queue_share": dig["ttft_p99_queue_share"],
         "schedule": schedule.spec,
     }
     out(f"scenario[{schedule.spec.get('process', '?')}]: "
@@ -593,6 +621,14 @@ def run_scenario(*, cfg, params, schedule, classes, page_size, slots,
     out(f"  engine/static speedup under chaos: "
         f"{result['speedup']:.3f}x (oracle-exact incl. resumed rows)")
     out("  " + slo.format_slo(rep).replace("\n", "\n  "))
+    if explain:
+        out("  " + explainlib.format_explain(dig).replace("\n", "\n  "))
+    if explain_out:
+        import json
+        from pathlib import Path
+
+        Path(explain_out).write_text(json.dumps(dig) + "\n")
+        out(f"  explain digest -> {explain_out}")
     return result
 
 
@@ -2030,11 +2066,17 @@ def main():
             lambda _a: (run_plane(**conf, migration=mig), 0)[1], ns)
         return
     if arg("scenario", False, bool):
+        # --explain/--explain-out mirror the shared CLI pair
+        # (harness/cli.py add_explain_args) through this parser, the
+        # same way --autofit and --kv-dtype are mirrored
+        exp = dict(explain=(arg("explain", False, bool)
+                            or bool(arg("explain-out", None, str))),
+                   explain_out=arg("explain-out", None, str))
         if arg("smoke", False, bool):
-            run_scenario(**scenario_smoke_config())
+            run_scenario(**scenario_smoke_config(), **exp)
         else:
             run_scenario(**scenario_full_config(
-                jax.default_backend() == "tpu"))
+                jax.default_backend() == "tpu"), **exp)
         return
     def resolve_autofit_buckets(buckets, max_seq):
         # --autofit on the plain rows: the fitted ladder replaces the

@@ -178,6 +178,31 @@ run "serving autofit A/B (fit on chip trace)" \
 run "serving autofit replay (chip-fitted config)" \
   python benchmarks/bench_serving.py --fit --autofit="${LOG%.log}_autofit.json"
 
+# 4i. REQUEST-FORENSICS row (round 18): where every p99 went, on chip.
+#     The chaos scenario's timed leg runs under request-scoped
+#     lifecycle tracing (harness/reqtrace.py — always on for that
+#     leg), with the coverage invariant asserted in-run (< 5%
+#     untracked) before any number prints; --explain renders the
+#     per-class tail-attribution table after the goodput row and
+#     --explain-out persists the digest. On chip this is the first
+#     attribution of a REAL p99: queued vs admit_wait vs prefill
+#     shares at chip service rates, with the seeded stalls landing in
+#     the bucket that names them. attribution_coverage_frac is gated
+#     and ttft_p99_queue_share is captured per round by
+#     harness/regress.py. The serve leg then proves the log-side
+#     consumer: a kind=reqtrace record through --log, attributed
+#     offline by `python -m hpc_patterns_tpu.harness.explain` — the
+#     same digest the in-run table rendered, from the artifact.
+run "serving tail attribution (chaos scenario)" \
+  python benchmarks/bench_serving.py --scenario \
+  --explain=1 --explain-out="${LOG%.log}_explain.json"
+run "serve leg with reqtrace record" \
+  python -m hpc_patterns_tpu.apps.serve_app --requests 24 --slots 4 \
+  --budget 32 --prompt-len 48 --chunk 8 --prompt-mix \
+  --explain --log "${LOG%.log}_reqtrace.jsonl"
+run "explain from the run log" \
+  python -m hpc_patterns_tpu.harness.explain "${LOG%.log}_reqtrace.jsonl"
+
 # 5. aligned speculative pair + gamma sweep + batched impls (item 4, 7)
 run "make draft pair" python benchmarks/make_draft_pair.py --out=benchmarks/pair_r5
 run "speculative aligned sweep" python benchmarks/bench_speculative.py --pair=benchmarks/pair_r5 --batched=8

@@ -177,6 +177,20 @@ DEFAULT_DISPATCH_CRITICAL = frozenset({
     "send_migration",
     "recv_migration",
     "_resolve_transport",
+    # the round-18 request-trace stamp paths: every lifecycle stamp
+    # (harness/reqtrace.py) fires inside an engine or router
+    # transition the batcher already owns — admission, preemption,
+    # swap-out, migration export/install — with decode chunks in
+    # flight. A stamp is a perf_counter read plus host list work by
+    # contract; a device readback smuggled into one (np.asarray of
+    # engine.pos to "enrich" a segment) turns the observability layer
+    # itself into the tail it exists to explain.
+    "begin_request",
+    "stamp_transition",
+    "finish_request",
+    "export_history",
+    "install_history",
+    "restamp_submit",
 })
 
 # rule names are kebab-case identifiers; anything after the last name

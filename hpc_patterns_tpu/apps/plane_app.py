@@ -48,12 +48,18 @@ import os
 import sys
 import time
 
-from hpc_patterns_tpu.harness.cli import add_autofit_arg, base_parser
+from hpc_patterns_tpu.harness.cli import (
+    add_autofit_arg,
+    add_explain_args,
+    base_parser,
+    explain_enabled,
+)
 
 
 def build_parser():
     p = base_parser(__doc__.splitlines()[0])
     add_autofit_arg(p)
+    add_explain_args(p)
     p.add_argument("--rdv", required=True,
                    help="rendezvous directory replicas publish their "
                         "listen addresses under (shared by all ranks)")
@@ -169,6 +175,12 @@ def _run_router(args, nprocs: int) -> int:
             handles, policy=args.policy,
             slo_targets=slolib.targets_from_classes(classes),
             emit=emit)
+    if explain_enabled(args):
+        # router-stamped request tracing: one recorder, one clock —
+        # the PlaneRouter class contract (serving_plane/service.py)
+        from hpc_patterns_tpu.harness import reqtrace as reqtracelib
+
+        reqtracelib.configure(enabled=True)
     report = router.run(arrivals, timeout_s=args.plane_timeout)
 
     ok = True
@@ -209,6 +221,26 @@ def _run_router(args, nprocs: int) -> int:
 
     tot = report["slo"]["total"]
     print(slolib.format_slo(report["slo"]), flush=True)
+    if explain_enabled(args):
+        from hpc_patterns_tpu.harness import explain as explainlib
+        from hpc_patterns_tpu.harness import reqtrace as reqtracelib
+
+        rtr = reqtracelib.active()
+        if rtr is not None:
+            snap = rtr.snapshot(router.stats)
+            if emit is not None:
+                emit(kind="reqtrace", **snap)
+            dig = explainlib.digest([snap])
+            print(explainlib.format_explain(dig), flush=True)
+            if args.explain_out:
+                import json
+
+                from pathlib import Path
+
+                Path(args.explain_out).write_text(
+                    json.dumps(dig) + "\n")
+                print(f"explain digest -> {args.explain_out}",
+                      flush=True)
     print(f"plane: served {report['served']}/{report['n']} "
           f"shed={report['shed']} deaths={report['deaths']} "
           f"resumed={report['resumed']} "

@@ -32,13 +32,17 @@ from hpc_patterns_tpu.harness import RunLog, Verdict
 from hpc_patterns_tpu.harness import metrics as metricslib
 from hpc_patterns_tpu.harness.cli import (
     add_autofit_arg,
+    add_explain_args,
     add_kv_dtype_arg,
     add_serving_args,
     base_parser,
+    explain_enabled,
     load_autofit,
     parse_buckets,
     resolve_kv_cache_dtype,
 )
+from hpc_patterns_tpu.harness import explain as explainlib
+from hpc_patterns_tpu.harness import reqtrace as reqtracelib
 from hpc_patterns_tpu.models import TransformerConfig, init_params
 
 
@@ -46,6 +50,7 @@ def build_parser():
     p = base_parser(__doc__.splitlines()[0])
     add_serving_args(p)
     add_autofit_arg(p)
+    add_explain_args(p)
     p.add_argument("--requests", type=int, default=8)
     p.add_argument("--slots", type=int, default=2,
                    help="concurrent rows in the pool")
@@ -270,6 +275,11 @@ def run(args) -> int:
     # run below must add none (warm)
     compiles_cold = prefill_cache_size() - compiles0
     compiles_before = prefill_cache_size()
+    if explain_enabled(args):
+        # fresh recorder for the MEASURED run only: the warm-up run
+        # above reused the same seq ids, and one recorder is one id
+        # space (the bench-leg reconfigure discipline)
+        reqtracelib.configure(enabled=True)
     t0 = time.perf_counter()
     with metricslib.span("serve.measure"):
         out, eng, _ = serve()
@@ -338,6 +348,20 @@ def run(args) -> int:
               f"{f' (ladder {len(buckets)})' if buckets else ''}"
               f"{f' +{compiles_warm} warm' if compiles_warm else ''}, "
               f"oracle[{mode}] {'ok' if exact else 'MISMATCH'}")
+
+    rtr = reqtracelib.active()
+    if rtr is not None:
+        snap = rtr.snapshot(eng.stats)
+        log.emit(kind="reqtrace", **snap)
+        dig = explainlib.digest([snap])
+        log.print(explainlib.format_explain(dig))
+        if args.explain_out:
+            import json as _json
+            from pathlib import Path as _Path
+
+            _Path(args.explain_out).write_text(
+                _json.dumps(dig) + "\n")
+            log.print(f"explain digest -> {args.explain_out}")
 
     if args.static_compare:
         def run_static():

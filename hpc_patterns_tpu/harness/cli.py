@@ -204,6 +204,39 @@ def add_autofit_arg(p: argparse.ArgumentParser) -> None:
     )
 
 
+def add_explain_args(p: argparse.ArgumentParser) -> None:
+    """The shared ``--explain``/``--explain-out`` pair: every serving
+    surface (serve_app, plane_app; bench_serving mirrors them through
+    its own flag parser) enables request-scoped lifecycle tracing
+    (harness/reqtrace.py) the same way and renders the SAME
+    per-class tail-attribution table (harness/explain.py) after its
+    goodput row — where every p99 went, by lifecycle segment."""
+    p.add_argument(
+        "--explain",
+        action="store_true",
+        help="trace request lifecycle segments (queued/prefill/decode/"
+             "admit_wait/preempted/swapped_out/prefetch_wait/"
+             "migrating/shed) and print the per-class tail-"
+             "attribution table; with --log, a kind=reqtrace record "
+             "is appended for `python -m hpc_patterns_tpu.harness."
+             "explain run.jsonl`",
+    )
+    p.add_argument(
+        "--explain-out",
+        default=None,
+        metavar="PATH",
+        help="also write the attribution digest as JSON "
+             "(implies --explain)",
+    )
+
+
+def explain_enabled(args) -> bool:
+    """Did this invocation ask for request tracing? (``--explain-out``
+    implies ``--explain`` — writing the digest requires recording.)"""
+    return bool(getattr(args, "explain", False)
+                or getattr(args, "explain_out", None))
+
+
 def load_autofit(path):
     """Load-and-validate a ``--autofit`` value (None passes through) —
     the one CLI ingestion point over ``autofit.load_fitted``."""

@@ -447,7 +447,49 @@ def merge(snaps: list[dict[str, Any]]) -> dict[str, Any]:
                 rec["bp"] = "e"
             events.append(rec)
 
+    # round 18 request-forensics lanes (harness/reqtrace.py): each
+    # request's lifecycle segments already merged above as cat=request
+    # X slices on its own TID_REQUEST lane; here every `migrating`
+    # segment carrying the plane's migration seq is threaded by a flow
+    # chain into the matched plane.kv_migration device windows of the
+    # same seq — reading a p99 in Perfetto, the arrow leads from the
+    # request's wait into the transfer that caused it
+    n_req_lanes = set()
+    n_mig_links = 0
+    for snap in annotated:
+        off = snap["_offset"]
+        for ev in snap.get("events", []):
+            ph, cat, name, ts, tid, dur, args = ev
+            if ph != "X" or cat != "request":
+                continue
+            n_req_lanes.add((snap["_pid"], int(tid)))
+            if name != "migrating" or not isinstance(args, dict) \
+                    or not isinstance(args.get("seq"), int):
+                continue
+            wins = groups.get(("plane.kv_migration", args["seq"]))
+            if not wins:
+                continue
+            n_mig_links += 1
+            flow_id += 1
+            chain = sorted(
+                [{"pid": snap["_pid"], "tid": int(tid),
+                  "start": float(ts) + off,
+                  "dur": float(dur or 0.0)}] + wins,
+                key=lambda w: w["start"] + w["dur"] / 2.0)
+            for i, w in enumerate(chain):
+                fph = "s" if i == 0 else (
+                    "f" if i == len(chain) - 1 else "t")
+                rec = {"name": "plane.kv_migration", "cat": "request",
+                       "ph": fph, "id": flow_id, "pid": w["pid"],
+                       "tid": w["tid"],
+                       "ts": (w["start"] + w["dur"] / 2.0 - t0) * 1e6}
+                if fph == "f":
+                    rec["bp"] = "e"
+                events.append(rec)
+
     rollup = _rollup(annotated, matched, align, n_unmatched)
+    rollup["requests"] = {"n_lanes": len(n_req_lanes),
+                          "n_migration_links": n_mig_links}
     chrome = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
     return {"chrome": chrome, "rollup": rollup}
 
@@ -562,6 +604,12 @@ def format_rollup(rollup: dict[str, Any]) -> str:
         + f"); {rollup['n_matched']} collective(s) matched across ranks"
         + (f", {rollup['n_unmatched']} single-rank"
            if rollup["n_unmatched"] else ""))
+    reqs = rollup.get("requests") or {}
+    if reqs.get("n_lanes"):
+        lines.append(
+            f"request lanes: {reqs['n_lanes']} request(s), "
+            f"{reqs['n_migration_links']} migration flow link(s) "
+            "(harness/explain.py attributes the tails)")
     sched = rollup.get("schedule") or {}
     verdict = sched.get("verdict")
     if verdict == "consistent":

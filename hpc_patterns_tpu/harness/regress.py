@@ -195,6 +195,21 @@ SPECS: tuple[MetricSpec, ...] = (
     # assertion (coverage loss here), not as a small gain wobble.
     MetricSpec("detail.fitted_goodput_tok_s", "higher"),
     MetricSpec("detail.autofit_gain_frac", "higher", abs_slack=0.05),
+    # the request-forensics row (bench_serving --scenario under
+    # harness/reqtrace.py, round 18): coverage is the fraction of
+    # finished-request wall time the lifecycle-segment tilings account
+    # for — the row asserts >= 0.95 in-run, so the gate holds the
+    # TRAJECTORY with a tight band (a new engine transition that
+    # forgets its stamp site leaks `untracked` time and regresses here
+    # before anyone reads a wrong attribution table). The p99 queue
+    # share is WHERE the tail went, not how big it is — load-shape
+    # dependent and legitimately mobile, so informational: the gate
+    # prints the drift, the attribution table explains it.
+    MetricSpec("detail.attribution_coverage_frac", "higher",
+               abs_slack=0.02),
+    MetricSpec("detail.ttft_p99_queue_share", "lower", gated=False,
+               abs_slack=0.10,
+               label="ttft_p99_queue_share (tail attribution)"),
 )
 
 

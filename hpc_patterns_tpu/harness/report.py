@@ -64,6 +64,7 @@ def aggregate(records: list[dict[str, Any]]) -> dict[str, Any]:
     traces: list[dict[str, Any]] = []
     merged_traces: list[dict[str, Any]] = []
     analyses: list[dict[str, Any]] = []
+    reqtraces: list[dict[str, Any]] = []
     n_ok = n_bad = n_snapshots = n_layout_skipped = 0
     for rec in records:
         kind = rec.get("kind", "?")
@@ -98,6 +99,15 @@ def aggregate(records: list[dict[str, Any]]) -> dict[str, Any]:
                 "stragglers": rec.get("stragglers", {}),
                 "out": rec.get("out"),
                 "rollup_out": rec.get("rollup_out"),
+            })
+        if kind == "reqtrace":
+            # request-lifecycle snapshot (harness/reqtrace.py):
+            # surface the run's attribution coverage here; the
+            # per-class tail table is the explain CLI's job
+            # (`python -m hpc_patterns_tpu.harness.explain`)
+            reqtraces.append({
+                "n": rec.get("n", 0),
+                "coverage_frac": rec.get("coverage_frac"),
             })
         if kind == "trace":
             # flight-recorder snapshot (harness/trace.py): summarize
@@ -145,6 +155,7 @@ def aggregate(records: list[dict[str, Any]]) -> dict[str, Any]:
         "traces": traces,
         "merged_traces": merged_traces,
         "analyses": analyses,
+        "reqtraces": reqtraces,
         "n_snapshots": n_snapshots,
         "n_layout_skipped": n_layout_skipped,
         "results": (n_ok, n_bad),
@@ -230,6 +241,12 @@ def format_report(agg: dict[str, Any], source: str = "") -> str:
             # name it so the autofit leg knows what to consume
             line += f", rollup: {t['rollup_out']}"
         lines.append(line)
+    for t in agg.get("reqtraces", []):
+        cov = t.get("coverage_frac")
+        lines.append(
+            f"reqtrace: {t['n']} request(s), attribution coverage "
+            + (f"{cov:.1%}" if cov is not None else "-")
+            + " — attribute: python -m hpc_patterns_tpu.harness.explain")
     for t in agg.get("traces", []):
         cats = ", ".join(f"{k}={n}" for k, n in sorted(t["by_cat"].items()))
         comp = t.get("compile", {})

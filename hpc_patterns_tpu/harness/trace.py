@@ -84,6 +84,12 @@ from hpc_patterns_tpu.harness import metrics as metricslib
 TID_DEVICE = 1 << 20
 TID_COMPILE = 1 << 21
 TID_COUNTER = (1 << 21) + 1
+# Request lifecycle lanes (round 18, harness/reqtrace.py): one
+# subtrack PER REQUEST (TID_REQUEST + seq_id), each tiled wall-to-wall
+# with that request's lifecycle segments — the Perfetto view of the
+# coverage invariant, threaded by flow arrows into the migration/
+# device windows at merge time (harness/collect.py).
+TID_REQUEST = 1 << 22
 
 
 def _track_label(tid: int) -> str:
@@ -95,6 +101,8 @@ def _track_label(tid: int) -> str:
         return "device (dispatch→completion)"
     if TID_DEVICE < tid < TID_COMPILE:
         return f"device (admit slot {tid - TID_DEVICE - 1})"
+    if tid >= TID_REQUEST:
+        return f"request {tid - TID_REQUEST}"
     return f"host thread {tid}"
 
 DEFAULT_CAPACITY = 16384
@@ -187,6 +195,22 @@ class TraceRecorder:
         ts = time.perf_counter()
         self._push("X", "device", name, t_dispatch, TID_DEVICE + track,
                    dur=ts - t_dispatch, args=args)
+
+    def mark_request_segment(self, seq_id: int, kind: str, t0: float,
+                             t1: float,
+                             args: dict[str, Any] | None = None
+                             ) -> None:
+        """One finished lifecycle segment on a request's own lane
+        (``TID_REQUEST + seq_id``) — reqtrace mirrors a request's
+        whole history here at finish, so the per-request tiling is a
+        first-class Perfetto track next to the device windows it
+        explains. Retrospective X slices: both stamps are ordinary
+        host perf_counter instants already taken by the stamp sites
+        (no clock read, no readback — this runs inside the serving
+        loop's finish path)."""
+        self._push("X", "request", kind, t0,
+                   TID_REQUEST + int(seq_id), dur=t1 - t0,
+                   args={**(args or {}), "seq_id": int(seq_id)})
 
     def mark_sync(self, name: str) -> float:
         """Record a cross-rank sync anchor: call this immediately after

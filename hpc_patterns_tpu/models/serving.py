@@ -168,6 +168,7 @@ from jax import lax
 
 from hpc_patterns_tpu.harness import chaos as chaoslib
 from hpc_patterns_tpu.harness import metrics as metricslib
+from hpc_patterns_tpu.harness import reqtrace as reqtracelib
 from hpc_patterns_tpu.harness import slo as slolib
 from hpc_patterns_tpu.harness import trace as tracelib
 from hpc_patterns_tpu.memory.prefix_cache import RadixPrefixCache
@@ -376,6 +377,13 @@ class MigrationBundle:
     #: fingerprints this into the collective schedule's
     #: ``kv_migration`` entries as the ``algorithm`` field
     transport: str = "local"
+    #: request-lifecycle segment history (harness/reqtrace.py) carried
+    #: across the handoff so the destination's attribution does not
+    #: start fresh — the same backward-compatible pattern as
+    #: ``transport``: None when the donor traced nothing; an ABSENT
+    #: key on a legacy wire artifact decodes to one ``untracked``
+    #: segment (reqtrace.LEGACY_SEGMENTS)
+    segments: tuple | None = None
 
 
 @dataclass
@@ -1178,6 +1186,9 @@ class EngineCore:
             "t_finish": None, "tokens": 0, "outcome": None,
             "preemptions": 0,
         }
+        rtr = reqtracelib.active()
+        if rtr is not None:
+            rtr.begin_request(sid, now)
         metricslib.get_metrics().gauge("serve.queue_depth").set(
             len(self._queue))
         return sid
@@ -1214,6 +1225,9 @@ class EngineCore:
             if rec is not None:
                 rec["outcome"] = "shed"
                 rec["t_finish"] = now
+            rtr = reqtracelib.active()
+            if rtr is not None:
+                rtr.finish_request(req.seq_id, now, final="shed")
             self._emit(kind="serve_shed", seq_id=req.seq_id,
                        priority=req.priority,
                        waited_s=now - req.t_submit,
@@ -1249,6 +1263,11 @@ class EngineCore:
         back in the admission window). Returns the number
         admitted."""
         self._shed_expired()
+        # one pass-start stamp: every request seated THIS round closes
+        # its queued segment here — the span from pass start to its
+        # own dispatch-complete is its share of the admission bubble
+        t_pass = (time.perf_counter()
+                  if reqtracelib.active() is not None else None)
         order = [self._queue[qi] for qi in self._queue_order()]
         admitted = 0
         for req in order:
@@ -1276,12 +1295,14 @@ class EngineCore:
             # holding ndarrays, so list.remove/__eq__ would be both
             # ambiguous and wrong here
             self._queue = [r for r in self._queue if r is not req]
-            self._admit(free_slot, req, overlapped, chain=chain)
+            self._admit(free_slot, req, overlapped, chain=chain,
+                        t_pass=t_pass)
             admitted += 1
         return admitted
 
     def _admit(self, slot: int, req: Request, overlapped: bool,
-               chain: list[int] | None = None):
+               chain: list[int] | None = None,
+               t_pass: float | None = None):
         """Dispatch-only admission: every device op (table upload,
         prefill, first-token pick, cursor seeding) enqueues without a
         host readback, so an in-flight decode chunk is never stalled.
@@ -1444,6 +1465,16 @@ class EngineCore:
             if m:
                 mx.counter("serve.prefix_matched_pages").inc(m)
                 mx.counter("serve.prefill_skip_tokens").inc(M)
+        rtr = reqtracelib.active()
+        if rtr is not None:
+            # queued (or preempted, for a resume) closed at the pass
+            # start; admit_wait covers the host admission work up to
+            # dispatch-complete; prefill runs until the first-token
+            # readback in _resolve_pending
+            rtr.stamp_transition(
+                req.seq_id, "admit_wait",
+                st.t_admit if t_pass is None else t_pass)
+            rtr.stamp_transition(req.seq_id, "prefill")
 
     def _resolve_pending(self):
         """Host bookkeeping deferred from :meth:`_admit`: read back the
@@ -1472,6 +1503,9 @@ class EngineCore:
             resumed = bool(st.prefix)
             if rec_s is not None and rec_s["t_first"] is None:
                 rec_s["t_first"] = now
+            rtr = reqtracelib.active()
+            if rtr is not None:
+                rtr.stamp_transition(st.seq_id, "decode", now)
             m = metricslib.get_metrics()
             if m.enabled and not resumed:
                 # prefill emitted the first token: its readback IS
@@ -1527,6 +1561,9 @@ class EngineCore:
             rec_s["t_finish"] = now
             rec_s["tokens"] = len(st.out)
             rec_s["outcome"] = "ok"
+        rtr = reqtracelib.active()
+        if rtr is not None:
+            rtr.finish_request(st.seq_id, now)
         m = metricslib.get_metrics()
         if m.enabled:
             dt = now - st.t_admit
@@ -1688,6 +1725,11 @@ class EngineCore:
         rec_s = self.stats.get(st.seq_id)
         if rec_s is not None:
             rec_s["preemptions"] += 1
+        rtr = reqtracelib.active()
+        if rtr is not None:
+            # decode closes; preempted spans the wait for re-admission
+            # (the resume's _admit transitions it to admit_wait)
+            rtr.stamp_transition(st.seq_id, "preempted")
         self._emit(kind="serve_preempt", seq_id=st.seq_id, slot=slot,
                    tokens_done=len(st.out), remaining=remaining,
                    pages_freed=len(st.pages), priority=st.priority,
@@ -2030,6 +2072,11 @@ class EngineCore:
         rec_s = self.stats.get(bundle.seq_id)
         if rec_s is not None:
             rec_s["outcome"] = "migrated"
+        rtr = reqtracelib.active()
+        if rtr is not None:
+            # decode closes into an open `migrating` segment; the copy
+            # rides the bundle so the installer closes it on ITS side
+            bundle.segments = rtr.export_history(bundle.seq_id)
         self._residency_release(bundle.seq_id)
         self._emit(kind="serve_migrate_out", seq_id=bundle.seq_id,
                    slot=slot, pages=bundle.n_pages,
@@ -2059,6 +2106,10 @@ class EngineCore:
         rec_s = self.stats.get(seq_id)
         if rec_s is not None:
             rec_s["outcome"] = "migrated"
+        rtr = reqtracelib.active()
+        if rtr is not None:
+            # the open `swapped_out` segment closes into `migrating`
+            bundle.segments = rtr.export_history(seq_id)
         self._residency_release(seq_id)
         self._emit(kind="serve_migrate_out", seq_id=seq_id, slot=-1,
                    pages=bundle.n_pages, tokens_done=len(bundle.out),
@@ -2192,6 +2243,17 @@ class EngineCore:
             "tokens": 0, "outcome": None,
             "preemptions": bundle.preemptions,
         }
+        rtr = reqtracelib.active()
+        if rtr is not None:
+            # the round-18 half of "starts fresh": t_submit/t_first/
+            # preemptions survived the handoff since round 14 (the
+            # stats rebuild above), but the lifecycle history did not
+            # — adopt the bundle's carried segments (swap-in bundles
+            # carry None and keep the LOCAL history; a legacy wire
+            # artifact decoded to one untracked span) and open decode
+            rtr.install_history(bundle.seq_id, bundle.segments,
+                                t=st.t_admit,
+                                t_submit=bundle.t_submit)
         return slot
 
 
@@ -2214,6 +2276,9 @@ class EngineCore:
             attrs={"seq_id": sid, "pages": bundle.n_pages})
         self._swapped[sid] = replace(bundle,
                                      pages_payload=host_payload)
+        rtr = reqtracelib.active()
+        if rtr is not None:
+            rtr.stamp_transition(sid, "swapped_out")
         self.residency.retier_group(sid, "host")
         self._emit(kind="serve_swap_out", seq_id=sid, slot=slot,
                    pages=bundle.n_pages, tokens_done=len(bundle.out),
@@ -2257,6 +2322,12 @@ class EngineCore:
                 break
             if bundle.n_pages > free_pages:
                 continue
+            rtr = reqtracelib.active()
+            if rtr is not None:
+                # stamped BEFORE the pull dispatch so an injected
+                # slow_host_transfer lands inside prefetch_wait — the
+                # chaos-attribution teeth contract
+                rtr.stamp_transition(sid, "prefetch_wait")
             payload, handle = self.residency.pull_payload(
                 bundle.pages_payload,
                 attrs={"seq_id": sid, "pages": bundle.n_pages})
@@ -2488,6 +2559,12 @@ class ContinuousBatcher(EngineCore):
                     t_abs = t_run0 + t_arr
                     self._queue[-1].t_submit = t_abs
                     self.stats[sid]["t_submit"] = t_abs
+                    rtr = reqtracelib.active()
+                    if rtr is not None:
+                        # the queued segment starts where t_submit
+                        # does, or the drain lag would finalize as a
+                        # leading untracked gap
+                        rtr.restamp_submit(sid, t_abs)
             if not self.has_work():
                 if not pending_arrivals:
                     break

@@ -33,6 +33,7 @@ from dataclasses import replace
 
 import numpy as np
 
+from hpc_patterns_tpu.harness import reqtrace
 from hpc_patterns_tpu.models.serving import MigrationBundle
 
 
@@ -125,6 +126,12 @@ def bundle_to_wire(bundle: MigrationBundle) -> dict:
         "rung": int(bundle.rung),
         "prefix_len": int(bundle.prefix_len),
         "transport": str(bundle.transport),
+        # request-lifecycle history (harness/reqtrace.py): compact
+        # [kind, t0, t1, meta] lists, already JSON — ALWAYS written
+        # (null when the donor traced nothing), so absence below means
+        # a legacy artifact, not a disabled tracer
+        "segments": ([list(s) for s in bundle.segments]
+                     if bundle.segments is not None else None),
     }
 
 
@@ -156,4 +163,12 @@ def bundle_from_wire(wire: dict) -> MigrationBundle:
         prefix_len=int(wire.get("prefix_len", 0)),
         # pre-transport-field artifacts crossed a socket by definition
         transport=str(wire.get("transport", "wire")),
+        # pre-segments-field artifacts decode to ONE untracked segment
+        # (reqtrace.LEGACY_SEGMENTS): the donor-side life is a measured
+        # unattributed span, not a silent gap; an explicit null means
+        # the donor ran with tracing off
+        segments=(tuple(tuple(s) for s in wire["segments"])
+                  if wire.get("segments") is not None
+                  else None if "segments" in wire
+                  else reqtrace.LEGACY_SEGMENTS),
     )

@@ -75,6 +75,7 @@ from hpc_patterns_tpu.analysis import runtime as analysis_runtime
 from hpc_patterns_tpu.comm import migration_dma
 from hpc_patterns_tpu.harness import chaos as chaoslib
 from hpc_patterns_tpu.harness import metrics as metricslib
+from hpc_patterns_tpu.harness import reqtrace as reqtracelib
 from hpc_patterns_tpu.harness import slo as slolib
 from hpc_patterns_tpu.harness import trace as tracelib
 from hpc_patterns_tpu.models.serving import EngineCore, fit_bucket_ladder
@@ -536,6 +537,13 @@ class ServingPlane:
         bundle = src.engine.export_migration(slot)
         bundle.seq = self._mig_seq
         self._mig_seq += 1
+        rtr = reqtracelib.active()
+        if rtr is not None:
+            # the engine opened `migrating` at export; the router owns
+            # the plane seq — tag the open segment so the cross-rank
+            # merge can thread the request lane into THIS migration's
+            # device window (harness/collect.py flow arrows)
+            rtr.annotate_open(bundle.seq_id, seq=bundle.seq)
         self.migration_bytes += sum(
             int(a.nbytes) for arrs in bundle.pages_payload.values()
             for a in arrs)
@@ -821,6 +829,15 @@ class ServingPlane:
             return
         ps["outcome"] = "shed"
         ps["t_finish"] = time.perf_counter()
+        rtr = reqtracelib.active()
+        if rtr is not None:
+            # plane-side shed (death / unplaceable arrival): the
+            # request may never have reached an engine's recorder —
+            # open its queued span retroactively so the shed life
+            # still tiles instead of finalizing as one untracked gap
+            if rtr.segments(sid) is None:
+                rtr.begin_request(sid, ps["t_submit"])
+            rtr.finish_request(sid, ps["t_finish"], final="shed")
         self._judge_window(ps)  # a shed never attains — it counts
         self.finished[sid] = np.zeros((0,), np.int32)
         self._requests.pop(sid, None)  # resolved: recovery never
@@ -896,6 +913,9 @@ class ServingPlane:
                     eng = self._assignment[rid].engine
                     eng._queue[-1].t_submit = t_abs
                     eng.stats[rid]["t_submit"] = t_abs
+                    rtr = reqtracelib.active()
+                    if rtr is not None:
+                        rtr.restamp_submit(rid, t_abs)
             if not self._has_work():
                 if not pending_arrivals:
                     break

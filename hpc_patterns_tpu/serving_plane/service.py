@@ -51,6 +51,7 @@ from pathlib import Path
 from hpc_patterns_tpu.analysis import runtime as analysis_runtime
 from hpc_patterns_tpu.harness import chaos as chaoslib
 from hpc_patterns_tpu.harness import metrics as metricslib
+from hpc_patterns_tpu.harness import reqtrace as reqtracelib
 from hpc_patterns_tpu.harness import slo as slolib
 from hpc_patterns_tpu.harness import trace as tracelib
 
@@ -823,6 +824,14 @@ class PlaneRouter:
             "t_first": None, "t_finish": None, "tokens": 0,
             "outcome": None, "preemptions": 0,
         }
+        rtr = reqtracelib.active()
+        if rtr is not None:
+            # launched-plane segments are ROUTER-stamped (one clock,
+            # the class contract above): replica-side detail is not
+            # visible here, so the buckets are the router's own
+            # transitions — queued until assigned, prefill until the
+            # first observed token, decode after
+            rtr.begin_request(rid, self.stats[rid]["t_submit"])
         if not self._assign(rid, resume_prefix=None):
             self._shed(rid)
         return rid
@@ -865,6 +874,12 @@ class PlaneRouter:
             if not reply.get("ok"):
                 continue  # this replica cannot fit it; try the next
             h.assigned.add(rid)
+            rtr = reqtracelib.active()
+            if rtr is not None:
+                # in a replica's hands: the service attempt (remote
+                # queue + prefill) runs until the router observes the
+                # first token in _merge_round
+                rtr.stamp_transition(rid, "prefill")
             # bump the local load estimate NOW: a burst of submits
             # between rounds must spread instead of piling onto the
             # replica whose snapshot happened to look emptiest
@@ -877,6 +892,9 @@ class PlaneRouter:
         rec = self.stats[rid]
         rec["outcome"] = "shed"
         rec["t_finish"] = time.perf_counter()
+        rtr = reqtracelib.active()
+        if rtr is not None:
+            rtr.finish_request(rid, rec["t_finish"], final="shed")
         self._judge_window(rec)  # a shed never attains — it counts
         self.finished[rid] = []
         self.shed.append(rid)
@@ -951,6 +969,13 @@ class PlaneRouter:
                 # the replica — the observed tokens ARE the output
                 self._finish(rid, emitted, "ok")
                 continue
+            rtr = reqtracelib.active()
+            if rtr is not None:
+                # the replica died with the row: the span from death
+                # to re-admission is a preemption, same bucket as an
+                # engine-level eviction (a successful _assign then
+                # transitions it back to prefill)
+                rtr.stamp_transition(rid, "preempted")
             if self._assign(rid, resume_prefix=emitted):
                 self.stats[rid]["preemptions"] += 1
                 self.resumed.append(rid)
@@ -971,6 +996,9 @@ class PlaneRouter:
         rec["tokens"] = len(tokens)
         if rec["t_first"] is None and tokens:
             rec["t_first"] = rec["t_finish"]
+        rtr = reqtracelib.active()
+        if rtr is not None:
+            rtr.finish_request(rid, rec["t_finish"])
         self._judge_window(rec)
         self.finished[rid] = tokens
         self.progress.pop(rid, None)
@@ -983,12 +1011,15 @@ class PlaneRouter:
         now = time.perf_counter()
         h.load = {k: reply.get(k, 0)
                   for k in ("free_pages", "queue_depth", "active")}
+        rtr = reqtracelib.active()
         for rid_s, toks in reply.get("progress", {}).items():
             rid = int(rid_s)
             self.progress[rid] = list(toks)
             rec = self.stats.get(rid)
             if rec is not None and rec["t_first"] is None and toks:
                 rec["t_first"] = now
+                if rtr is not None:
+                    rtr.stamp_transition(rid, "decode", now)
         for rid_s, key in reply.get("keys", {}).items():
             self.key_ckpt[int(rid_s)] = key
         outcomes = reply.get("outcomes", {})
@@ -1015,6 +1046,12 @@ class PlaneRouter:
                 rec = self.stats.get(rid)
                 if rec is not None and rec["t_first"] is None:
                     rec["t_first"] = now
+            if rtr is not None:
+                # the row left its donor: in plane transit until a
+                # decode replica accepts the forwarded bundle
+                rtr.stamp_transition(rid, "migrating", now)
+                if isinstance(wire.get("seq"), int):
+                    rtr.annotate_open(rid, seq=wire["seq"])
             self.pending_bundles.append(wire)
 
     def _forward_bundles(self) -> None:
@@ -1042,6 +1079,11 @@ class PlaneRouter:
                 continue
             h.assigned.add(int(wire["seq_id"]))
             h.load["free_pages"] -= int(wire["n_pages"])
+            rtr = reqtracelib.active()
+            if rtr is not None:
+                # handoff delivered: the row decodes on the receiver
+                # (its tokens reappear in that replica's progress)
+                rtr.stamp_transition(int(wire["seq_id"]), "decode")
             self.migrations += 1
         self.pending_bundles = still
 

@@ -1,0 +1,49 @@
+"""Known-bad: the round-18 request-trace bug shapes, minimized. A
+lifecycle stamp (harness/reqtrace.py) is a ``perf_counter`` read plus
+host list work by contract — it fires inside engine transitions the
+batcher already owns (admission, preemption, migration export) with
+decode chunks in flight. These variants smuggle a device readback into
+the stamp to "enrich" the segment metadata, turning the observability
+layer itself into the host stall it exists to attribute."""
+
+import time
+
+import numpy as np
+
+import jax
+
+
+def stamp_transition(histories, engine, seq_id, kind):
+    """The enriched stamp: reading the engine's device-resident decode
+    cursor back to annotate the segment synchronizes the queue on
+    EVERY transition — queued/prefill/decode boundaries become the
+    bubble the table then blames on the scheduler."""
+    now = time.perf_counter()
+    pos_now = int(np.asarray(engine.pos)[seq_id])  # EXPECT: host-sync-in-dispatch
+    segs = histories.setdefault(seq_id, [])
+    if segs and segs[-1][2] is None:
+        segs[-1][2] = now
+    segs.append([kind, now, None, {"pos": pos_now}])
+    return segs
+
+
+def export_history(histories, engine, seq_id):
+    """Export with a 'consistency check': block_until_ready on the KV
+    slab before handing the segment tuple to the bundle serializes the
+    donor's in-flight chunk behind the migration bookkeeping."""
+    jax.block_until_ready(engine.kv_pages)  # EXPECT: host-sync-in-dispatch
+    segs = histories.get(seq_id) or []
+    if segs and segs[-1][2] is None:
+        segs[-1][2] = time.perf_counter()
+    return tuple(tuple(s) for s in segs)
+
+
+def finish_request(histories, engine, seq_id, t):
+    """Finish stamp that materializes the generated-token count from
+    a device counter: float()-of-a-call reads the value back on the
+    one boundary every finished request crosses."""
+    tokens = float(jax.device_get(engine.generated)[seq_id])  # EXPECT: host-sync-in-dispatch
+    segs = histories.get(seq_id) or []
+    if segs and segs[-1][2] is None:
+        segs[-1][2] = t
+    return tokens, segs

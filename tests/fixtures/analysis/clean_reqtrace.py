@@ -1,0 +1,54 @@
+"""Known-clean: the shipped request-trace stamp discipline
+(``harness/reqtrace.py``): every lifecycle stamp is a ``perf_counter``
+read plus host list mutation — segment metadata comes from values the
+engine already holds on the host (bundle fields, stats dict entries),
+never from a device readback. Zero findings expected."""
+
+import time
+
+
+def stamp_transition(histories, seq_id, kind, t=None):
+    """The stamp contract: close the open segment, open the next —
+    wall-clock and list work only, clamped so a same-tick transition
+    cannot produce a negative span."""
+    now = time.perf_counter() if t is None else t
+    segs = histories.setdefault(seq_id, [])
+    if segs and segs[-1][2] is None:
+        segs[-1][2] = max(now, segs[-1][1])
+    segs.append([kind, now, None, None])
+    return segs
+
+
+def export_history(histories, seq_id):
+    """Migration export: transition to ``migrating`` and return an
+    immutable copy for the bundle — the KV payload's own movement is
+    the DMA tier's job, not the tracer's."""
+    stamp_transition(histories, seq_id, "migrating")
+    return tuple(tuple(s) for s in histories[seq_id])
+
+
+def install_history(histories, seq_id, segments, t, t_submit):
+    """Install side of the handoff: adopt the carried segments (or
+    synthesize one ``untracked`` span for a legacy wire artifact),
+    close the travel segment, open ``decode`` — pure host list work
+    on metadata that arrived over the wire."""
+    if segments is not None:
+        segs = [list(s) for s in segments]
+    elif seq_id in histories:
+        segs = histories[seq_id]
+    else:
+        segs = [["untracked", t_submit, None, None]]
+    histories[seq_id] = segs
+    if segs and segs[-1][2] is None:
+        segs[-1][2] = max(t, segs[-1][1])
+    segs.append(["decode", t, None, None])
+    return segs
+
+
+def finish_request(histories, stats, seq_id, t):
+    """Finish stamp: the token count comes from the stats row the
+    resolve step already wrote — nothing is read back here."""
+    segs = histories.get(seq_id) or []
+    if segs and segs[-1][2] is None:
+        segs[-1][2] = max(t, segs[-1][1])
+    return stats[seq_id]["tokens"], segs

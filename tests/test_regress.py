@@ -292,6 +292,21 @@ class TestAutofitSpecs:
         assert f.abs_slack >= 0.03
 
 
+class TestReqtraceSpecs:
+    def test_reqtrace_keys_direction_and_gating(self):
+        # round 18: coverage GATES (higher, tight band — a missing
+        # stamp site leaks untracked time and regresses here); the p99
+        # queue share is informational — where the tail went is
+        # load-shape dependent, so it prints drift without failing
+        # the gate
+        by_path = {s.path: s for s in regress.SPECS}
+        c = by_path["detail.attribution_coverage_frac"]
+        assert c.gated and c.direction == "higher"
+        assert c.abs_slack <= 0.02
+        q = by_path["detail.ttft_p99_queue_share"]
+        assert not q.gated and q.direction == "lower"
+
+
 class TestStrictCoverage:
     _round = TestGateMechanics._round
 
