@@ -94,6 +94,9 @@ def _fused_collective_detail() -> dict:
       matmul tile while the next shard's remote DMA is in flight
       (clamped at 0 — interpret mode serializes DMAs, so the CPU smoke
       legitimately measures no overlap);
+    - ``allreduce_busbw_gbps``: the same busbw normalization measured
+      on ``algorithm="collective"`` — the gated host-driven baseline
+      row the fused number is judged against;
     - ``allreduce_gbps_by_algorithm``: the fused-vs-collective-vs-ring
       comparison row (informational, not gated).
 
@@ -143,10 +146,46 @@ def _fused_collective_detail() -> dict:
         lambda a, b: comm.allgather_matmul(a, b, "collective"), xa, w)
     return {
         "fused_allreduce_gbps": round(gbps["fused"], 3),
+        # the gated host-driven baseline row: the same ring-busbw
+        # normalization measured on algorithm="collective" (the
+        # jax.lax.psum route the fused kernel is judged against)
+        "allreduce_busbw_gbps": round(gbps["collective"], 3),
         "allreduce_overlap_frac": round(
             max(0.0, 1.0 - t_fused / t_host), 4) if t_host > 0 else 0.0,
         "allreduce_gbps_by_algorithm": {
             a: round(v, 3) for a, v in gbps.items()},
+    }
+
+
+def _serving_detail() -> dict:
+    """Single-engine serving headline keys, captured in the same
+    measurement child as the overlap headline:
+
+    - ``serving_tok_s``: engine-window tok/s of the continuous
+      batcher on ``bench_serving.run_bench``'s smoke shape
+      (oracle-exact vs standalone decode before the number exists);
+    - ``serving_bubble_frac``: host-gap fraction of that engine
+      window — the overlapped-admission claim in one number;
+    - ``serving_prefill_compiles``: distinct prefill compilations the
+      bucket ladder admitted (a ladder regression shows up as a
+      compile-count jump before it shows up in the wall clock).
+
+    These three are the oldest gated keys in ``regress.py``'s table
+    and were captured by hand (or not at all) until contractlint's
+    ``gate-key-orphan`` flagged them as emitterless. Returns {} on
+    failure — the gate's coverage-loss warning is the tripwire."""
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    import bench_serving
+
+    r = bench_serving.run_bench(**bench_serving.smoke_config(),
+                                quiet=True)
+    return {
+        "serving_tok_s": round(r["tokens_per_s_engine"], 1),
+        "serving_bubble_frac": round(r["bubble_frac"], 4),
+        "serving_prefill_compiles": int(r["prefill_compiles"]),
     }
 
 
@@ -342,6 +381,12 @@ def _reqtrace_detail() -> dict:
       (harness/explain.py) — the "where did the p99 go" number,
       captured per round so tail regressions come pre-attributed.
 
+    The same scenario run also yields the robustness row's gated
+    keys — ``serving_goodput_tok_s`` (SLO-attained tok/s under
+    chaos) and ``serving_degraded_bubble_frac`` (the degraded-mode
+    engine bubble) — which had no emitter at all until contractlint's
+    ``gate-key-orphan`` flagged the orphaned gate rows.
+
     Runs ``bench_serving.run_scenario``'s smoke shape (oracle-exact,
     chaos seeded). Returns {} on failure — the gate's coverage-loss
     warning is the tripwire."""
@@ -357,6 +402,8 @@ def _reqtrace_detail() -> dict:
         "attribution_coverage_frac": round(
             r["attribution_coverage_frac"], 4),
         "ttft_p99_queue_share": round(r["ttft_p99_queue_share"], 4),
+        "serving_goodput_tok_s": round(r["goodput_tok_s"], 1),
+        "serving_degraded_bubble_frac": round(r["bubble_frac"], 4),
     }
 
 
@@ -700,6 +747,16 @@ def main() -> int:
         fused_detail = {"fused_collective_error":
                         f"{type(err).__name__}: {err}"}
 
+    # the single-engine serving row: continuous-batcher tok/s, engine
+    # bubble fraction, and the ladder's prefill-compile count
+    # (bench_serving.run_bench smoke — oracle-exact before any number
+    # is returned)
+    try:
+        serving_detail = _serving_detail()
+    except Exception as err:  # noqa: BLE001 — never sink the headline
+        serving_detail = {"serving_error":
+                          f"{type(err).__name__}: {err}"}
+
     # the serving-plane row (round 10): router goodput across 2
     # replicas + the KV-migration overlap fraction of the
     # disaggregated 1p/1d shape (bench_serving.run_plane smoke —
@@ -799,6 +856,7 @@ def main() -> int:
                     if measure_error is not None else None,
                     "backend": jax.default_backend(),
                     **fused_detail,
+                    **serving_detail,
                     **plane_detail,
                     **offload_detail,
                     **shared_detail,

@@ -313,9 +313,16 @@ run "regress coverage-loss check (full trajectory)" \
 #    (collective-divergence/-order, unchecked-permutation,
 #    spec-mismatch) AND the pallaslint family (dma-sem-balance,
 #    dma-slot-reuse, collective-id-collision, kernel-dtype-cast,
-#    vmem-budget) gate here with no script change; the runtime halves
-#    are step 7b's "collective schedules consistent" verdict and the
-#    strict-semaphore shim the fused parity battery runs under.
+#    vmem-budget) AND the contractlint family (gate-key-orphan,
+#    record-kind-drift, wire-field-compat, track-band-collision,
+#    chaos-site-drift — whole-tree producer/consumer contracts over
+#    the very gate keys step 8 judges) gate here with no script
+#    change; the runtime halves are step 7b's "collective schedules
+#    consistent" verdict and the strict-semaphore shim the fused
+#    parity battery runs under. The producer/consumer tables behind
+#    the contract rules are printable on demand
+#    (--contract-report), the informational twin of the VMEM table
+#    below.
 #    --vmem-report logs the per-kernel VMEM budget table next to the
 #    analysis record — read it BEFORE step 7c's compiled fused legs
 #    (the kernels this round first lowers on real VMEM limits) and

@@ -60,14 +60,33 @@ def build_parser() -> argparse.ArgumentParser:
         "--vmem-report", action="store_true",
         help="print the per-kernel VMEM budget table (every "
              "pallas_call, model-dim bindings; analysis/vmem.py)")
+    p.add_argument(
+        "--contract-report", action="store_true",
+        help="print the whole-tree producer/consumer tables the "
+             "contractlint rules judge (gate keys, metric names, "
+             "record kinds, track bands, chaos names; "
+             "analysis/contracts.py)")
     return p
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
-        for name, rule in sorted(registered_rules().items()):
-            print(f"{name:<24} {rule.summary}")
+        # grouped by family in pipeline order: Python-level hazards,
+        # SPMD hazards, in-kernel hazards, cross-module contracts
+        rules = registered_rules()
+        families = ["jaxlint", "shardlint", "pallaslint",
+                    "contractlint"]
+        families += sorted({r.family for r in rules.values()}
+                           - set(families))
+        for family in families:
+            members = sorted((name, rule) for name, rule
+                             in rules.items() if rule.family == family)
+            if not members:
+                continue
+            print(f"{family}:")
+            for name, rule in members:
+                print(f"  {name:<26} {rule.summary}")
         return 0
     paths = args.paths or [_PACKAGE_ROOT]
     if args.select:
@@ -115,6 +134,13 @@ def main(argv=None) -> int:
         vmem_stats = vmem.vmem_summary(estimates)
         if args.vmem_report:
             print(vmem.format_vmem_table(estimates, root=_PACKAGE_ROOT))
+    if args.contract_report:
+        # the informational twin of --vmem-report: the full
+        # producer/consumer tables the contractlint rules judged
+        from hpc_patterns_tpu.analysis import contracts
+
+        print(contracts.format_contract_report(
+            contracts.tables_for_paths(paths)))
     for f in report.findings:
         print(f.format())
     counts = report.by_rule()

@@ -284,6 +284,10 @@ class Rule:
     name: str = "?"
     #: one-line description for --list-rules and the docs catalog
     summary: str = ""
+    #: rule family for --list-rules grouping: jaxlint (Python-level),
+    #: shardlint (SPMD), pallaslint (in-kernel), contractlint
+    #: (cross-module producer/consumer contracts)
+    family: str = "jaxlint"
     hint: str = ""
 
     def check(self, mod: ModuleInfo, config: "AnalysisConfig"
@@ -323,9 +327,10 @@ def register(rule_cls: type[Rule]) -> type[Rule]:
 
 
 def registered_rules() -> dict[str, Rule]:
-    # rules.py / pallas_rules.py self-register on import; import
-    # lazily so core stays importable without the rule set (the
-    # runtime helper's case)
+    # rules.py / pallas_rules.py / contract_rules.py self-register on
+    # import; import lazily so core stays importable without the rule
+    # set (the runtime helper's case)
+    from hpc_patterns_tpu.analysis import contract_rules  # noqa: F401
     from hpc_patterns_tpu.analysis import pallas_rules  # noqa: F401
     from hpc_patterns_tpu.analysis import rules  # noqa: F401
 

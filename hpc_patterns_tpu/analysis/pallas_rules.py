@@ -1205,6 +1205,7 @@ class DmaSemBalanceRule(Rule):
     the kernel's scratch teardown."""
 
     name = "dma-sem-balance"
+    family = "pallaslint"
     summary = ("kernel DMA semaphore ledger imbalance: double-wait, "
                "wait-without-signal, or signals outstanding at exit")
     hint = ("wait every started DMA exactly once per channel; after a "
@@ -1228,6 +1229,7 @@ class DmaSlotReuseRule(Rule):
     other's remote consumption)."""
 
     name = "dma-slot-reuse"
+    family = "pallaslint"
     summary = ("scratch slot reused while a DMA is in flight, or one "
                "recv buffer shared across DMA phases")
     hint = ("wait the in-flight DMA's semaphore before touching its "
@@ -1251,6 +1253,7 @@ class CollectiveIdCollisionRule(Rule):
     call sites sharing an id or a registry name in one module."""
 
     name = "collective-id-collision"
+    family = "pallaslint"
     summary = ("hand-picked or colliding collective_id (use the "
                "ops.tiling.collective_id registry)")
     hint = ("pass collective_id=tiling.collective_id('<unique.name>') "
@@ -1317,6 +1320,7 @@ class KernelDtypeCastRule(Rule):
     fused/flash kernels already do; this makes it checked."""
 
     name = "kernel-dtype-cast"
+    family = "pallaslint"
     summary = ("widened matmul stored into a kernel ref without "
                ".astype(ref.dtype)")
     hint = ("end the store with .astype(<ref>.dtype) — the explicit "
@@ -1362,6 +1366,7 @@ class VmemBudgetRule(Rule):
     territory, reported, never flagged)."""
 
     name = "vmem-budget"
+    family = "pallaslint"
     summary = ("literal-resolvable kernel VMEM footprint exceeds its "
                "vmem_limit_bytes")
     hint = ("shrink the block/scratch shapes, stream the grid, or "

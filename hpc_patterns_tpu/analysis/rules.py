@@ -828,6 +828,7 @@ class CollectiveDivergenceRule(Rule):
     rank waits inside a collective this rank never enters)."""
 
     name = "collective-divergence"
+    family = "shardlint"
     summary = ("collective under rank-dependent control flow whose "
                "paths disagree on the schedule")
     hint = ("issue the same collective sequence on every rank: branch "
@@ -917,6 +918,7 @@ class CollectiveOrderRule(Rule):
     reason to exist."""
 
     name = "collective-order"
+    family = "shardlint"
     summary = ("sibling code paths issue the same collectives in "
                "different orders")
     hint = ("normalize the order so every path reaching the "
@@ -951,6 +953,7 @@ class UncheckedPermutationRule(Rule):
     every consumer in ``_PERMUTE_CONSUMERS``."""
 
     name = "unchecked-permutation"
+    family = "shardlint"
     summary = ("ppermute/fused_permute pair list built without "
                "ring.check_permutation")
     hint = ("bind the pair list to a name and run "
@@ -1012,6 +1015,7 @@ class SpecMismatchRule(Rule):
     silently wasted and the input still dies)."""
 
     name = "spec-mismatch"
+    family = "shardlint"
     summary = ("PartitionSpec inconsistent with the module's mesh "
                "axes or a donated buffer's output specs")
     hint = ("axis names in a PartitionSpec must exist on the mesh and "

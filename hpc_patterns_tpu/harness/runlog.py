@@ -25,6 +25,43 @@ import time
 from pathlib import Path
 from typing import IO, Any
 
+#: Record kinds written for the FORENSIC stream — the raw per-event
+#: log a human (or replay tooling) greps after a bad run — and
+#: deliberately not string-dispatched by report/collect/autofit/
+#: explain. contractlint's ``record-kind-drift`` treats membership
+#: here as consumption-by-declaration: a kind belongs on this list
+#: only if nothing *should* dispatch on it; adding one to silence a
+#: finding while a consumer exists is the drift the rule hunts.
+FORENSIC_KINDS = (
+    # serving-engine lifecycle events (models/serving.py): the
+    # per-seq swap/migration audit trail behind the aggregated
+    # serve_admit/serve_swap_out windows autofit DOES dispatch on
+    "serve_migrate_out",
+    "serve_migrate_in",
+    "serve_swap_in",
+    # serving-plane round events (serving_plane/router.py,
+    # service.py, autoscaler.py): the elastic plane's decision journal
+    "plane_migrate",
+    "plane_route",
+    "plane_shed",
+    "plane_resume",
+    "plane_transport_fallback",
+    "plane_replica_death",
+    "plane_spinup",
+    "plane_drain",
+    "plane_retire",
+    # per-step training journal (apps/train_app.py): loss/dt per
+    # step for post-mortem grep; the aggregated numbers ride the
+    # metrics snapshot
+    "step",
+    # the versioned --rollup-out artifact envelope (harness/
+    # collect.py): consumers take the whole document, nothing
+    # string-dispatches on its kind field
+    "trace_rollup",
+    # kernel autotune outcomes (benchmarks): cache-warm evidence
+    "autotune",
+)
+
 
 class RunLog:
     def __init__(

@@ -91,6 +91,31 @@ TID_COUNTER = (1 << 21) + 1
 # device windows at merge time (harness/collect.py).
 TID_REQUEST = 1 << 22
 
+# The single declared source of device-SUBTRACK bands (offsets added
+# to TID_DEVICE): ``name -> (base, count)``, half-open width. Every
+# module that owns a band unpacks it with :func:`track_band` instead
+# of hand-picking integers — contractlint's ``track-band-collision``
+# flags literal ``*_TRACK_BASE`` assignments and out-of-band
+# ``track=`` literals, the same registry discipline pallaslint
+# applies to collective ids. Bands: the decode chunk itself, the
+# overlapped-admission slots (one per admit row), the KV-migration
+# lanes (serving_plane/service.py), the warm spin-up lanes
+# (serving_plane/autoscaler.py), and the host<->HBM residency lanes
+# (memory/residency.py).
+TRACK_BANDS: dict[str, tuple[int, int]] = {
+    "decode": (0, 1),
+    "admit": (1, 63),
+    "migration": (64, 8),
+    "spinup": (72, 8),
+    "residency": (80, 8),
+}
+
+
+def track_band(name: str) -> tuple[int, int]:
+    """``(base, count)`` for a declared subtrack band; the ONLY
+    sanctioned way for a module to learn its band's offsets."""
+    return TRACK_BANDS[name]
+
 
 def _track_label(tid: int) -> str:
     if tid == TID_COMPILE:
@@ -100,7 +125,15 @@ def _track_label(tid: int) -> str:
     if tid == TID_DEVICE:
         return "device (dispatch→completion)"
     if TID_DEVICE < tid < TID_COMPILE:
-        return f"device (admit slot {tid - TID_DEVICE - 1})"
+        track = tid - TID_DEVICE
+        for name, (base, count) in TRACK_BANDS.items():
+            if base <= track < base + count:
+                # admit keeps its historic "slot" wording (slot N
+                # rides subtrack N+1; track 0 is the decode chunk)
+                if name == "admit":
+                    return f"device (admit slot {track - base})"
+                return f"device ({name} lane {track - base})"
+        return f"device (subtrack {track})"
     if tid >= TID_REQUEST:
         return f"request {tid - TID_REQUEST}"
     return f"host thread {tid}"

@@ -59,11 +59,10 @@ from hpc_patterns_tpu.harness import trace as tracelib
 from hpc_patterns_tpu.memory import kinds as kindslib
 
 #: device-subtrack band for ``mem.prefetch`` / ``mem.evict`` windows —
-#: above the admit-slot band and the serving plane's migration band
-#: (serving_plane/service.py: 64..71), so concurrently-open windows
-#: never share a Chrome sync track with either
-MEM_TRACK_BASE = 80
-MEM_TRACKS = 8
+#: declared in harness/trace.py's TRACK_BANDS above the admit-slot
+#: band and the serving plane's migration band, so concurrently-open
+#: windows never share a Chrome sync track with either
+MEM_TRACK_BASE, MEM_TRACKS = tracelib.track_band("residency")
 
 
 def mem_track(seq: int) -> int:

@@ -67,12 +67,11 @@ from hpc_patterns_tpu.harness import trace as tracelib
 from hpc_patterns_tpu.serving_plane.migration import migrate_pages
 from hpc_patterns_tpu.serving_plane.router import Replica, ServingPlane
 
-#: device-subtrack band for ``plane.spinup`` windows — between the
-#: migration band (service.py: 64..71) and the residency band
-#: (memory/residency.py: 80..87), so a spin-up overlapping either
-#: never shares a Chrome sync track with it
-SPINUP_TRACK_BASE = 72
-SPINUP_TRACKS = 8
+#: device-subtrack band for ``plane.spinup`` windows — declared in
+#: harness/trace.py's TRACK_BANDS between the migration band and the
+#: residency band, so a spin-up overlapping either never shares a
+#: Chrome sync track with it
+SPINUP_TRACK_BASE, SPINUP_TRACKS = tracelib.track_band("spinup")
 
 
 def spinup_track(ordinal: int) -> int:

@@ -62,6 +62,21 @@ def migrate_pages(bundle: MigrationBundle, device=None) -> MigrationBundle:
 # wire codec (shared with the jax-free socket plane)
 # ---------------------------------------------------------------------------
 
+#: The fields a wire dict MUST carry — reading one with ``wire["k"]``
+#: (absent-INTOLERANT) is legal only for names listed here; every
+#: other field must be read with ``.get()`` or an ``in`` guard, so an
+#: old donor's artifact never kills a new receiver (the round-17
+#: ``transport`` / round-18 ``segments`` compatibility discipline).
+#: contractlint's ``wire-field-compat`` enforces this statically. The
+#: last three are the per-array codec's own envelope
+#: (``_arr_to_wire``/``_arr_from_wire``).
+REQUIRED_WIRE_FIELDS = (
+    "seq_id", "prompt", "out", "prefix", "budget", "pos", "limit",
+    "token", "key", "temp", "priority", "t_submit", "n_pages",
+    "page_size", "payload",
+    "shape", "dtype", "b64",
+)
+
 
 def _arr_to_wire(a) -> dict:
     a = np.asarray(a)

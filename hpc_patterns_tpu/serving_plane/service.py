@@ -64,13 +64,13 @@ class ReplicaDead(Exception):
 #: by EVERY party to a handoff (the in-process plane in router.py;
 #: the socket plane's donor and receiver here): the cross-rank merge
 #: matches windows by (name, seq), and concurrent migrations must not
-#: share a subtrack (Chrome sync slices on one track must nest). Base
-#: 64 clears the decode chunk's track 0 and the per-slot admission
-#: subtracks (slot+1) for any realistic slot count. Defined in this
-#: import-light module so the jax-free stub tier never pays for the
+#: share a subtrack (Chrome sync slices on one track must nest). The
+#: band itself lives in ``harness/trace.py``'s TRACK_BANDS registry
+#: (clear of the decode chunk's track 0 and the per-slot admission
+#: subtracks); this import-light module unpacks it — trace.py is
+#: stdlib-only, so the jax-free stub tier still never pays for the
 #: jax-side migration codec.
-MIG_TRACK_BASE = 64
-MIG_TRACKS = 8
+MIG_TRACK_BASE, MIG_TRACKS = tracelib.track_band("migration")
 
 
 def migration_track(seq: int) -> int:

@@ -79,7 +79,12 @@ class TestGoldenFixtures:
                 # bug shape has a caught minimized replica
                 "dma-sem-balance", "dma-slot-reuse",
                 "collective-id-collision", "kernel-dtype-cast",
-                "vmem-budget"} <= caught
+                "vmem-budget",
+                # the contractlint family (PR 19): every stringly
+                # producer/consumer seam has a caught drift replica
+                "gate-key-orphan", "record-kind-drift",
+                "wire-field-compat", "track-band-collision",
+                "chaos-site-drift"} <= caught
 
     def test_rank_branched_deadlock_replica_is_caught_at_the_branch(self):
         live, _ = core.analyze_file(
@@ -243,8 +248,28 @@ class TestCLI:
                      "collective-order", "unchecked-permutation",
                      "spec-mismatch", "dma-sem-balance",
                      "dma-slot-reuse", "collective-id-collision",
-                     "kernel-dtype-cast", "vmem-budget"):
+                     "kernel-dtype-cast", "vmem-budget",
+                     "gate-key-orphan", "record-kind-drift",
+                     "wire-field-compat", "track-band-collision",
+                     "chaos-site-drift"):
             assert rule in out
+
+    def test_list_rules_groups_by_family(self, capsys):
+        # the catalog is grouped: one header per rule family, every
+        # family header before its first rule line
+        assert cli.main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for family in ("jaxlint:", "shardlint:", "pallaslint:",
+                       "contractlint:"):
+            assert family in out
+        lines = out.splitlines()
+        contract = lines.index("contractlint:")
+        section = {l.split()[0] for l in lines[contract + 1:]
+                   if l.startswith("  ")}
+        assert section == {"gate-key-orphan", "record-kind-drift",
+                           "wire-field-compat",
+                           "track-band-collision",
+                           "chaos-site-drift"}
 
 
 class TestBurnDownPins:
@@ -1056,3 +1081,99 @@ class TestStrictSemaphores:
             assert pl.pallas_call is not before[2]
         assert (pltpu.make_async_copy, pltpu.make_async_remote_copy,
                 pl.pallas_call) == before
+
+
+class TestContractlint:
+    """Whole-tree producer/consumer verification (contractlint): the
+    static tables agree with the live tree, and the motivating
+    deleted-emitter shape is caught at the surviving gate row."""
+
+    def test_static_gate_key_table_covers_every_gate_spec(self):
+        # the static twin of regress.py's runtime coverage-loss
+        # warning: every detail.* key the gate table consumes must
+        # have an emitter in bench.py/benchmarks/ BEFORE any bench
+        # run happens — a deleted emitter fails here, not one silent
+        # bench run later
+        from hpc_patterns_tpu.analysis import contracts
+        from hpc_patterns_tpu.harness import regress
+
+        root = contracts.find_repo_root(Path(__file__).resolve())
+        assert root is not None
+        tables = contracts.live_tables(root)
+        for spec in regress.SPECS:
+            if not spec.path.startswith("detail."):
+                continue
+            key = spec.path.split(".", 1)[1]
+            assert key in tables.detail_keys, (
+                f"gate key {spec.path} has no static emitter in "
+                f"bench.py/benchmarks/")
+
+    def test_deleted_emitter_replica_flagged_at_the_gate_row(self):
+        # the minimized "gated key whose emitter was deleted" replica:
+        # the finding anchors at the surviving MetricSpec row, exactly
+        # where its EXPECT marker sits
+        path = FIXTURES / "bad_gate_key_orphan.py"
+        live, _ = core.analyze_file(path)
+        orphans = [f for f in live if f.rule == "gate-key-orphan"]
+        assert orphans, "the deleted-emitter replica must be flagged"
+        lines = path.read_text().splitlines()
+        gate_rows = [f for f in orphans
+                     if "detail.engine_bubble_frac" in lines[f.line - 1]]
+        assert gate_rows, "finding must anchor at the gate-table row"
+        assert "EXPECT: gate-key-orphan" in lines[gate_rows[0].line - 1]
+
+    def test_fixture_worlds_are_self_contained(self):
+        # a fixture under tests/fixtures/ is its own single-module
+        # tree: its tables must not bleed into (or read from) the
+        # live repo tables
+        from hpc_patterns_tpu.analysis import contracts
+
+        mod = core.ModuleInfo.parse(
+            FIXTURES / "bad_record_kind_drift.py")
+        t = contracts.tables_for(mod)
+        assert set(t.kinds_produced) == {"engine_round", "engine_debug"}
+        assert t.root == ""  # not resolved to the repo checkout
+
+    def test_live_wire_codec_declares_required_fields(self):
+        # REQUIRED_WIRE_FIELDS is the explicit absent-intolerance
+        # contract: direct indexing in from_wire is legal only for
+        # declared fields
+        from hpc_patterns_tpu.serving_plane import migration
+
+        assert "seq_id" in migration.REQUIRED_WIRE_FIELDS
+        assert "payload" in migration.REQUIRED_WIRE_FIELDS
+
+    def test_live_track_bands_registry_is_collision_free(self):
+        from hpc_patterns_tpu.harness import trace as tracelib
+
+        bands = sorted(tracelib.TRACK_BANDS.items(),
+                       key=lambda kv: kv[1][0])
+        for (_, (b0, n0)), (_, (b1, _)) in zip(bands, bands[1:]):
+            assert b0 + n0 <= b1, f"bands overlap: {bands}"
+        # the three migrated modules unpack from the registry
+        from hpc_patterns_tpu.memory import residency
+        from hpc_patterns_tpu.serving_plane import autoscaler, service
+
+        assert (service.MIG_TRACK_BASE, service.MIG_TRACKS) \
+            == tracelib.track_band("migration")
+        assert (autoscaler.SPINUP_TRACK_BASE, autoscaler.SPINUP_TRACKS) \
+            == tracelib.track_band("spinup")
+        assert (residency.MEM_TRACK_BASE, residency.MEM_TRACKS) \
+            == tracelib.track_band("residency")
+
+    def test_contract_report_renders_every_section(self, capsys):
+        assert cli.main(["--contract-report"]) == 0
+        out = capsys.readouterr().out
+        assert "contractlint report over" in out
+        for section in ("gate keys (harness/regress.py SPECS",
+                        "metric names consumed by string",
+                        "RunLog record kinds",
+                        "device-subtrack bands",
+                        "chaos contract"):
+            assert section in out
+        # the live tree is burned down: every gate key has an
+        # emitter and every string-consumed metric a producer. (The
+        # record-kind section may show residue from deliberate test
+        # fabrications — those carry rule-layer suppressions.)
+        assert "MISSING EMITTER" not in out
+        assert "MISSING PRODUCER" not in out

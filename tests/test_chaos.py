@@ -68,6 +68,9 @@ class TestParse:
         # a typo'd spec silently injecting nothing would fake a healthy
         # run out of a chaos scenario — every unknown token is an error
         with pytest.raises(ValueError, match="unknown chaos kind"):
+            # jaxlint: disable=chaos-site-drift — the typo is the
+            # test: parse() must reject it, which is the runtime half
+            # of the contract the static rule checks
             chaos.parse("stragler:delay_ms=1")
         with pytest.raises(ValueError, match="unknown chaos key"):
             chaos.parse("straggler:delay=1")

@@ -291,6 +291,10 @@ class TestResidencyScheduling:
                 np.testing.assert_array_equal(base[i], got[s])
             fired = [e for e in chaoslib.injections()
                      if e["site"] == "host_transfer"]
+            # jaxlint: disable=record-kind-drift — chaos injection
+            # events are not RunLog records; their kind field is the
+            # chaos fault kind, written dynamically by
+            # record_injection
             assert fired and all(e["kind"] == "slow_host_transfer"
                                  for e in fired)
             pf = [ev for ev in rec.events
