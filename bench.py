@@ -407,6 +407,38 @@ def _reqtrace_detail() -> dict:
     }
 
 
+def _budget_detail() -> dict:
+    """Segment-budget headline keys (round 20), the attribution
+    loop's gate feed:
+
+    - ``tpot_p99_stall_share``: share of the pooled p99 inter-token
+      gap band spent in decode-stall segments
+      (harness/explain.py TPOT_STALL_KINDS) over the seeded
+      slow_host_transfer row — the "where did the inter-token tail
+      go" number;
+    - ``budget_breach_segments``: how many distinct segments breached
+      their SLO-budget allowance (harness/budget.py) — run_slo_budget
+      already asserts the set is exactly {"prefetch_wait"} in-run, so
+      the gate watches the count for smear (a second breached segment
+      means attribution leaked out of the injected mechanism).
+
+    Runs ``bench_serving.run_slo_budget``'s one shape (oracle-exact,
+    chaos seeded, breach set asserted inside). Returns {} on failure
+    — the gate's coverage-loss warning is the tripwire."""
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    import bench_serving
+
+    r = bench_serving.run_slo_budget(
+        **bench_serving.slo_budget_smoke_config(), quiet=True)
+    return {
+        "tpot_p99_stall_share": round(r["tpot_p99_stall_share"], 4),
+        "budget_breach_segments": len(r["budget_breach_segments"]),
+    }
+
+
 def _quantized_detail() -> dict:
     """Quantized-decode headline keys (round 13), captured in the same
     measurement child as the overlap headline:
@@ -825,6 +857,16 @@ def main() -> int:
         reqtrace_detail = {"reqtrace_error":
                            f"{type(err).__name__}: {err}"}
 
+    # the segment-budget row (round 20): the seeded decode-stall
+    # stream's inter-token tail share + breached-segment count
+    # (bench_serving.run_slo_budget — breach set pinned to the
+    # injected mechanism in-run before either number exists)
+    try:
+        budget_detail = _budget_detail()
+    except Exception as err:  # noqa: BLE001 — never sink the headline
+        budget_detail = {"budget_error":
+                         f"{type(err).__name__}: {err}"}
+
     # any clamped-to-zero component means the run measured nothing usable
     degenerate = min(t_overlap, t_serial, t_dma, t_comp) <= 0
     if degenerate:
@@ -864,6 +906,7 @@ def main() -> int:
                     **elastic_detail,
                     **autofit_detail,
                     **reqtrace_detail,
+                    **budget_detail,
                     # the five raw (serial, overlap) pairs, measurement
                     # order — the distribution behind the median
                     "pairs_us": [

@@ -157,6 +157,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from hpc_patterns_tpu.harness import budget as budgetlib
 from hpc_patterns_tpu.harness import chaos as chaoslib
 from hpc_patterns_tpu.harness import explain as explainlib
 from hpc_patterns_tpu.harness import loadgen
@@ -584,6 +585,10 @@ def run_scenario(*, cfg, params, schedule, classes, page_size, slots,
         f"request-trace coverage {dig['coverage_frac']:.3f} < 0.95 — "
         "segment tilings leak untracked time (harness/reqtrace.py "
         "stamp site missing?)")
+    # segment SLO budgets (harness/budget.py): did any ONE lifecycle
+    # segment alone blow a class's target? The loud section rides
+    # --explain; the count rides the result row either way
+    breaches = budgetlib.evaluate(req_snap, targets)
 
     tot = rep["total"]
     served_tokens = tot["tokens"]
@@ -603,6 +608,8 @@ def run_scenario(*, cfg, params, schedule, classes, page_size, slots,
         "prefill_compiles": compiles, "ladder": len(buckets),
         "attribution_coverage_frac": dig["coverage_frac"],
         "ttft_p99_queue_share": dig["ttft_p99_queue_share"],
+        "tpot_p99_stall_share": dig["tpot_p99_stall_share"],
+        "budget_breaches": len(breaches),
         "schedule": schedule.spec,
     }
     out(f"scenario[{schedule.spec.get('process', '?')}]: "
@@ -623,6 +630,8 @@ def run_scenario(*, cfg, params, schedule, classes, page_size, slots,
     out("  " + slo.format_slo(rep).replace("\n", "\n  "))
     if explain:
         out("  " + explainlib.format_explain(dig).replace("\n", "\n  "))
+        out("  " + budgetlib.format_budget(breaches)
+            .replace("\n", "\n  "))
     if explain_out:
         import json
         from pathlib import Path
@@ -779,6 +788,155 @@ def run_offload(*, cfg, params, n, slots, chunk, page_size, prompt_len,
     out(f"  capacity {t_full / t_tier:.3f}x wall cost for "
         f"{full_pool / hbm_pool:.1f}x pool oversubscription "
         "(token-identical, oracle-exact)")
+    return result
+
+
+def slo_budget_smoke_config():
+    """The CI segment-budget shape (tier-1 via
+    tests/test_bench_serving.py): a deliberately TINY model (the
+    tests/test_reqtrace.py attribution geometry, seconds on CPU) on a
+    5-request stream through a 2-resident tiered pool with a seeded
+    ``slow_host_transfer`` — every pull eats a known synthetic delay,
+    so the decode-phase stall is injected into ONE mechanism and the
+    budget evaluator must blame exactly that mechanism. The knobs are
+    sized so the ``prefetch_wait`` allowance sits well under one
+    injected delay while every other segment's allowance sits well
+    over anything the stream can spend."""
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq=128,
+                            dtype="float32", decode_attn="gather")
+    params = init_params(jax.random.PRNGKey(5), cfg)
+    return dict(cfg=cfg, params=params, n=5, prompt_len=8,
+                max_budget=24, page_size=8, chunk=4, slots=5,
+                hbm_seqs=2, cold_n=2, delay_ms=60,
+                ttft_slo_s=5.0, tpot_slo_s=0.08)
+
+
+#: the seeded-stall budget: prefetch_wait may eat 2% of the decode
+#: allowance (0.02 * 0.08s * 23 tokens ≈ 37ms — LESS than one 60ms
+#: injected transfer delay), everything else is allowed most of the
+#: target — so the injected chaos breaches its own bucket and no other
+SLO_BUDGET_SEEDED = budgetlib.SLOBudget(
+    ttft_shares={"queued": 0.9, "admit_wait": 0.9, "untracked": 0.5},
+    tpot_shares={"prefetch_wait": 0.02, "swapped_out": 0.9,
+                 "preempted": 0.9, "migrating": 0.9, "untracked": 0.5},
+)
+
+
+def _tiered_stall_leg(*, cfg, params, n, prompt_len, max_budget,
+                      page_size, chunk, slots, hbm_seqs, cold_n,
+                      delay_ms, prefetch_depth=None,
+                      min_resident_rounds=1, emit=None):
+    """One tiered stream under a seeded ``slow_host_transfer`` with
+    request tracing on: an HBM pool sized for ``hbm_seqs`` of the
+    ``n``-row working set forces the cold-after-N rotation to page,
+    and every host->HBM pull eats the injected delay. Returns
+    ``(outs, eng, mgr, snapshot, fired)`` — the shared chassis of the
+    ``--slo-budget`` row and the ``--fit`` blame A/B."""
+    from hpc_patterns_tpu.memory import ColdAfterNPolicy, ResidencyManager
+
+    pps = ContinuousBatcher.pages_needed(prompt_len, max_budget,
+                                         page_size)
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(0, cfg.vocab, size=prompt_len)
+               .astype(np.int32) for _ in range(n)]
+    if delay_ms > 0:
+        chaoslib.configure(f"slow_host_transfer:delay_ms={delay_ms}")
+    reqtracelib.configure(enabled=True)
+    try:
+        mgr = ResidencyManager(host_blocks=n * pps,
+                               policy=ColdAfterNPolicy(cold_n),
+                               min_resident_rounds=min_resident_rounds,
+                               prefetch_depth=prefetch_depth)
+        eng = ContinuousBatcher(
+            params, cfg, slots=slots, pool_pages=hbm_seqs * pps,
+            pages_per_seq=pps, page_size=page_size, chunk=chunk,
+            residency=mgr, emit=emit)
+        ids = [eng.submit(p, max_budget) for p in prompts]
+        got = eng.run()
+        fired = [e for e in chaoslib.injections()
+                 if e["site"] == "host_transfer"]
+        snap = reqtracelib.active().snapshot(eng.stats)
+    finally:
+        chaoslib.reset()
+        reqtracelib.reset()
+    return {i: got[s] for i, s in enumerate(ids)}, eng, mgr, snap, fired
+
+
+def run_slo_budget(*, cfg, params, n, prompt_len, max_budget,
+                   page_size, chunk, slots, hbm_seqs, cold_n,
+                   delay_ms, ttft_slo_s, tpot_slo_s, quiet=False,
+                   explain=False):
+    """The segment-budget row: seeded chaos must land in the budget
+    bucket it was injected into. A ``slow_host_transfer`` run is
+    evaluated against :data:`SLO_BUDGET_SEEDED` and the row ASSERTS
+    the breach set is exactly ``{"prefetch_wait"}`` — the injected
+    mechanism blamed, nothing else smeared — and that the inter-token
+    digest attributes a nonzero stall share to the same decode phase.
+    Outputs stay oracle-exact vs standalone decode (paging + chaos
+    change WHEN tokens arrive, never WHICH). Reports
+    ``tpot_p99_stall_share`` and ``budget_breach_segments``, the two
+    keys ``bench.py`` captures and ``harness/regress.py`` gates."""
+    out = print if not quiet else (lambda *a, **k: None)
+    leg = dict(cfg=cfg, params=params, n=n, prompt_len=prompt_len,
+               max_budget=max_budget, page_size=page_size, chunk=chunk,
+               slots=slots, hbm_seqs=hbm_seqs, cold_n=cold_n)
+    # warmup (compiles) with the delay off; the judged leg runs seeded
+    _tiered_stall_leg(**leg, delay_ms=0)
+    t0 = time.perf_counter()
+    outs, eng, mgr, snap, fired = _tiered_stall_leg(
+        **leg, delay_ms=delay_ms)
+    wall = time.perf_counter() - t0
+
+    # oracle before any number is believed
+    rng = np.random.RandomState(11)
+    for i in range(n):
+        prompt = rng.randint(0, cfg.vocab, size=prompt_len) \
+            .astype(np.int32)
+        want = np.asarray(paged_generate(
+            params, jnp.asarray(prompt)[None], cfg, max_budget,
+            page_size=page_size))[0]
+        np.testing.assert_array_equal(outs[i], want,
+                                      err_msg=f"budget seq {i}")
+    assert mgr.swap_outs > 0 and fired, (
+        f"seeded stall row paged nothing (swap_outs={mgr.swap_outs}, "
+        f"injections={len(fired)}) — the row measured nothing")
+
+    targets = {0: slo.SLOTarget(ttft_s=ttft_slo_s, tpot_s=tpot_slo_s)}
+    breaches = budgetlib.evaluate(snap, targets, SLO_BUDGET_SEEDED)
+    segs = budgetlib.breached_segments(breaches)
+    assert segs == {"prefetch_wait"}, (
+        f"seeded slow_host_transfer breached {sorted(segs)} — chaos "
+        "must land in the budget bucket it was injected into")
+    dig = explainlib.digest([snap])
+    assert dig["tpot_p99_stall_share"] > 0.0, (
+        "inter-token digest attributes no stall time to a run whose "
+        "every pull was seeded slow")
+
+    result = {
+        "wall_s": wall, "n": n,
+        "tokens": n * max_budget,
+        "swap_outs": mgr.swap_outs,
+        "stall_injections": len(fired),
+        "stall_injected_s": sum(e["delay_s"] for e in fired),
+        "attribution_coverage_frac": dig["coverage_frac"],
+        "tpot_p99_stall_share": dig["tpot_p99_stall_share"],
+        "budget_breach_segments": sorted(segs),
+        "budget_breaches": len(breaches),
+    }
+    out(f"slo-budget: n={n} hbm={hbm_seqs}/{n} seqs resident "
+        f"chaos=slow_host_transfer:{delay_ms}ms "
+        f"targets ttft={ttft_slo_s}s tpot={tpot_slo_s}s")
+    out(f"  stream  : {wall:.3f}s  swaps {mgr.swap_outs}  "
+        f"injected {result['stall_injected_s'] * 1e3:.0f}ms over "
+        f"{len(fired)} pull(s) (oracle-exact)")
+    out(f"  tpot p99-gap stall share "
+        f"{dig['tpot_p99_stall_share']:.0%}  coverage "
+        f"{dig['coverage_frac']:.1%}")
+    out("  " + budgetlib.format_budget(breaches).replace("\n", "\n  "))
+    if explain:
+        out("  " + explainlib.format_explain(dig)
+            .replace("\n", "\n  "))
     return result
 
 
@@ -1796,7 +1954,13 @@ def run_fitted(*, cfg, params, n, slots, chunk, page_size, max_budget,
        ``load_fitted`` round trip, exactly what the CLI does);
     3. the A/B — the default-ladder engine vs
        ``ContinuousBatcher.from_fitted`` on the SAME stream and pool
-       geometry, warmed then timed min-of-reps.
+       geometry, warmed then timed min-of-reps;
+    4. the BLAME A/B — a seeded decode-stall stream (the
+       ``--slo-budget`` chassis) recorded, blame-fitted, and
+       re-served under the fitted residency; asserts the fitter
+       blames the injected ``prefetch_wait`` mechanism and that the
+       blamed segment's pooled p99-gap-band share STRICTLY shrinks
+       under the fitted config (attribution closed into control).
 
     Deterministic win first: the fitted ladder's expected padding must
     be STRICTLY below the default's on the observed lengths (the DP
@@ -1924,6 +2088,53 @@ def run_fitted(*, cfg, params, n, slots, chunk, page_size, max_budget,
         np.testing.assert_array_equal(fitted_out[i], want,
                                       err_msg=f"fitted seq {i}")
 
+    # the BLAME A/B (attribution becomes control): a decode-stall
+    # stream — the --slo-budget chassis, seeded slow_host_transfer
+    # under a thrashing 2-resident tier — is RECORDED (emit stream +
+    # its reqtrace snapshot, the same two inputs a production RunLog
+    # carries), fitted, and re-served under the blame-fitted
+    # residency. Two asserts close the loop: the fitter must blame
+    # the injected mechanism (tpot/prefetch_wait, not the queued-
+    # dominated TTFT shape every saturated stream shows), and the
+    # blamed segment's pooled p99-gap-band share must STRICTLY
+    # shrink under the fitted config.
+    bcfg = slo_budget_smoke_config()
+    bleg = dict(cfg=bcfg["cfg"], params=bcfg["params"], n=bcfg["n"],
+                prompt_len=bcfg["prompt_len"],
+                max_budget=bcfg["max_budget"],
+                page_size=bcfg["page_size"], chunk=bcfg["chunk"],
+                slots=bcfg["slots"], hbm_seqs=bcfg["hbm_seqs"],
+                cold_n=bcfg["cold_n"])
+    _tiered_stall_leg(**bleg, delay_ms=0)  # warmup (compiles)
+    blame_records: list = []
+    outs_rec, _be, _bm, snap_rec, _bf = _tiered_stall_leg(
+        **bleg, delay_ms=bcfg["delay_ms"],
+        emit=lambda **kw: blame_records.append(kw))
+    blame_records.append(dict(snap_rec, kind="reqtrace"))
+    bfit = autofitlib.fit(blame_records)
+    blame = bfit.get("blame")
+    assert blame and blame["axis"] == "tpot" \
+        and blame["dominant"] == "prefetch_wait", (
+        f"blame fitter read the seeded decode stall as {blame} — the "
+        "injected mechanism must be the one blamed")
+    bres = bfit.get("residency") or {}
+    outs_bfit, _be2, _bm2, snap_fit, _bf2 = _tiered_stall_leg(
+        **bleg, delay_ms=bcfg["delay_ms"],
+        prefetch_depth=bres.get("prefetch_depth"),
+        min_resident_rounds=int(bres.get("min_resident_rounds") or 1))
+    for i in sorted(outs_rec):
+        np.testing.assert_array_equal(
+            outs_bfit[i], outs_rec[i],
+            err_msg=f"blame-fitted leg diverged on seq {i}")
+    blame_share_default = float(blame["share"])
+    blame_share_fitted = float(
+        (explainlib.digest([snap_fit])["tpot_p99_band_shares"] or {})
+        .get("prefetch_wait", 0.0))
+    assert blame_share_fitted < blame_share_default, (
+        f"blame-fitted config did not shrink the blamed segment: "
+        f"prefetch_wait p99-band share {blame_share_default:.3f} -> "
+        f"{blame_share_fitted:.3f}")
+
     gain = t_default / t_fitted - 1.0
     result = {
         "t_default": t_default, "t_fitted": t_fitted,
@@ -1935,9 +2146,12 @@ def run_fitted(*, cfg, params, n, slots, chunk, page_size, max_budget,
         "ladder_fitted": list(fitted_ladder),
         "expected_padding_default": pad_default,
         "expected_padding_fitted": pad_fit,
+        "blame_segment": blame["dominant"],
+        "blame_share_default": blame_share_default,
+        "blame_share_fitted": blame_share_fitted,
         "config_sections": sorted(
             k for k in ("ladder", "residency", "placement",
-                        "autoscaler") if fitted.get(k)),
+                        "autoscaler", "blame") if fitted.get(k)),
     }
     out(f"autofit: n={n} slots={slots} chunk={chunk} "
         f"lengths={sorted(set(obs_lengths))} tokens={total_tokens} "
@@ -1951,6 +2165,10 @@ def run_fitted(*, cfg, params, n, slots, chunk, page_size, max_budget,
     out(f"  autofit gain {gain:+.1%} wall clock, E[pad] "
         f"{pad_default:.1f} -> {pad_fit:.1f} tokens/req "
         "(oracle-exact, strict padding win asserted)")
+    out(f"  blame   : {blame['axis']}.{blame['dominant']} "
+        f"p99-band share {blame_share_default:.0%} -> "
+        f"{blame_share_fitted:.0%} under "
+        f"{blame['actions']} (strict shrink asserted)")
     return result
 
 
@@ -2034,6 +2252,15 @@ def main():
         else:
             run_elastic(**elastic_full_config(
                 jax.default_backend() == "tpu"))
+        return
+    if arg("slo-budget", False, bool):
+        # one shape on every backend: the row's value is the seeded
+        # attribution assert (chaos lands in its own budget bucket),
+        # not throughput — the injected delay dwarfs the model math
+        # either way. NOT --budget: that flag is the plain row's
+        # token budget.
+        run_slo_budget(**slo_budget_smoke_config(),
+                       explain=arg("explain", False, bool))
         return
     if arg("fit", False, bool):
         if arg("smoke", False, bool):

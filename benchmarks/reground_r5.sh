@@ -203,6 +203,20 @@ run "serve leg with reqtrace record" \
 run "explain from the run log" \
   python -m hpc_patterns_tpu.harness.explain "${LOG%.log}_reqtrace.jsonl"
 
+# 4j. SEGMENT-BUDGET row (round 20): the attribution loop closed, on
+#     chip. A seeded slow_host_transfer through a thrashing
+#     2-resident tier must breach the prefetch_wait budget line and
+#     NO other (run_slo_budget asserts the breach set in-run — chaos
+#     lands in the bucket it was injected into), and --explain
+#     renders the inter-token TPOT-tail table (the digest past
+#     t_first) next to the step 4i TTFT table. tpot_p99_stall_share
+#     and budget_breach_segments are the gated keys
+#     (harness/regress.py); the --fit row above already asserted the
+#     blamed segment's share strictly shrinks under the blame-fitted
+#     residency, so this leg is the breach-side artifact.
+run "serving segment budgets (seeded breach + TPOT tail)" \
+  python benchmarks/bench_serving.py --slo-budget --explain=1
+
 # 5. aligned speculative pair + gamma sweep + batched impls (item 4, 7)
 run "make draft pair" python benchmarks/make_draft_pair.py --out=benchmarks/pair_r5
 run "speculative aligned sweep" python benchmarks/bench_speculative.py --pair=benchmarks/pair_r5 --batched=8

@@ -11,7 +11,7 @@ already writes (``kind=serve_admit`` / ``kind=trace`` /
 config *derived from* the run, the first-touch idea of automatic
 data-movement tuning applied to our serving tiers.
 
-Four independent fitters, each deterministic and pure (no RNG, no
+Five independent fitters, each deterministic and pure (no RNG, no
 timestamps, no device dispatch — same records in, bit-identical JSON
 out):
 
@@ -28,7 +28,18 @@ out):
   attainment/queue trajectory (``kind=plane_attainment`` records, the
   sliding-window gauge both planes emit) through the pure
   :class:`~hpc_patterns_tpu.serving_plane.autoscaler.Autoscaler`
-  offline and keeping the candidate that never flaps.
+  offline and keeping the candidate that never flaps;
+- **blame** — acts on *why* the tail happened, not just on raw
+  signals: the pooled attribution digest (``kind=reqtrace`` records
+  through :func:`harness.explain.digest`) names the dominant p99-band
+  segment, and the fitter maps blame to a knob — ``prefetch_wait``
+  refits the prefetch depth from the wait-overlap structure
+  (stacked waits cap at one in-flight pull; serialized waits deepen
+  to the parked-row peak), ``queued`` widens the autoscaler band
+  (scale up at a shallower backlog), ``admit_wait`` recommends a
+  higher admission high-water. Blame overrides the signal fit where
+  both speak: the digest sees the REQUEST's wait, the trace only
+  sees the transfer.
 
 A section whose signals are absent from the input is emitted as
 ``null`` — consumers fall back to their defaults, so a config fitted
@@ -66,6 +77,12 @@ THRASH_PULLS_PER_SEQ = 1.5  # pulls/seq above this = re-eviction churn
 MIN_OVERLAP_FOR_DEPTH = 0.2  # exposed pulls => depth 1, don't stack
 ROUND_ROBIN_MAX_SKEW = 1.25  # weight skew below this: uniform is fine
 MIN_TRAJECTORY_ROUNDS = 4   # fewer observed rounds fit nothing
+MIN_BLAME_SHARE = 0.25      # a band share below this blames nobody
+MAX_BLAME_DEPTH = 8         # deepened prefetch depth is still bounded
+BLAME_RESIDENT_ROUNDS = 8   # blamed churn escalates the anti-thrash
+                            # floor to this: long enough that a
+                            # bench-scale decode finishes its stint
+                            # instead of paying an exposed pull mid-way
 
 
 # ---------------------------------------------------------------------------
@@ -444,6 +461,115 @@ def fit_autoscaler(records) -> dict[str, Any] | None:
 
 
 # ---------------------------------------------------------------------------
+# blame: the attribution digest becomes a knob
+
+
+def _segment_intervals(snaps, kinds) -> list[tuple[float, float]]:
+    """Canonically-tiled ``(start, end)`` intervals of the given
+    segment kinds across every request in the reqtrace snapshots —
+    the overlap structure :func:`fit_blame` reads depth from."""
+    from hpc_patterns_tpu.harness import reqtrace as reqtracelib
+
+    out: list[tuple[float, float]] = []
+    for snap in snaps:
+        for entry in (snap.get("requests") or {}).values():
+            t_submit = entry.get("t_submit")
+            t_finish = entry.get("t_finish")
+            if t_submit is None or t_finish is None:
+                continue
+            tiled, _ = reqtracelib.finalize(
+                entry.get("segments") or (), t_submit, t_finish)
+            out.extend((float(s0), float(s1))
+                       for kind, s0, s1, _meta in tiled
+                       if kind in kinds and s1 > s0)
+    return sorted(out)
+
+
+def fit_blame(records) -> dict[str, Any] | None:
+    """Blame-driven fitting: digest the run's ``kind=reqtrace``
+    records (harness/explain.py) and map the dominant p99-band
+    segment to a config action. Candidates and rules (deterministic):
+
+    - ``prefetch_wait`` dominating the pooled p99 *inter-token gap*
+      band → the decode tail is paying for mid-decode churn: escalate
+      the anti-thrash floor to ``BLAME_RESIDENT_ROUNDS`` (a resident
+      row finishes its stint instead of paging out and paying an
+      exposed pull to come back) and refit the prefetch depth from
+      the wait overlap — waits that STACK (peak concurrency ≥ 2)
+      mean exposed transfers piled onto one host, cap at one
+      in-flight pull; waits that never overlap while rows sit parked
+      mean the serializing depth IS the stall, deepen to the
+      parked-row peak (bounded by ``MAX_BLAME_DEPTH``);
+    - ``queued`` dominating the pooled p99 *TTFT* band → widen the
+      autoscaler band: scale up at a backlog of 1 (the tail already
+      proved the queue is where the time goes);
+    - ``admit_wait`` dominating the pooled p99 TTFT band → recommend
+      the full admission high-water (stop holding arena back from a
+      tail that is waiting on it).
+
+    Precedence is fixed, not max-share: a decode-phase stall
+    mechanism outranks the TTFT candidates, because ``queued``
+    dominating the TTFT band is the DEFAULT look of any saturated
+    open-loop stream while a stall-dominated inter-token band is the
+    rarer, sharper finding. A share below ``MIN_BLAME_SHARE`` blames
+    nobody (empty actions). Returns None when the input has no
+    reqtrace records at all.
+    """
+    from hpc_patterns_tpu.harness import explain as explainlib
+
+    snaps = [r for r in records if r.get("kind") == "reqtrace"]
+    if not snaps:
+        return None
+    dig = explainlib.digest(snaps, worst_n=0)
+    ttft_band = dig.get("ttft_p99_band_shares") or {}
+    tpot_band = dig.get("tpot_p99_band_shares") or {}
+    candidates = {
+        "tpot.prefetch_wait": float(tpot_band.get("prefetch_wait",
+                                                  0.0)),
+        "ttft.queued": float(ttft_band.get("queued", 0.0)),
+        "ttft.admit_wait": float(ttft_band.get("admit_wait", 0.0)),
+    }
+    axis = dominant = None
+    share = 0.0
+    for key in ("tpot.prefetch_wait", "ttft.queued",
+                "ttft.admit_wait"):
+        if candidates[key] >= MIN_BLAME_SHARE:
+            axis, dominant = key.split(".", 1)
+            share = candidates[key]
+            break
+    actions: dict[str, Any] = {}
+    waits: dict[str, Any] = {}
+    if dominant == "prefetch_wait":
+        wait_iv = _segment_intervals(snaps, ("prefetch_wait",))
+        parked_iv = _segment_intervals(
+            snaps, ("prefetch_wait", "swapped_out"))
+        stacked = _max_concurrency(wait_iv)
+        parked = _max_concurrency(parked_iv)
+        actions["min_resident_rounds"] = BLAME_RESIDENT_ROUNDS
+        actions["prefetch_depth"] = (
+            1 if stacked >= 2
+            else max(2, min(MAX_BLAME_DEPTH, parked)))
+        waits = {"stacked_waits_peak": stacked,
+                 "parked_rows_peak": parked}
+    elif dominant == "queued":
+        actions["up_queue"] = 1
+    elif dominant == "admit_wait":
+        actions["admit_highwater"] = 1.0
+    return {
+        "axis": axis,
+        "dominant": dominant,
+        "share": round(float(share), 6),
+        "candidates": {k: round(v, 6)
+                       for k, v in sorted(candidates.items())},
+        "actions": actions,
+        "observed": {"n_requests": int(dig.get("n") or 0),
+                     "tpot_p99_stall_share": round(float(
+                         dig.get("tpot_p99_stall_share") or 0.0), 6),
+                     **waits},
+    }
+
+
+# ---------------------------------------------------------------------------
 # the FittedConfig
 
 
@@ -454,6 +580,29 @@ def fit(records, *, rollups=None) -> dict[str, Any]:
     residency = fit_residency(records)
     placement = fit_placement(records, rollups)
     autoscaler = fit_autoscaler(records)
+    blame = fit_blame(records)
+    # blame overrides the signal fit where both speak: the trace only
+    # proves the transfer was exposed; the digest proves a request's
+    # p99 PAID for it — act on the latter
+    if blame and residency is not None \
+            and blame["actions"].get("prefetch_depth") is not None:
+        residency = dict(residency,
+                         prefetch_depth=blame["actions"][
+                             "prefetch_depth"])
+    if blame and residency is not None \
+            and blame["actions"].get("min_resident_rounds") is not None:
+        residency = dict(residency,
+                         min_resident_rounds=max(
+                             int(residency.get(
+                                 "min_resident_rounds") or 1),
+                             int(blame["actions"][
+                                 "min_resident_rounds"])))
+    if blame and autoscaler is not None \
+            and blame["actions"].get("up_queue") is not None:
+        autoscaler = dict(autoscaler,
+                          up_queue=min(int(autoscaler["up_queue"]),
+                                       int(blame["actions"][
+                                           "up_queue"])))
     return {
         "version": FITTED_VERSION,
         "kind": FITTED_KIND,
@@ -470,12 +619,15 @@ def fit(records, *, rollups=None) -> dict[str, Any]:
             "n_plane_attainment": sum(
                 1 for r in records
                 if r.get("kind") == "plane_attainment"),
+            "n_reqtrace": sum(
+                1 for r in records if r.get("kind") == "reqtrace"),
             "rollups": bool(rollups),
         },
         "ladder": ladder,
         "residency": residency,
         "placement": placement,
         "autoscaler": autoscaler,
+        "blame": blame,
     }
 
 
@@ -566,7 +718,8 @@ def main(argv=None) -> int:
     if args.emit:
         Path(args.emit).write_text(text)
         sections = [k for k in ("ladder", "residency", "placement",
-                                "autoscaler") if fitted.get(k)]
+                                "autoscaler", "blame")
+                    if fitted.get(k)]
         print(f"fitted config -> {args.emit} "
               f"(sections: {', '.join(sections) or 'none'})")
     else:

@@ -19,14 +19,26 @@ The tail band is the class's requests with TTFT at or above the exact
 p99 (numpy over raw values, the harness/slo.py discipline — at bench
 scale that is "the worst few requests", which is the point).
 
-Two numbers feed the bench gate (harness/regress.py):
+Attribution does NOT stop at the first token: the **inter-token
+digest** tiles the same canonical segments over every gap between
+consecutive token-availability stamps (``token_ts`` in the stats
+table, stamped at chunk readback by models/serving.py) inside
+``[t_first, t_finish]`` — so a decode-phase stall (a swap, a pull, a
+preemption, a migration) is blamed on the mechanism that caused it
+instead of vanishing into a fat TPOT mean. The gap band is the gaps
+at/above the exact pooled p99 of gap width.
+
+Three numbers feed the bench gate (harness/regress.py):
 
 - ``coverage_frac`` — 1 - untracked share over all finished requests
   (gated HIGHER with tight slack: attribution that quietly loses
   coverage is worse than no attribution);
 - ``ttft_p99_queue_share`` — queued share of the pooled p99 band's
   TTFT windows (captured per round; the single scalar that says
-  whether the tail is a scheduling problem or a compute problem).
+  whether the tail is a scheduling problem or a compute problem);
+- ``tpot_p99_stall_share`` — the :data:`TPOT_STALL_KINDS` share of
+  the pooled p99 inter-token gap band (the single scalar that says
+  whether the decode tail is the model or the memory/control plane).
 
 Usage::
 
@@ -54,6 +66,66 @@ from hpc_patterns_tpu.harness.report import load_records
 
 #: how many worst-TTFT requests the digest itemizes by default
 WORST_N = 5
+
+#: decode-phase segment kinds the inter-token digest counts as STALL
+#: time — everything that is not the row making forward progress (or
+#: the explicit unclaimed remainder). ``decode``/``prefill`` in a gap
+#: is compute; these are the mechanisms a fitter can act on.
+TPOT_STALL_KINDS = ("preempted", "swapped_out", "prefetch_wait",
+                    "migrating", "untracked")
+
+
+def _decode_gaps(entry: Mapping[str, Any]) -> list[tuple[float, float]]:
+    """Inter-token windows of one request: consecutive pairs of token
+    availability stamps, clamped to ``[t_first, t_finish]``. Empty for
+    shed rows (no tokens), single-token responses (no gap), and legacy
+    snapshots without ``token_ts``."""
+    ts = entry.get("token_ts") or ()
+    t_first, t_finish = entry.get("t_first"), entry.get("t_finish")
+    if t_first is None or t_finish is None or len(ts) < 2:
+        return []
+    lo, hi = float(t_first), float(t_finish)
+    pts = sorted(min(max(float(t), lo), hi) for t in ts)
+    return [(a, b) for a, b in zip(pts, pts[1:]) if b - a > 0]
+
+
+def _gap_rows(entry: Mapping[str, Any]
+              ) -> list[tuple[dict[str, float], float]]:
+    """``(shares, width_s)`` per inter-token gap of one request —
+    the same canonical :func:`reqtrace.finalize` tiling the TTFT
+    window uses, intersected with each gap, so shares per gap sum to
+    exactly 1.0 (a gap fully inside one stamped ``decode`` span is
+    100% decode — honest: the chunk was simply slow)."""
+    gaps = _decode_gaps(entry)
+    if not gaps:
+        return []
+    tiled, _ = reqtrace.finalize(entry.get("segments") or (),
+                                 entry["t_submit"], entry["t_finish"])
+    rows: list[tuple[dict[str, float], float]] = []
+    for g0, g1 in gaps:
+        width = g1 - g0
+        shares: dict[str, float] = {}
+        for kind, s0, s1, _meta in tiled:
+            ov = min(s1, g1) - max(s0, g0)
+            if ov > 0:
+                shares[kind] = shares.get(kind, 0.0) + ov / width
+        rows.append((shares, width))
+    return rows
+
+
+def _gap_band(rows: list[tuple[dict[str, float], float]]) -> tuple[
+        list[tuple[dict[str, float], float]], float | None]:
+    """Gaps at/above the exact p99 of gap width (the slo.py numpy
+    discipline, same as the TTFT band)."""
+    if not rows:
+        return [], None
+    widths = np.asarray([w for _, w in rows], np.float64)
+    p99 = float(np.percentile(widths, 99.0))
+    return [r for r in rows if r[1] >= p99], p99
+
+
+def _stall_share(shares: Mapping[str, float]) -> float:
+    return float(sum(shares.get(k, 0.0) for k in TPOT_STALL_KINDS))
 
 
 def _window_shares(entry: Mapping[str, Any]) -> tuple[
@@ -105,12 +177,17 @@ def digest(snapshots: Iterable[Mapping[str, Any]],
         requests.update(snap.get("requests") or {})
 
     per_req: list[dict[str, Any]] = []
+    gap_rows_by_prio: dict[int, list[tuple[dict[str, float], float]]] \
+        = {}
     untracked_s = span_s = 0.0
     for sid, entry in requests.items():
         ws = _window_shares(entry)
         if ws is None:
             continue
         shares, window, _ = ws
+        prio_key = int(entry.get("priority") or 0)
+        gap_rows_by_prio.setdefault(prio_key, []).extend(
+            _gap_rows(entry))
         ttft = (float(entry["t_first"]) - float(entry["t_submit"])
                 if entry.get("t_first") is not None else None)
         span = float(entry["t_finish"]) - float(entry["t_submit"])
@@ -139,6 +216,26 @@ def digest(snapshots: Iterable[Mapping[str, Any]],
         return [r for r in rows
                 if r["ttft_s"] is not None and r["ttft_s"] >= p99], p99
 
+    def _tpot(rows: list[tuple[dict[str, float], float]]
+              ) -> dict[str, Any]:
+        """The inter-token-tail table for one pool of gaps."""
+        widths = [w for _, w in rows]
+        band, p99 = _gap_band(rows)
+        band_shares = _merge_shares(band)
+        span_shares = _merge_shares(rows)
+        return {
+            "n_gaps": len(rows),
+            "n_band": len(band),
+            "gap": ({"p50": float(np.percentile(widths, 50.0)),
+                     "p95": float(np.percentile(widths, 95.0)),
+                     "p99": p99} if widths else
+                    {"p50": None, "p95": None, "p99": None}),
+            "band_shares": band_shares,
+            "band_stall_share": _stall_share(band_shares),
+            "span_shares": span_shares,
+            "span_stall_share": _stall_share(span_shares),
+        }
+
     classes: dict[int, dict[str, Any]] = {}
     for prio in sorted({r["priority"] for r in per_req}):
         rows = [r for r in per_req if r["priority"] == prio]
@@ -155,11 +252,14 @@ def digest(snapshots: Iterable[Mapping[str, Any]],
                 [(r["shares"], r["window_s"]) for r in band]),
             "span_shares": _merge_shares(
                 [(r["shares"], r["window_s"]) for r in rows]),
+            "tpot": _tpot(gap_rows_by_prio.get(prio, [])),
         }
 
     pooled_band, _ = _band(per_req)
     pooled = _merge_shares([(r["shares"], r["window_s"])
                             for r in pooled_band])
+    pooled_tpot = _tpot([g for rows in gap_rows_by_prio.values()
+                         for g in rows])
     worst = sorted(per_req,
                    key=lambda r: -(r["ttft_s"] if r["ttft_s"]
                                    is not None else r["span_s"]))
@@ -168,6 +268,10 @@ def digest(snapshots: Iterable[Mapping[str, Any]],
         "coverage_frac": (1.0 - untracked_s / span_s
                           if span_s > 0 else 1.0),
         "ttft_p99_queue_share": pooled.get("queued", 0.0),
+        "ttft_p99_band_shares": pooled,
+        "tpot_p99_stall_share": pooled_tpot["band_stall_share"],
+        "tpot_p99_band_shares": pooled_tpot["band_shares"],
+        "tpot": pooled_tpot,
         "classes": classes,
         "worst": worst[:max(0, int(worst_n))],
     }
@@ -183,13 +287,25 @@ def _ms(v: float | None) -> str:
     return "-" if v is None else f"{v * 1e3:.0f}ms"
 
 
+def _dominant(shares: Mapping[str, float]) -> str:
+    """``"61% queued"`` for the band's biggest segment — whatever kind
+    it is (a prefetch_wait-dominated band must not be summarized as
+    "queue share 0%"); ``_merge_shares`` already sorted descending."""
+    for kind, frac in shares.items():
+        return f"{frac:.0%} {kind}"
+    return "none"
+
+
 def format_explain(dig: Mapping[str, Any]) -> str:
     """The human table the ``--explain`` surfaces print after the
     goodput row (same fixed-layout style as slo.format_slo)."""
     lines = [
         f"request forensics  n={dig['n']}  "
         f"coverage {dig['coverage_frac']:.1%}  "
-        f"p99-band queue share {dig['ttft_p99_queue_share']:.0%}"]
+        f"p99-band dominant "
+        f"{_dominant(dig.get('ttft_p99_band_shares') or {})}  "
+        f"tpot-p99 stall share "
+        f"{dig.get('tpot_p99_stall_share', 0.0):.0%}"]
     for prio, cls in sorted(dig["classes"].items()):
         t = cls["ttft"]
         lines.append(
@@ -200,6 +316,16 @@ def format_explain(dig: Mapping[str, Any]) -> str:
             f"{_fmt_shares(cls['band_shares'])}")
         lines.append(f"    all requests:  "
                      f"{_fmt_shares(cls['span_shares'])}")
+        tp = cls.get("tpot") or {}
+        if tp.get("n_gaps"):
+            g = tp["gap"]
+            lines.append(
+                f"    inter-token gaps n={tp['n_gaps']}  p50/p95/p99 "
+                f"{_ms(g['p50'])}/{_ms(g['p95'])}/{_ms(g['p99'])}")
+            lines.append(
+                f"    p99-gap band (n={tp['n_band']}, stall "
+                f"{tp['band_stall_share']:.0%}): "
+                f"{_fmt_shares(tp['band_shares'])}")
     if dig["worst"]:
         lines.append("  worst requests by TTFT:")
         for r in dig["worst"]:

@@ -210,6 +210,21 @@ SPECS: tuple[MetricSpec, ...] = (
     MetricSpec("detail.ttft_p99_queue_share", "lower", gated=False,
                abs_slack=0.10,
                label="ttft_p99_queue_share (tail attribution)"),
+    # the segment-budget row (bench_serving --slo-budget, round 20):
+    # the stall share is what fraction of the pooled p99 inter-token
+    # gap band the seeded slow_host_transfer run spends in decode-
+    # stall segments — seeded physics, but the share rides scheduler
+    # timing on a shared CI box, so the band is wide; it GROWING past
+    # the slack means decode stalls got structurally worse (or a new
+    # stall mechanism joined the band). The breach-segment count is
+    # structural: the row asserts the set is exactly {prefetch_wait}
+    # in-run, so any count above 1 means attribution smeared out of
+    # the injected mechanism — zero slack.
+    MetricSpec("detail.tpot_p99_stall_share", "lower",
+               abs_slack=0.15,
+               label="tpot_p99_stall_share (inter-token tail)"),
+    MetricSpec("detail.budget_breach_segments", "lower",
+               abs_slack=0.0),
 )
 
 

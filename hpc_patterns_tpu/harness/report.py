@@ -65,6 +65,7 @@ def aggregate(records: list[dict[str, Any]]) -> dict[str, Any]:
     merged_traces: list[dict[str, Any]] = []
     analyses: list[dict[str, Any]] = []
     reqtraces: list[dict[str, Any]] = []
+    budgets: list[dict[str, Any]] = []
     n_ok = n_bad = n_snapshots = n_layout_skipped = 0
     for rec in records:
         kind = rec.get("kind", "?")
@@ -108,6 +109,20 @@ def aggregate(records: list[dict[str, Any]]) -> dict[str, Any]:
             reqtraces.append({
                 "n": rec.get("n", 0),
                 "coverage_frac": rec.get("coverage_frac"),
+            })
+        if kind == "slo_budget":
+            # segment SLO budget breach (harness/budget.py): one row
+            # per over-budget (class, axis, segment) — rendered as
+            # the per-class breach table next to the percentile tables
+            budgets.append({
+                "priority": rec.get("priority", 0),
+                "axis": rec.get("axis", "?"),
+                "segment": rec.get("segment", "?"),
+                "share": rec.get("share"),
+                "allowance_s": rec.get("allowance_s"),
+                "n": rec.get("n", 0),
+                "breached": rec.get("breached", 0),
+                "worst_s": rec.get("worst_s"),
             })
         if kind == "trace":
             # flight-recorder snapshot (harness/trace.py): summarize
@@ -156,6 +171,7 @@ def aggregate(records: list[dict[str, Any]]) -> dict[str, Any]:
         "merged_traces": merged_traces,
         "analyses": analyses,
         "reqtraces": reqtraces,
+        "budgets": budgets,
         "n_snapshots": n_snapshots,
         "n_layout_skipped": n_layout_skipped,
         "results": (n_ok, n_bad),
@@ -247,6 +263,27 @@ def format_report(agg: dict[str, Any], source: str = "") -> str:
             f"reqtrace: {t['n']} request(s), attribution coverage "
             + (f"{cov:.1%}" if cov is not None else "-")
             + " — attribute: python -m hpc_patterns_tpu.harness.explain")
+    if agg.get("budgets"):
+        # the per-class breach table (harness/budget.py): which
+        # lifecycle segment alone blew the class's TTFT/TPOT target
+        lines.append(f"slo budget breaches: {len(agg['budgets'])} "
+                     "(class axis segment: worst/allowance, count)")
+        lines.append(f"  {'class':<6} {'axis':<5} {'segment':<14} "
+                     f"{'share':>6} {'allowance':>10} {'worst':>10} "
+                     f"{'count':>8}")
+        for b in sorted(agg["budgets"],
+                        key=lambda b: (b["priority"], b["axis"],
+                                       -(b.get("worst_s") or 0.0))):
+            share = (f"{b['share']:.0%}"
+                     if b.get("share") is not None else "-")
+            allow = (f"{b['allowance_s'] * 1e3:.0f}ms"
+                     if b.get("allowance_s") is not None else "-")
+            worst = (f"{b['worst_s'] * 1e3:.0f}ms"
+                     if b.get("worst_s") is not None else "-")
+            lines.append(
+                f"  {b['priority']:<6} {b['axis']:<5} "
+                f"{b['segment']:<14} {share:>6} {allow:>10} "
+                f"{worst:>10} {b['breached']:>4}/{b['n']:<3}")
     for t in agg.get("traces", []):
         cats = ", ".join(f"{k}={n}" for k, n in sorted(t["by_cat"].items()))
         comp = t.get("compile", {})

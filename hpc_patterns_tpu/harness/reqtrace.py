@@ -233,6 +233,12 @@ class ReqTrace:
                 "preemptions": rec.get("preemptions", 0),
                 "segments": ([list(s) for s in segs]
                              if segs is not None else None),
+                # per-token availability instants (models/serving.py
+                # collect readbacks) — None on a legacy stats table;
+                # harness/explain.py tiles decode-phase stalls over
+                # the gaps between consecutive stamps
+                "token_ts": (list(rec["token_ts"])
+                             if rec.get("token_ts") else None),
             }
             if rec.get("replica") is not None:
                 entry["replica"] = rec["replica"]

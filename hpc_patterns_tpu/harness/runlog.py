@@ -15,6 +15,13 @@ harness/trace.py; exported to a Chrome-trace timeline by
 ``python -m hpc_patterns_tpu.harness.trace``). Both append (never
 truncate), so the app's own records survive — the structured analog of
 run.sh's trailing grep summary.
+
+Forensic vs dispatched kinds: ``FORENSIC_KINDS`` below lists the
+record kinds nothing string-dispatches on. Kinds a consumer DOES
+dispatch on stay off that list — e.g. ``kind=slo_budget``
+(harness/budget.py breach records), which ``harness.report`` collects
+into the per-class breach table; declaring it forensic would hide the
+producer/consumer edge contractlint verifies.
 """
 
 from __future__ import annotations

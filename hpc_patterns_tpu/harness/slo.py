@@ -19,6 +19,13 @@ targets hold (a ``None`` target is trivially attained). Shed requests
 — dropped by admission control before serving — count against
 attainment but contribute zero tokens.
 
+Attainment says WHETHER a class met its targets; it does not say
+which mechanism ate the time when it did not. ``harness/budget.py``
+splits the same two targets into per-segment allowances (shares of
+TTFT/TPOT a lifecycle segment may consume) and emits a breach record
+per segment that overspends — the budget layer on top of the verdict
+this module renders.
+
 The input is the serving engine's per-request stats table
 (``ContinuousBatcher.stats``: ``t_submit``/``t_first``/``t_finish``/
 ``tokens``/``priority``/``outcome``/``preemptions`` per request).

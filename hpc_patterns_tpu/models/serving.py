@@ -1184,7 +1184,7 @@ class EngineCore:
         self.stats[sid] = {
             "priority": int(priority), "t_submit": now, "t_first": None,
             "t_finish": None, "tokens": 0, "outcome": None,
-            "preemptions": 0,
+            "preemptions": 0, "token_ts": [],
         }
         rtr = reqtracelib.active()
         if rtr is not None:
@@ -1503,6 +1503,11 @@ class EngineCore:
             resumed = bool(st.prefix)
             if rec_s is not None and rec_s["t_first"] is None:
                 rec_s["t_first"] = now
+            if rec_s is not None:
+                # first-token availability instant (the inter-token
+                # digest's window endpoints; a resume stamps only its
+                # NEW token — prefix stamps rode the earlier life)
+                rec_s.setdefault("token_ts", []).append(now)
             rtr = reqtracelib.active()
             if rtr is not None:
                 rtr.stamp_transition(st.seq_id, "decode", now)
@@ -1788,6 +1793,11 @@ class EngineCore:
             rec.mark_complete("serve.chunk", t_disp,
                               {"chunk": self.chunk, "rows": len(parts)})
         limit_new = np.asarray(self.limit)
+        # the chunk's tokens all became host-visible at THIS readback —
+        # one shared availability instant (honest: intra-chunk device
+        # timing is invisible; the inter-token digest tiles stall
+        # segments over the gaps BETWEEN these instants)
+        now = time.perf_counter()
         for i in parts:
             st = self._slots[i]
             if not st.active:
@@ -1795,6 +1805,9 @@ class EngineCore:
             valid = int(np.clip(limit_new[i] - pos_start[i], 0,
                                 self.chunk))
             st.out.extend(int(t) for t in out[:valid, i])
+            rec_s = self.stats.get(st.seq_id)
+            if rec_s is not None and valid:
+                rec_s.setdefault("token_ts", []).extend([now] * valid)
             if pos_start[i] + valid >= limit_new[i]:
                 self._finish(i)
 
@@ -1839,14 +1852,20 @@ class EngineCore:
                                "rows": len(parts)})
         pos_np = np.asarray(self.pos)
         limit_np = np.asarray(self.limit)
+        now = time.perf_counter()
         for i in parts:
             st = self._slots[i]
             if not st.active:
                 continue
+            accepted = 0
             for k in range(advs.shape[0]):
                 v = int(advs[k, i])
                 if v:
                     st.out.extend(int(t) for t in emits[k, i, :v])
+                    accepted += v
+            rec_s = self.stats.get(st.seq_id)
+            if rec_s is not None and accepted:
+                rec_s.setdefault("token_ts", []).extend([now] * accepted)
             if pos_np[i] >= limit_np[i]:
                 self._finish(i)
 
@@ -2237,11 +2256,18 @@ class EngineCore:
             # warm this engine's index with the installed chain: the
             # next same-rung prompt sharing the prefix maps it here
             self._insert_prefix(prompt, int(bundle.rung), pages)
+        prior = self.stats.get(bundle.seq_id)
         self.stats[bundle.seq_id] = {
             "priority": bundle.priority, "t_submit": bundle.t_submit,
             "t_first": bundle.t_first, "t_finish": None,
             "tokens": 0, "outcome": None,
             "preemptions": bundle.preemptions,
+            # token availability stamps survive a LOCAL swap-out/in (the
+            # gap across the stall is exactly what the inter-token
+            # digest tiles); a migration install starts empty — the
+            # donor's stamps are engine-local wall clock, not wire state
+            "token_ts": list(prior.get("token_ts") or [])
+            if prior is not None else [],
         }
         rtr = reqtracelib.active()
         if rtr is not None:

@@ -51,6 +51,7 @@ from pathlib import Path
 from hpc_patterns_tpu.analysis import runtime as analysis_runtime
 from hpc_patterns_tpu.harness import chaos as chaoslib
 from hpc_patterns_tpu.harness import metrics as metricslib
+from hpc_patterns_tpu.harness import budget as budgetlib
 from hpc_patterns_tpu.harness import reqtrace as reqtracelib
 from hpc_patterns_tpu.harness import slo as slolib
 from hpc_patterns_tpu.harness import trace as tracelib
@@ -1141,6 +1142,16 @@ class PlaneRouter:
         wall = time.perf_counter() - t0
         self.last_slo = slolib.attainment(
             self.stats, self.slo_targets, wall)
+        # segment SLO budgets (harness/budget.py): when the run was
+        # request-traced AND judged against targets, say WHICH
+        # lifecycle segment blew them — breach records ride the same
+        # RunLog as the attainment rollup, next to the reqtrace record
+        self.last_budget: list = []
+        rtr = reqtracelib.active()
+        if rtr is not None and self.slo_targets:
+            self.last_budget = budgetlib.evaluate(
+                rtr.snapshot(self.stats), self.slo_targets)
+            budgetlib.publish(self.last_budget, emit=self._emit)
         return {
             "wall_s": wall,
             "n": len(self.stats),
@@ -1151,4 +1162,5 @@ class PlaneRouter:
             "resumed": sorted(set(self.resumed)),
             "migrations": self.migrations,
             "slo": self.last_slo,
+            "budget_breaches": len(self.last_budget),
         }

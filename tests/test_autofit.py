@@ -274,6 +274,111 @@ class TestConsumers:
         assert mgr.prefetch_depth == 2
 
 
+def stall_entry(*, queued_to=1.0, wait=(1.1, 2.9), t_finish=3.0,
+                token_ts=(1.0, 1.1, 3.0), priority=0):
+    """One finished request whose inter-token tail is dominated by a
+    ``prefetch_wait`` span: token stamps at 1.0/1.1/then the finish,
+    with the wait segment filling (most of) the long gap."""
+    segs = [["queued", 0.0, queued_to, None],
+            ["decode", queued_to, wait[0], None],
+            ["prefetch_wait", wait[0], wait[1], None],
+            ["decode", wait[1], t_finish, None]]
+    return {"priority": priority, "t_submit": 0.0,
+            "t_first": float(token_ts[0]), "t_finish": t_finish,
+            "tokens": len(token_ts), "outcome": "ok",
+            "preemptions": 0, "segments": segs,
+            "token_ts": list(token_ts)}
+
+
+def reqtrace_rec(entries):
+    return {"kind": "reqtrace", "n": len(entries),
+            "coverage_frac": 1.0,
+            "requests": {str(i): e for i, e in enumerate(entries)}}
+
+
+class TestBlameFit:
+    def test_decode_stall_outranks_the_queued_ttft_shape(self):
+        # queued fills the ENTIRE TTFT window (share 1.0, the default
+        # look of any saturated open-loop stream) yet the decode-phase
+        # stall still wins: precedence, not max-share
+        blame = autofit.fit_blame([reqtrace_rec([stall_entry()])])
+        assert blame["axis"] == "tpot"
+        assert blame["dominant"] == "prefetch_wait"
+        assert blame["candidates"]["ttft.queued"] == pytest.approx(
+            1.0, abs=1e-6)
+        assert blame["share"] >= autofit.MIN_BLAME_SHARE
+
+    def test_stall_actions_raise_the_antithrash_floor(self):
+        blame = autofit.fit_blame([reqtrace_rec([stall_entry()])])
+        assert blame["actions"]["min_resident_rounds"] \
+            == autofit.BLAME_RESIDENT_ROUNDS
+        # one parked row, no stacked waits -> deepen (floor 2)
+        assert blame["actions"]["prefetch_depth"] == 2
+        assert blame["observed"]["stacked_waits_peak"] == 1
+
+    def test_stacked_waits_cap_depth_at_one(self):
+        # two requests whose wait spans overlap in wall time: exposed
+        # transfers piled onto one host, the fit serializes them
+        entries = [stall_entry(), stall_entry(wait=(1.2, 2.8))]
+        blame = autofit.fit_blame([reqtrace_rec(entries)])
+        assert blame["dominant"] == "prefetch_wait"
+        assert blame["observed"]["stacked_waits_peak"] == 2
+        assert blame["actions"]["prefetch_depth"] == 1
+
+    def test_no_decode_stall_blames_the_queue(self):
+        # same request with the stall segment replaced by decode and
+        # an even token cadence: only the TTFT queued share is left
+        e = stall_entry()
+        e["segments"] = [["queued", 0.0, 1.0, None],
+                         ["decode", 1.0, 3.0, None]]
+        e["token_ts"] = [1.0, 2.0, 3.0]
+        blame = autofit.fit_blame([reqtrace_rec([e])])
+        assert (blame["axis"], blame["dominant"]) == ("ttft", "queued")
+        assert blame["actions"] == {"up_queue": 1}
+
+    def test_admit_wait_blamed_when_queued_is_quiet(self):
+        e = stall_entry()
+        e["segments"] = [["queued", 0.0, 0.1, None],
+                         ["admit_wait", 0.1, 1.0, None],
+                         ["decode", 1.0, 3.0, None]]
+        e["token_ts"] = [1.0, 2.0, 3.0]
+        blame = autofit.fit_blame([reqtrace_rec([e])])
+        assert blame["dominant"] == "admit_wait"
+        assert blame["actions"] == {"admit_highwater": 1.0}
+
+    def test_below_threshold_blames_nobody(self):
+        # every candidate under MIN_BLAME_SHARE: an untracked-heavy
+        # history with an even cadence leaves no segment dominant
+        e = stall_entry()
+        e["segments"] = [["queued", 0.0, 0.2, None],
+                         ["admit_wait", 0.2, 0.4, None]]
+        e["token_ts"] = [1.0, 2.0, 3.0]
+        blame = autofit.fit_blame([reqtrace_rec([e])])
+        assert blame["dominant"] is None and blame["axis"] is None
+        assert blame["actions"] == {}
+
+    def test_no_reqtrace_records_means_no_blame(self):
+        assert autofit.fit_blame(ladder_records()) is None
+        assert autofit.fit(ladder_records())["blame"] is None
+
+    def test_fit_threads_blame_into_the_residency_section(self):
+        # paging signals alone fit depth from the trace overlap; the
+        # digest proves a request's p99 PAID for the exposed pull, so
+        # the blame actions override the signal fit
+        recs = paging_records(overlap=True) \
+            + [reqtrace_rec([stall_entry()])]
+        fitted = autofit.fit(recs)
+        res = fitted["residency"]
+        assert res["min_resident_rounds"] \
+            == autofit.BLAME_RESIDENT_ROUNDS
+        assert res["prefetch_depth"] \
+            == fitted["blame"]["actions"]["prefetch_depth"]
+        assert fitted["source"]["n_reqtrace"] == 1
+        # the blamed fit is still deterministic, byte for byte
+        assert autofit.dumps_config(autofit.fit(recs)) \
+            == autofit.dumps_config(autofit.fit(recs))
+
+
 class TestABSmoke:
     def test_fitted_engine_does_not_lose_to_default(self):
         # the tier-1 A/B: run_fitted records an untimed leg under the
@@ -291,3 +396,9 @@ class TestABSmoke:
         assert (r["fitted_goodput_tok_s"]
                 >= r["default_goodput_tok_s"] * 0.85)
         assert "ladder" in r["config_sections"]
+        # the blame A/B rode along: the seeded decode stall was
+        # blamed (prefetch_wait, not the queued TTFT shape) and the
+        # blamed segment's p99-gap-band share strictly shrank under
+        # the blame-fitted residency (also asserted in-run)
+        assert r["blame_segment"] == "prefetch_wait"
+        assert r["blame_share_fitted"] < r["blame_share_default"]

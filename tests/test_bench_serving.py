@@ -226,3 +226,27 @@ def test_smoke_elastic_row_beats_static_and_reports_efficiency():
     for prio, pair in r["per_class_attainment"].items():
         if pair["static"] is not None and pair["elastic"] is not None:
             assert pair["elastic"] >= pair["static"], (prio, pair)
+
+
+def test_smoke_slo_budget_row_blames_the_injected_mechanism():
+    # the SEGMENT-BUDGET gate (round 20): a seeded slow_host_transfer
+    # through a thrashing 2-resident tier must breach the
+    # prefetch_wait budget line and NO other — chaos lands in the
+    # bucket it was injected into, nothing smears. run_slo_budget
+    # asserts the breach set, the nonzero inter-token stall share,
+    # and the oracle in-run; this pins the reported gate keys.
+    from benchmarks.bench_serving import (
+        run_slo_budget,
+        slo_budget_smoke_config,
+    )
+
+    r = run_slo_budget(**slo_budget_smoke_config(), quiet=True)
+    assert r["budget_breach_segments"] == ["prefetch_wait"]
+    assert r["budget_breaches"] == 1
+    assert 0.0 < r["tpot_p99_stall_share"] <= 1.0
+    assert r["attribution_coverage_frac"] >= 0.95
+    # the chaos actually did damage worth attributing: every pull ate
+    # the injected delay and the injected time dominates a clean serve
+    assert r["swap_outs"] > 0
+    assert r["stall_injections"] >= r["swap_outs"]
+    assert r["stall_injected_s"] > 0.0

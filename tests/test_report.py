@@ -261,3 +261,44 @@ class TestNoopGuard:
         # and report aggregates the app log end to end
         agg = report.aggregate(records)
         assert agg["counters"]["app.work"] == 7
+
+
+class TestBudgetRecords:
+    def test_kind_slo_budget_renders_the_breach_table(self, tmp_path,
+                                                      capsys):
+        # budget.publish writes one kind=slo_budget record per
+        # breached (class, axis, segment); the report renders them as
+        # the per-class table, severity-sorted within a class
+        path = tmp_path / "run.jsonl"
+        path.write_text("\n".join([
+            json.dumps({"kind": "slo_budget", "priority": 1,
+                        "axis": "ttft", "segment": "queued",
+                        "share": 0.5, "allowance_s": 0.25,
+                        "n": 4, "breached": 1, "worst_s": 0.41,
+                        "worst_seq_id": 9}),
+            json.dumps({"kind": "slo_budget", "priority": 0,
+                        "axis": "tpot", "segment": "prefetch_wait",
+                        "share": 0.35, "allowance_s": 0.037,
+                        "n": 5, "breached": 4, "worst_s": 0.133,
+                        "worst_seq_id": 3}),
+        ]) + "\n")
+        agg = report.aggregate(report.load_records([path]))
+        assert len(agg["budgets"]) == 2
+        rc = report.main([str(path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert ("slo budget breaches: 2 "
+                "(class axis segment: worst/allowance, count)") in out
+        # class 0 sorts first; fields land in the labeled columns
+        rows = [ln for ln in out.splitlines()
+                if "prefetch_wait" in ln or "queued" in ln]
+        assert "prefetch_wait" in rows[0] and "queued" in rows[1]
+        assert "133ms" in rows[0] and "37ms" in rows[0]
+        assert "4/5" in rows[0]
+        assert "41" in rows[1].replace("410ms", "410")
+
+    def test_no_budget_records_no_table(self, capsys):
+        rc = report.main([str(FIXTURE)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "slo budget breaches" not in out

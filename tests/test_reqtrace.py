@@ -483,3 +483,108 @@ class TestSnapshotAndExplain:
         empty = tmp_path / "empty.jsonl"
         RunLog(str(empty)).emit(kind="metrics")
         assert explainlib.main([str(empty)]) == 2
+
+
+class TestInterTokenDigest:
+    """The decode-phase half of the digest: per-token availability
+    stamps tile the same canonical segments over INTER-TOKEN windows,
+    so 'tpot p99 missed' comes pre-attributed like the TTFT band
+    does. Edge shapes the tiling must survive: shed-only streams (no
+    tokens at all), single-token responses (no gap), and
+    migration-install histories (segments without stamps — donor
+    token instants are engine-local wall clock, so installs start
+    empty)."""
+
+    @staticmethod
+    def _snap(entries):
+        return {"n": len(entries), "coverage_frac": 1.0,
+                "requests": {str(i): e
+                             for i, e in enumerate(entries)}}
+
+    def test_gap_tiling_attributes_the_stall(self):
+        # stamps at 1.0/1.1/3.0: the long gap crosses a 1.8s
+        # prefetch_wait span -> gap shares sum to 1.0 and the pooled
+        # p99 band blames the stall mechanism
+        e = {"priority": 0, "t_submit": 0.0, "t_first": 1.0,
+             "t_finish": 3.0, "tokens": 3, "outcome": "ok",
+             "segments": [["queued", 0.0, 1.0, None],
+                          ["decode", 1.0, 1.1, None],
+                          ["prefetch_wait", 1.1, 2.9, None],
+                          ["decode", 2.9, 3.0, None]],
+             "token_ts": [1.0, 1.1, 3.0]}
+        dig = explainlib.digest([self._snap([e])])
+        tp = dig["tpot"]
+        assert tp["n_gaps"] == 2 and tp["n_band"] == 1
+        assert sum(tp["band_shares"].values()) == pytest.approx(1.0)
+        assert dig["tpot_p99_band_shares"]["prefetch_wait"] \
+            == pytest.approx(1.8 / 1.9)
+        assert dig["tpot_p99_stall_share"] \
+            == pytest.approx(1.8 / 1.9)
+        # the per-class section carries the same pool
+        assert dig["classes"][0]["tpot"]["n_gaps"] == 2
+        text = explainlib.format_explain(dig)
+        assert "inter-token gaps" in text
+        assert "prefetch_wait" in text
+
+    def test_shed_only_stream_has_no_gaps_and_zero_stall_share(self):
+        e = {"priority": 0, "t_submit": 0.0, "t_first": None,
+             "t_finish": 1.0, "tokens": 0, "outcome": "shed",
+             "segments": [["queued", 0.0, 0.5, None],
+                          ["shed", 0.5, 0.5, None]],
+             "token_ts": None}
+        dig = explainlib.digest([self._snap([e])])
+        assert dig["tpot"]["n_gaps"] == 0
+        assert dig["tpot_p99_stall_share"] == 0.0
+        assert dig["tpot_p99_band_shares"] == {}
+        assert dig["tpot"]["gap"]["p99"] is None
+        explainlib.format_explain(dig)  # renders without a tpot line
+
+    def test_single_token_response_has_no_inter_token_window(self):
+        e = {"priority": 0, "t_submit": 0.0, "t_first": 1.0,
+             "t_finish": 1.0, "tokens": 1, "outcome": "ok",
+             "segments": [["prefill", 0.0, 1.0, None]],
+             "token_ts": [1.0]}
+        dig = explainlib.digest([self._snap([e])])
+        assert dig["tpot"]["n_gaps"] == 0
+        assert dig["tpot_p99_stall_share"] == 0.0
+
+    def test_migration_install_history_without_stamps_digests(self):
+        # a migrated request's install carries full segments but an
+        # empty stamp list (donor instants are engine-local): the
+        # TTFT half still attributes, the TPOT half stays silent
+        e = {"priority": 0, "t_submit": 0.0, "t_first": 0.5,
+             "t_finish": 2.0, "tokens": 8, "outcome": "ok",
+             "segments": [["queued", 0.0, 0.4, None],
+                          ["prefill", 0.4, 0.5, None],
+                          ["decode", 0.5, 1.0, None],
+                          ["migrating", 1.0, 1.5, None],
+                          ["decode", 1.5, 2.0, None]],
+             "token_ts": None}
+        dig = explainlib.digest([self._snap([e])])
+        assert dig["tpot"]["n_gaps"] == 0
+        assert dig["tpot_p99_stall_share"] == 0.0
+        assert dig["ttft_p99_band_shares"]["queued"] \
+            == pytest.approx(0.8)
+
+    def test_engine_snapshot_carries_monotone_token_stamps(self, setup):
+        # the producer half: a served stream's stats rows stamp one
+        # instant per collected token, nondecreasing, first stamp at
+        # t_first — and the snapshot serializes them
+        cfg, params = setup
+        reqtrace.configure(enabled=True)
+        eng = ContinuousBatcher(params, cfg, **ENG)
+        ids = [eng.submit(np.arange(5 + i, dtype=np.int32), 6)
+               for i in range(3)]
+        eng.run()
+        snap = reqtrace.active().snapshot(eng.stats)
+        for sid in ids:
+            entry = snap["requests"][str(sid)]
+            ts = entry["token_ts"]
+            assert len(ts) == entry["tokens"]
+            assert ts == sorted(ts)
+            assert ts[0] == pytest.approx(entry["t_first"])
+            assert ts[-1] <= entry["t_finish"] + 1e-6
+        dig = explainlib.digest([snap])
+        assert dig["tpot"]["n_gaps"] >= 3
+        assert sum(dig["tpot"]["band_shares"].values()) \
+            == pytest.approx(1.0)
